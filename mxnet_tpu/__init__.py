@@ -1,0 +1,49 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's
+capabilities (reference: gigasquid/incubator-mxnet), rebuilt on
+JAX/XLA/PjRt/Pallas. See SURVEY.md for the capability map.
+
+Usage mirrors the reference's ``import mxnet as mx``::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .random import seed
+
+__version__ = "0.1.0"
+
+# Subpackages that may not exist yet early in the build are imported lazily.
+_LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
+         "initializer", "init", "kvstore", "kv", "callback", "lr_scheduler",
+         "profiler", "parallel", "test_utils", "image", "recordio", "engine",
+         "executor", "model", "monitor", "visualization")
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("sym", "symbol"):
+        mod = importlib.import_module(".symbol", __name__)
+        globals()["symbol"] = mod
+        globals()["sym"] = mod
+        return mod
+    if name in ("init", "initializer"):
+        mod = importlib.import_module(".initializer", __name__)
+        globals()["initializer"] = mod
+        globals()["init"] = mod
+        return mod
+    if name == "kv":
+        mod = importlib.import_module(".kvstore", __name__)
+        globals()["kvstore"] = mod
+        globals()["kv"] = mod
+        return mod
+    if name in _LAZY:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

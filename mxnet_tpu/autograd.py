@@ -1,0 +1,314 @@
+"""Imperative autograd.
+
+Reference: python/mxnet/autograd.py + src/imperative/imperative.cc
+(RecordOp/Backward, SURVEY.md §3.2).
+
+TPU-native design: the tape records (op, attrs, input values, node links)
+per eager call. ``backward`` walks the tape in reverse and computes each
+entry's input cotangents with a **jitted, cached ``jax.vjp``** of the op's
+pure function — per-op FGradient registrations (the reference's
+``pass::Gradient`` machinery) are unnecessary because JAX differentiates
+the op body directly. Re-running the forward inside vjp is deliberate
+rematerialization: it trades HBM for FLOPs, which is the right default on
+TPU (SURVEY.md §7 notes XLA buffer reuse replaces PlanMemory).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import weakref
+
+from .base import MXNetError, canonical_attrs
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "mark_variable", "backward",
+           "grad", "set_recording", "set_training", "record_op"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train):
+    prev = _st().training
+    _state.training = bool(train)
+    return prev
+
+
+class _RecordingScope:
+    def __init__(self, is_record, train):
+        self._is_record = is_record
+        self._train = train
+
+    def __enter__(self):
+        self._prev_r = (set_recording(self._is_record)
+                        if self._is_record is not None else None)
+        self._prev_t = (set_training(self._train)
+                        if self._train is not None else None)
+        return self
+
+    def __exit__(self, *exc):
+        if self._is_record is not None:
+            set_recording(self._prev_r)
+        if self._train is not None:
+            set_training(self._prev_t)
+
+
+def record(train_mode=True):
+    """Scope enabling tape recording (reference: autograd.py:122)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape structures
+# ---------------------------------------------------------------------------
+
+class AGNode:
+    """Autograd graph node: one output of one recorded op, or a leaf
+    variable (the analog of Imperative::AGInfo + nnvm NodeEntry,
+    include/mxnet/imperative.h:39)."""
+
+    __slots__ = ("entry", "out_index", "array_ref", "grad_req", "__weakref__")
+
+    def __init__(self, entry=None, out_index=0, array=None, grad_req=None):
+        self.entry = entry
+        self.out_index = out_index
+        self.array_ref = weakref.ref(array) if array is not None else None
+        self.grad_req = grad_req
+
+    @property
+    def is_leaf(self):
+        return self.entry is None
+
+
+class TapeEntry:
+    __slots__ = ("op", "attrs", "input_nodes", "input_values", "key",
+                 "n_outputs", "output_nodes")
+
+    def __init__(self, op, attrs, input_nodes, input_values, key, n_outputs):
+        self.op = op
+        self.attrs = attrs
+        self.input_nodes = input_nodes
+        self.input_values = input_values
+        self.key = key
+        self.n_outputs = n_outputs
+        self.output_nodes = []
+
+
+def mark_variable(x, grad_req="write"):
+    from .ndarray.ndarray import NDArray, zeros
+    node = AGNode(array=x, grad_req=grad_req)
+    x._ag_node = node
+    x._grad_req = grad_req
+    if grad_req != "null":
+        x.grad = zeros(x.shape, ctx=x.context, dtype=x.dtype)
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """Reference: python/mxnet/autograd.py mark_variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for i, v in enumerate(variables):
+        mark_variable(v, grad_reqs[i])
+        if gradients is not None:
+            v.grad = gradients[i]
+
+
+def record_op(op, attrs, inputs, outputs, key=None):
+    """Append an op application to the tape (called by invoke_op)."""
+    from .ndarray.ndarray import NDArray
+    input_nodes = []
+    any_node = False
+    for x in inputs:
+        n = x._ag_node if isinstance(x, NDArray) else None
+        input_nodes.append(n)
+        any_node = any_node or n is not None
+    if not any_node:
+        return
+    vals = tuple(x._data if isinstance(x, NDArray) else x for x in inputs)
+    entry = TapeEntry(op, dict(attrs), input_nodes, vals, key, len(outputs))
+    for i, o in enumerate(outputs):
+        node = AGNode(entry=entry, out_index=i, array=o)
+        o._ag_node = node
+        entry.output_nodes.append(node)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _vjp_fn(name, attr_key, with_key):
+    """Jitted (inputs, cotangents) -> input gradients for one (op, attrs)."""
+    import jax
+    from .ops.registry import get_op
+    op = get_op(name)
+    attrs = dict(attr_key)
+
+    def fwd(*arrs):
+        out = op.fn(*arrs, **attrs)
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    def run(inputs, cts):
+        _, vjp = jax.vjp(fwd, *inputs)
+        grads = vjp(tuple(cts))
+        return grads[1:] if with_key else grads
+
+    return jax.jit(run)
+
+
+def _topo_entries(head_nodes):
+    seen = set()
+    order = []
+
+    def visit(entry):
+        if entry is None or id(entry) in seen:
+            return
+        seen.add(id(entry))
+        for n in entry.input_nodes:
+            if n is not None and n.entry is not None:
+                visit(n.entry)
+        order.append(entry)
+
+    for n in head_nodes:
+        if n is not None:
+            visit(n.entry)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (reference: Imperative::Backward, src/imperative/imperative.cc:270)."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    head_nodes = []
+    for h in heads:
+        if h._ag_node is None:
+            raise MXNetError(
+                "cannot differentiate a head that is not in a recorded "
+                "computation (reference: imperative.cc Backward check)")
+        head_nodes.append(h._ag_node)
+
+    grad_map = {}
+
+    def add_grad(node, g):
+        prev = grad_map.get(id(node))
+        grad_map[id(node)] = g if prev is None else prev + g
+
+    for i, h in enumerate(heads):
+        if head_grads is None or head_grads[i] is None:
+            g = jnp.ones(h.shape, dtype=h.dtype)
+        else:
+            hg = head_grads[i]
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        add_grad(h._ag_node, g)
+
+    entries = _topo_entries(head_nodes)
+    leaf_nodes = {}
+    for n in head_nodes:
+        if n.is_leaf:
+            leaf_nodes[id(n)] = n
+    for e in entries:
+        for n in e.input_nodes:
+            if n is not None and n.is_leaf:
+                leaf_nodes[id(n)] = n
+
+    for entry in reversed(entries):
+        cts = []
+        needed = False
+        for i, onode in enumerate(entry.output_nodes):
+            g = grad_map.get(id(onode))
+            if g is None:
+                # zero cotangent for unused outputs
+                import jax
+                shape_dtype = jax.eval_shape(
+                    lambda *a: _normalize(entry.op.fn(*a, **entry.attrs))[i],
+                    *( ((entry.key,) if entry.key is not None else ()) + entry.input_values))
+                g = jnp.zeros(shape_dtype.shape, dtype=shape_dtype.dtype)
+            else:
+                needed = True
+            cts.append(g)
+        if not needed:
+            continue
+        with_key = entry.key is not None
+        inputs = ((entry.key,) + entry.input_values) if with_key \
+            else entry.input_values
+        fn = _vjp_fn(entry.op.name, canonical_attrs(entry.attrs), with_key)
+        in_grads = fn(inputs, tuple(cts))
+        for node, g in zip(entry.input_nodes, in_grads):
+            if node is None or g is None:
+                continue
+            if hasattr(g, "dtype") and g.dtype.name == "float0":
+                continue
+            add_grad(node, g)
+
+    # write accumulated gradients into leaf arrays
+    for node in leaf_nodes.values():
+        g = grad_map.get(id(node))
+        if g is None or node.grad_req == "null":
+            continue
+        arr = node.array_ref() if node.array_ref else None
+        if arr is None:
+            continue
+        if node.grad_req == "add" and arr.grad is not None:
+            arr.grad._set_data(arr.grad._data + g)
+        else:
+            if arr.grad is None:
+                from .ndarray.ndarray import zeros
+                arr.grad = zeros(arr.shape, ctx=arr.context, dtype=arr.dtype)
+            arr.grad._set_data(g)
+
+
+def _normalize(out):
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (reference: autograd.py grad)."""
+    from .ndarray.ndarray import NDArray
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order grad) pending")
+    saved = [(v.grad, v._grad_req) for v in variables]
+    for v in variables:
+        if v._ag_node is None or not v._ag_node.is_leaf:
+            raise MXNetError("grad requires marked leaf variables")
+        v._ag_node.grad_req = "write"
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    outs = [v.grad for v in variables]
+    for v, (g, req) in zip(variables, saved):
+        pass
+    return outs

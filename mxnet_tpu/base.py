@@ -1,0 +1,65 @@
+"""Base plumbing: dtype tables, registry helpers, exceptions.
+
+TPU-native re-design of the reference's ctypes plumbing layer
+(reference: python/mxnet/base.py). There is no C ABI boundary on the hot
+path here — the "backend" is JAX/XLA, so this module only carries the
+shared type tables and small utilities.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "string_types", "numeric_types",
+    "np_dtype", "dtype_name", "DEFAULT_DTYPE",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: python/mxnet/base.py:72)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# canonical dtype table (reference: python/mxnet/base.py / mshadow type enum)
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes/jnp
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+DEFAULT_DTYPE = _np.float32
+
+
+def np_dtype(dtype):
+    """Resolve a dtype name / np dtype / jnp dtype to a numpy dtype object."""
+    if dtype is None:
+        return _np.dtype(DEFAULT_DTYPE)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return _np.dtype(ml_dtypes.bfloat16)
+        return _np.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np_dtype(dtype).name
+
+
+def canonical_attrs(attrs: dict) -> tuple:
+    """Canonicalize op attributes into a hashable key (lists→tuples)."""
+    out = []
+    for k in sorted(attrs):
+        v = attrs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        out.append((k, v))
+    return tuple(out)

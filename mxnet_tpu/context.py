@@ -1,0 +1,116 @@
+"""Device contexts.
+
+Re-design of the reference's Context (reference: python/mxnet/context.py):
+``mx.cpu()`` / ``mx.gpu(i)`` become ``cpu()`` / ``tpu(i)`` mapping onto JAX
+devices. ``gpu`` is kept as an alias for ``tpu`` so reference-style scripts
+run unchanged. Contexts are cheap handles; when the requested platform is
+not present (e.g. unit tests forced onto CPU) a ``tpu(i)`` context
+transparently resolves to the i-th available device — mirroring how the
+reference's tests use multiple ``mx.cpu(i)`` fakes to exercise
+multi-context code paths (reference: tests/python/unittest/test_kvstore.py).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_thread_local = threading.local()
+
+
+class Context:
+    """A device context (reference: python/mxnet/context.py:28)."""
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devstr2type:
+            raise ValueError("unknown device type %r" % (device_type,))
+        # 'gpu' is accepted as an alias so reference scripts keep working
+        self.device_typeid = self.devstr2type[device_type]
+        self.device_id = int(device_id)
+
+    @property
+    def device_type(self) -> str:
+        return self.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- context stack ----------------------------------------------------
+    def __enter__(self):
+        stack = _ctx_stack()
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ctx_stack().pop()
+
+    # -- JAX device resolution --------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        Accelerator contexts pick from accelerator devices when present,
+        otherwise fall back to host devices (so ``tpu(i)`` works as a cheap
+        fake under the forced-CPU test configuration).
+        """
+        import jax
+
+        if self.device_type == "tpu":
+            devs = _accel_devices()
+            if not devs:
+                devs = jax.devices()
+        else:
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+def _accel_devices():
+    import jax
+    devs = [d for d in jax.devices() if d.platform not in ("cpu",)]
+    return devs
+
+
+def _ctx_stack():
+    if not hasattr(_thread_local, "stack"):
+        _thread_local.stack = [Context("cpu", 0)]
+    return _thread_local.stack
+
+
+def current_context() -> Context:
+    return _ctx_stack()[-1]
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias for :func:`tpu` (compat with reference scripts)."""
+    return Context("tpu", device_id)
+
+
+def num_tpus() -> int:
+    return len(_accel_devices())
+
+
+def num_gpus() -> int:
+    return num_tpus()

@@ -1,0 +1,22 @@
+"""NDArray package: eager tensor API + generated op namespace
+(reference: python/mxnet/ndarray/__init__.py)."""
+from .ndarray import (NDArray, invoke_op, array, zeros, ones, full, empty,
+                      arange, concat, stack, waitall)
+from .utils import save, load
+from . import random
+from . import _internal
+
+# populate generated op functions (nd.relu, nd.FullyConnected, ...)
+from . import register as _register
+_register.populate(__name__, __package__ + "._internal")
+
+
+def onehot_encode(indices, out):
+    """Reference: python/mxnet/ndarray/ndarray.py onehot_encode."""
+    depth = out.shape[1]
+    return invoke_op("one_hot", [indices], {"depth": depth}, out=out)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    return invoke_op("dot", [lhs, rhs], {"transpose_a": transpose_a,
+                                         "transpose_b": transpose_b})

@@ -1,0 +1,2 @@
+"""Internal (underscore-prefixed) generated ops land here, mirroring
+python/mxnet/ndarray/_internal.py in the reference."""

@@ -1,0 +1,79 @@
+"""Random sampling frontend (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..base import np_dtype
+from ..context import current_context
+from .ndarray import NDArray, invoke_op
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "randint", "negative_binomial", "multinomial", "shuffle"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _sample(opname, attrs, ctx, out):
+    ctx = ctx or current_context()
+    with ctx:
+        return invoke_op(opname, [], attrs, out=out)
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_uniform", {"low": low, "high": high,
+                                       "shape": _shape(shape),
+                                       "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_normal", {"loc": loc, "scale": scale,
+                                      "shape": _shape(shape),
+                                      "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_gamma", {"alpha": alpha, "beta": beta,
+                                     "shape": _shape(shape),
+                                     "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_exponential", {"lam": 1.0 / scale,
+                                           "shape": _shape(shape),
+                                           "dtype": np_dtype(dtype).name},
+                   ctx, out)
+
+
+def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_poisson", {"lam": lam, "shape": _shape(shape),
+                                       "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None):
+    return _sample("_random_randint", {"low": low, "high": high,
+                                       "shape": _shape(shape),
+                                       "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("_random_negative_binomial",
+                   {"k": k, "p": p, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, ctx, out)
+
+
+def multinomial(data, shape=(), get_prob=False, out=None, dtype="int32"):
+    return invoke_op("_sample_multinomial", [data],
+                     {"shape": _shape(shape), "get_prob": get_prob,
+                      "dtype": np_dtype(dtype).name}, out=out)
+
+
+def shuffle(data, out=None):
+    return invoke_op("_shuffle", [data], {}, out=out)

@@ -1,0 +1,51 @@
+"""Generate the NDArray op namespace from the registry.
+
+Reference: python/mxnet/ndarray/register.py:30-169 — the reference walks
+the C op registry at import and code-generates one Python function per op.
+Here the registry is Python-native so "codegen" is closure generation; the
+calling convention is kept: positional NDArray inputs, keyword attrs, and
+keyword NDArray arguments are treated as additional inputs (in keyword
+order), `out=` for destination arrays.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke_op
+
+__all__ = ["make_op_func", "populate"]
+
+
+def make_op_func(opdef):
+    name = opdef.name
+
+    def op_func(*args, out=None, name=None, **kwargs):  # noqa: A002
+        arrays = list(args)
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                arrays.append(v)
+            else:
+                attrs[k] = v
+        return invoke_op(opdef.name, arrays, attrs, out=out)
+
+    op_func.__name__ = name
+    op_func.__qualname__ = name
+    op_func.__doc__ = opdef.doc
+    return op_func
+
+
+def populate(target_module_name, internal_module_name=None):
+    """Install generated functions into the given module namespaces."""
+    mod = sys.modules[target_module_name]
+    internal = sys.modules.get(internal_module_name)
+    for name in _reg.list_ops():
+        fn = make_op_func(_reg.get_op(name))
+        if name.startswith("_"):
+            if internal is not None:
+                setattr(internal, name, fn)
+        else:
+            setattr(mod, name, fn)
+        if internal is not None and not name.startswith("_"):
+            setattr(internal, name, fn)

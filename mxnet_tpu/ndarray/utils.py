@@ -1,0 +1,67 @@
+"""NDArray save/load (reference: python/mxnet/ndarray/utils.py:149,222 and
+the native format at src/ndarray/ndarray.cc:1565-1763).
+
+Format: a single ``.npz``-style container is deliberately NOT used; instead
+we keep a named-tensor dict serialized with numpy's portable NPY encoding
+inside a zip, so checkpoints are shard-aware-friendly and readable without
+the framework. API matches ``mx.nd.save/load``.
+"""
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+_MAGIC = "mxtpu-ndarray-v1"
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict (reference: utils.py:149)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        items = [(k, v) for k, v in data.items()]
+        keyed = True
+    elif isinstance(data, (list, tuple)):
+        items = [(str(i), v) for i, v in enumerate(data)]
+        keyed = False
+    else:
+        raise MXNetError("save requires NDArray, list or dict")
+    for _, v in items:
+        if not isinstance(v, NDArray):
+            raise MXNetError("save requires NDArray values")
+    with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
+                    (_MAGIC, int(keyed), len(items)))
+        for i, (k, v) in enumerate(items):
+            buf = io.BytesIO()
+            _np.save(buf, v.asnumpy(), allow_pickle=False)
+            zf.writestr("%05d:%s" % (i, k), buf.getvalue())
+
+
+def load(fname, ctx=None):
+    """Load NDArrays saved by :func:`save` (reference: utils.py:222)."""
+    if not os.path.exists(fname):
+        raise MXNetError("no such file %r" % fname)
+    with zipfile.ZipFile(fname, "r") as zf:
+        meta = zf.read("__meta__").decode().splitlines()
+        if meta[0] != _MAGIC:
+            raise MXNetError("not an NDArray file: %r" % fname)
+        keyed = bool(int(meta[1].split("=")[1]))
+        names = [n for n in zf.namelist() if n != "__meta__"]
+        names.sort()
+        out_items = []
+        for n in names:
+            idx, key = n.split(":", 1)
+            arr = _np.load(io.BytesIO(zf.read(n)), allow_pickle=False)
+            out_items.append((key, array(arr, ctx=ctx, dtype=arr.dtype)))
+    if keyed:
+        return dict(out_items)
+    return [v for _, v in out_items]

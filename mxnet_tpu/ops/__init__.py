@@ -1,0 +1,14 @@
+"""Operator library: importing this package populates the registry."""
+from .registry import (OpDef, register, get_op, list_ops, invoke, invoke_raw,
+                       alias)
+
+from . import elemwise     # noqa: F401
+from . import reduce       # noqa: F401
+from . import matrix       # noqa: F401
+from . import nn           # noqa: F401
+from . import creation     # noqa: F401
+from . import random_ops   # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "invoke_raw",
+           "alias"]

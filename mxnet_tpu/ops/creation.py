@@ -1,0 +1,51 @@
+"""Array-creation operators (reference: src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+from ..base import np_dtype
+
+
+@register("_zeros", differentiable=False,
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _zeros(shape=(), dtype="float32", **_ignored):
+    return jnp.zeros(shape, dtype=np_dtype(dtype))
+
+
+@register("_ones", differentiable=False,
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _ones(shape=(), dtype="float32", **_ignored):
+    return jnp.ones(shape, dtype=np_dtype(dtype))
+
+
+@register("_full", differentiable=False,
+          attr_defaults={"shape": (), "value": 0.0, "dtype": "float32"})
+def _full(shape=(), value=0.0, dtype="float32", **_ignored):
+    return jnp.full(shape, value, dtype=np_dtype(dtype))
+
+
+@register("_arange", differentiable=False,
+          attr_defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                         "dtype": "float32"})
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+            **_ignored):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", differentiable=False,
+          attr_defaults={"start": 0.0, "stop": 1.0, "num": 50, "endpoint": True,
+                         "dtype": "float32"})
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32",
+              **_ignored):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=np_dtype(dtype))
+
+
+@register("_eye", differentiable=False,
+          attr_defaults={"N": 0, "M": 0, "k": 0, "dtype": "float32"})
+def _eye(N=0, M=0, k=0, dtype="float32", **_ignored):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=np_dtype(dtype))

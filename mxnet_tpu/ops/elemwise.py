@@ -1,0 +1,220 @@
+"""Elementwise operators.
+
+TPU-native replacement for the reference's elementwise op families
+(reference: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_binary_scalar_op_*.cc and the
+scalar functor zoo in src/operator/mshadow_op.h). Each op is a pure jnp
+function; XLA fuses chains of these into single kernels, which replaces
+the reference's engine-level op bulking (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+def _softrelu(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "softrelu": _softrelu,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name)(lambda x, _f=_f: _f(x))
+
+@register("_copy")
+def _copy(x):
+    return x
+
+alias("identity", "_copy")
+
+
+@register("stop_gradient")
+def _stop_gradient(x):
+    return lax.stop_gradient(x)
+
+alias("BlockGrad", "stop_gradient")
+
+
+@register("make_loss")
+def _make_loss(x):
+    return x
+
+alias("MakeLoss", "make_loss")
+
+
+# ---------------------------------------------------------------------------
+# binary (broadcasting); elemwise_* are the same-shape fast path in the
+# reference (src/operator/tensor/elemwise_binary_op_basic.cc) — on XLA both
+# lower identically, so they share implementations.
+# ---------------------------------------------------------------------------
+
+def _cmp(f):
+    def _g(a, b):
+        return f(a, b).astype(jnp.result_type(a, b))
+    return _g
+
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": _cmp(jnp.equal),
+    "broadcast_not_equal": _cmp(jnp.not_equal),
+    "broadcast_greater": _cmp(jnp.greater),
+    "broadcast_greater_equal": _cmp(jnp.greater_equal),
+    "broadcast_lesser": _cmp(jnp.less),
+    "broadcast_lesser_equal": _cmp(jnp.less_equal),
+    "broadcast_logical_and": _cmp(jnp.logical_and),
+    "broadcast_logical_or": _cmp(jnp.logical_or),
+    "broadcast_logical_xor": _cmp(jnp.logical_xor),
+    "arctan2": jnp.arctan2,
+}
+
+for _name, _f in _BINARY.items():
+    register(_name)(lambda a, b, _f=_f: _f(a, b))
+
+for _ew, _bc in [("elemwise_add", "broadcast_add"), ("elemwise_sub", "broadcast_sub"),
+                 ("elemwise_mul", "broadcast_mul"), ("elemwise_div", "broadcast_div"),
+                 ("_plus", "broadcast_add"), ("_minus", "broadcast_sub"),
+                 ("_mul", "broadcast_mul"), ("_div", "broadcast_div"),
+                 ("_add", "broadcast_add"), ("_sub", "broadcast_sub"),
+                 ("_maximum", "broadcast_maximum"), ("_minimum", "broadcast_minimum"),
+                 ("_power", "broadcast_power"), ("_mod", "broadcast_mod"),
+                 ("_equal", "broadcast_equal"), ("_not_equal", "broadcast_not_equal"),
+                 ("_greater", "broadcast_greater"), ("_greater_equal", "broadcast_greater_equal"),
+                 ("_lesser", "broadcast_lesser"), ("_lesser_equal", "broadcast_lesser_equal"),
+                 ("_hypot", "broadcast_hypot")]:
+    alias(_ew, _bc)
+
+
+# ---------------------------------------------------------------------------
+# binary with scalar attr (reference: src/operator/tensor/elemwise_binary_scalar_op_*.cc)
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, f, defaults=None):
+    def _g(x, scalar=0.0):
+        return f(x, jnp.asarray(scalar, dtype=x.dtype))
+    register(name, attr_defaults=(defaults or {"scalar": 0.0}))(_g)
+
+
+_scalar_op("_plus_scalar", jnp.add)
+_scalar_op("_minus_scalar", jnp.subtract)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", jnp.multiply)
+_scalar_op("_div_scalar", jnp.divide)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar_op("_power_scalar", jnp.power)
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_hypot_scalar", jnp.hypot)
+_scalar_op("_equal_scalar", _cmp(jnp.equal))
+_scalar_op("_not_equal_scalar", _cmp(jnp.not_equal))
+_scalar_op("_greater_scalar", _cmp(jnp.greater))
+_scalar_op("_greater_equal_scalar", _cmp(jnp.greater_equal))
+_scalar_op("_lesser_scalar", _cmp(jnp.less))
+_scalar_op("_lesser_equal_scalar", _cmp(jnp.less_equal))
+_scalar_op("_logical_and_scalar", _cmp(jnp.logical_and))
+_scalar_op("_logical_or_scalar", _cmp(jnp.logical_or))
+_scalar_op("_logical_xor_scalar", _cmp(jnp.logical_xor))
+
+
+@register("clip", attr_defaults={"a_min": 0.0, "a_max": 0.0})
+def _clip(x, a_min=0.0, a_max=0.0):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("smooth_l1", attr_defaults={"scalar": 1.0})
+def _smooth_l1(x, scalar=1.0):
+    """Reference: src/operator/tensor/elemwise_binary_scalar_op_extended.cc."""
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+@register("zeros_like")
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def _size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int32)
+
+
+@register("Cast", attr_defaults={"dtype": "float32"})
+def _cast(x, dtype="float32"):
+    from ..base import np_dtype
+    return x.astype(np_dtype(dtype))
+
+alias("cast", "Cast")

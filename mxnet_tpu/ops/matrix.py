@@ -1,0 +1,429 @@
+"""Shape-manipulation, indexing and linear-algebra operators.
+
+Reference: src/operator/tensor/matrix_op.cc (reshape/transpose/slice/...,
+special reshape codes implemented at src/operator/tensor/matrix_op-inl.h),
+dot.cc, indexing_op.cc (take/Embedding/one_hot/gather_nd/scatter_nd),
+concat.cc, and the sequence ops (src/operator/sequence_*). All static-shape
+transforms — dynamic shapes would defeat XLA tiling, so anything
+data-dependent (e.g. sequence masking) is expressed with masks instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from ..base import np_dtype, MXNetError
+
+
+# ---------------------------------------------------------------------------
+# reshape with MXNet's special codes (0, -1, -2, -3, -4)
+# reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape
+# ---------------------------------------------------------------------------
+
+def infer_reshape(src_shape, target, reverse=False):
+    src = list(src_shape)
+    tgt = list(target)
+    if reverse:
+        src = src[::-1]
+        tgt_rev = []
+        # reverse while keeping -4's two successor entries attached in order
+        i = len(tgt) - 1
+        parts = []
+        while i >= 0:
+            parts.append(tgt[i])
+            i -= 1
+        tgt = parts
+    out = []
+    src_i = 0
+    infer_idx = -1
+    i = 0
+    while i < len(tgt):
+        t = tgt[i]
+        if t > 0:
+            out.append(t)
+            src_i += 1
+        elif t == 0:
+            if src_i >= len(src):
+                raise MXNetError("reshape: 0 out of bounds")
+            out.append(src[src_i])
+            src_i += 1
+        elif t == -1:
+            if infer_idx >= 0:
+                raise MXNetError("reshape: more than one -1")
+            infer_idx = len(out)
+            out.append(-1)
+            src_i += 1
+        elif t == -2:
+            out.extend(src[src_i:])
+            src_i = len(src)
+        elif t == -3:
+            if src_i + 1 >= len(src):
+                raise MXNetError("reshape: -3 needs two source dims")
+            out.append(src[src_i] * src_i_next(src, src_i))
+            src_i += 2
+        elif t == -4:
+            d1, d2 = tgt[i + 1], tgt[i + 2]
+            d = src[src_i]
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("reshape: -4 with two -1s")
+            if d1 == -1:
+                d1 = d // d2
+            if d2 == -1:
+                d2 = d // d1
+            out.extend([d1, d2])
+            src_i += 1
+            i += 2
+        else:
+            raise MXNetError("reshape: invalid code %d" % t)
+        i += 1
+    total = 1
+    for s in src_shape:
+        total *= s
+    if infer_idx >= 0:
+        known = 1
+        for j, v in enumerate(out):
+            if j != infer_idx:
+                known *= v
+        out[infer_idx] = total // known
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+def src_i_next(src, i):
+    return src[i + 1]
+
+
+@register("Reshape", attr_defaults={"shape": None, "reverse": False})
+def _reshape(x, shape=None, reverse=False):
+    new_shape = infer_reshape(x.shape, shape, reverse)
+    return jnp.reshape(x, new_shape)
+
+alias("reshape", "Reshape")
+
+
+@register("reshape_like")
+def _reshape_like(x, y):
+    return jnp.reshape(x, y.shape)
+
+
+@register("Flatten")
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+alias("flatten", "Flatten")
+
+
+@register("transpose", attr_defaults={"axes": None})
+def _transpose(x, axes=None):
+    if not axes:
+        axes = None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", attr_defaults={"axis": 0})
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", attr_defaults={"axis": None})
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("swapaxes", attr_defaults={"dim1": 0, "dim2": 0})
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+alias("SwapAxis", "swapaxes")
+
+
+@register("slice", attr_defaults={"begin": (), "end": (), "step": ()})
+def _slice(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis", attr_defaults={"axis": 0, "begin": 0, "end": None})
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", attr_defaults={"axes": ()})
+def _slice_like(x, like, axes=()):
+    axes = axes or tuple(range(min(x.ndim, like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a % x.ndim] = slice(0, like.shape[a % like.ndim])
+    return x[tuple(idx)]
+
+
+@register("Concat", attr_defaults={"dim": 1})
+def _concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+alias("concat", "Concat")
+
+
+@register("stack", attr_defaults={"axis": 0})
+def _stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_n_outputs(attrs):
+    return int(dict(attrs)["num_outputs"])
+
+
+@register("SliceChannel", num_outputs=_split_n_outputs,
+          attr_defaults={"num_outputs": 1, "axis": 1, "squeeze_axis": False})
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+alias("split", "SliceChannel")
+
+
+@register("tile", attr_defaults={"reps": ()})
+def _tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat", attr_defaults={"repeats": 1, "axis": None})
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("reverse", attr_defaults={"axis": 0})
+def _reverse(x, axis=0):
+    return jnp.flip(x, axis=axis)
+
+alias("flip", "reverse")
+
+
+@register("Pad", attr_defaults={"mode": "constant", "pad_width": (), "constant_value": 0.0})
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    mode_map = {"edge": "edge", "reflect": "reflect"}
+    return jnp.pad(x, pw, mode=mode_map[mode])
+
+alias("pad", "Pad")
+
+
+@register("broadcast_to", attr_defaults={"shape": ()})
+def _broadcast_to(x, shape=()):
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis", attr_defaults={"axis": (), "size": ()})
+def _broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else axis
+    size = (size,) if isinstance(size, int) else size
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+alias("broadcast_axes", "broadcast_axis")
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (MXU path — reference: src/operator/tensor/dot.cc)
+# ---------------------------------------------------------------------------
+
+@register("dot", attr_defaults={"transpose_a": False, "transpose_b": False})
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    """General dot: contracts last axis of a with first axis of b
+    (reference dot semantics, src/operator/tensor/dot-inl.h). Transposes
+    flip which axis is contracted. Lowers to a single MXU dot_general."""
+    if transpose_a:
+        a = jnp.transpose(a, tuple(range(1, a.ndim)) + (0,)) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.transpose(b, (b.ndim - 1,) + tuple(range(0, b.ndim - 1))) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return lax.dot_general(a, b, (((a.ndim - 1,), (0,)), ((), ())))
+
+
+@register("batch_dot", attr_defaults={"transpose_a": False, "transpose_b": False})
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("take", attr_defaults={"axis": 0, "mode": "clip"})
+def _take(x, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(x, idx, axis=axis, mode=mode)
+
+
+@register("batch_take")
+def _batch_take(x, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
+
+
+@register("pick", attr_defaults={"axis": -1, "keepdims": False, "mode": "clip"})
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    axis = axis % x.ndim
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    idx = jnp.expand_dims(idx, axis) if idx.ndim < x.ndim else idx
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("Embedding", attr_defaults={"input_dim": 0, "output_dim": 0,
+                                      "dtype": "float32", "sparse_grad": False})
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc (Embedding). A plain
+    gather — XLA lowers to a dynamic-gather that keeps the table in HBM."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+@register("one_hot", differentiable=False,
+          attr_defaults={"depth": 0, "on_value": 1.0, "off_value": 0.0,
+                         "dtype": "float32"})
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    idx = indices.astype(jnp.int32)
+    oh = jax_one_hot(idx, depth)
+    out = oh * on_value + (1.0 - oh) * off_value
+    return out.astype(np_dtype(dtype))
+
+
+def jax_one_hot(idx, depth):
+    return (idx[..., None] == jnp.arange(depth, dtype=jnp.int32)).astype(jnp.float32)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", attr_defaults={"shape": ()})
+def _scatter_nd(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask_scalar_fill", attr_defaults={"value": 0.0})
+def _mask_fill(data, mask, value=0.0):
+    return jnp.where(mask.astype(bool), data, jnp.asarray(value, data.dtype))
+
+
+@register("diag", attr_defaults={"k": 0})
+def _diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops — masks, not dynamic shapes (reference: src/operator/sequence_*)
+# ---------------------------------------------------------------------------
+
+@register("SequenceMask", attr_defaults={"use_sequence_length": False,
+                                         "value": 0.0, "axis": 0})
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    t = jnp.arange(T)
+    # data is (T, N, ...) for axis=0 or (N, T, ...) for axis=1
+    if axis == 0:
+        mask = t[:, None] < sequence_length[None, :].astype(t.dtype)
+    else:
+        mask = t[None, :] < sequence_length[:, None].astype(t.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", attr_defaults={"use_sequence_length": False, "axis": 0})
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, N, ...)
+    return jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", attr_defaults={"use_sequence_length": False, "axis": 0})
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    t = jnp.arange(T)
+    L = sequence_length.astype(jnp.int32)  # (N,)
+    rev_idx = jnp.where(t[:, None] < L[None, :], L[None, :] - 1 - t[:, None],
+                        t[:, None])  # (T, N)
+    rev_idx = rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(rev_idx, data.shape), axis=0)
+
+
+@register("space_to_depth", attr_defaults={"block_size": 1})
+def _space_to_depth(x, block_size=1):
+    b = block_size
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space", attr_defaults={"block_size": 1})
+def _depth_to_space(x, block_size=1):
+    b = block_size
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)

@@ -1,0 +1,637 @@
+"""Neural-network operators.
+
+Reference: src/operator/nn/ (fully_connected.cc, convolution.cc,
+deconvolution.cc, pooling.cc, batch_norm.cc, layer_norm.cc, dropout.cc,
+softmax.cc, activation.cc, lrn.cc, upsampling.cc), src/operator/rnn.cc,
+src/operator/softmax_output.cc, src/operator/leaky_relu.cc.
+
+TPU design notes:
+* Convs/matmuls go through ``lax.conv_general_dilated`` / ``dot_general``
+  so XLA tiles them onto the MXU; elementwise epilogues (bias, activation,
+  BN scale/shift) fuse into the same kernel at compile time — this is the
+  TPU equivalent of the reference's cuDNN fused paths.
+* Everything is static-shape and functional. Stateful bits of the
+  reference ops (BatchNorm moving stats, Dropout RNG) are externalized:
+  BN returns (out, mean, var) and the layer owns running stats; random
+  ops take an explicit PRNG key threaded by the runtime.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc:239)
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", attr_defaults={"num_hidden": 0, "no_bias": False,
+                                           "flatten": True})
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True):
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(data, weight,
+                          (((data.ndim - 1,), (1,)), ((), ())))
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+_CONV_DIMS = {1: ("NCW", "OIW", "NCW"),
+              2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _tup(v, n, default):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register("Convolution", attr_defaults={"kernel": (), "stride": (), "dilate": (),
+                                        "pad": (), "num_filter": 0,
+                                        "num_group": 1, "no_bias": False,
+                                        "layout": None, "workspace": 1024,
+                                        "cudnn_tune": None, "cudnn_off": False})
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=0, num_group=1, no_bias=False, layout=None,
+                 **_ignored):
+    """Reference: src/operator/nn/convolution.cc. NCHW in/out; XLA's layout
+    assignment re-tiles internally for the MXU so no manual NHWC transpose
+    is needed."""
+    nd = len(kernel)
+    if nd not in _CONV_DIMS:
+        raise MXNetError("Convolution supports 1/2/3-d kernels")
+    stride = _tup(stride, nd, 1)
+    dilate = _tup(dilate, nd, 1)
+    pad = _tup(pad, nd, 0)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", attr_defaults={"kernel": (), "stride": (), "dilate": (),
+                                          "pad": (), "adj": (), "num_filter": 0,
+                                          "num_group": 1, "no_bias": True,
+                                          "layout": None, "target_shape": (),
+                                          "workspace": 1024})
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), num_filter=0, num_group=1, no_bias=True,
+                   layout=None, target_shape=(), **_ignored):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+    Implemented as input-dilated convolution with flipped kernels — the
+    gradient-of-conv formulation XLA pattern-matches natively."""
+    nd = len(kernel)
+    stride = _tup(stride, nd, 1)
+    dilate = _tup(dilate, nd, 1)
+    pad = _tup(pad, nd, 0)
+    adj = _tup(adj, nd, 0)
+    # weight layout (in_channels, num_filter//num_group, *kernel)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+
+    def one_group(x, wg):
+        dn = lax.conv_dimension_numbers(x.shape,
+                                        (wg.shape[1], wg.shape[0]) + wg.shape[2:],
+                                        _CONV_DIMS[nd])
+        wt = jnp.swapaxes(wg, 0, 1)  # -> (num_filter/g, in/g, *k)
+        padding = []
+        for k, p, d, a in zip(kernel, pad, dilate, adj):
+            keff = (k - 1) * d + 1
+            padding.append((keff - 1 - p, keff - 1 - p + a))
+        return lax.conv_general_dilated(
+            x, wt, window_strides=(1,) * nd, padding=padding,
+            lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+
+    if num_group == 1:
+        out = one_group(data, w)
+    else:
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(w, num_group, axis=0)
+        out = jnp.concatenate([one_group(x, wg) for x, wg in zip(xs, ws)], axis=1)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register("Pooling", attr_defaults={"kernel": (), "pool_type": "max",
+                                    "global_pool": False, "stride": (),
+                                    "pad": (), "pooling_convention": "valid",
+                                    "count_include_pad": True, "cudnn_off": False})
+def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+             pad=(), pooling_convention="valid", count_include_pad=True,
+             **_ignored):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = tuple(kernel)
+    stride = _tup(stride, nd, 1)
+    pad = _tup(pad, nd, 0)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)]
+    for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+        lo = hi = p
+        if pooling_convention == "full":
+            # ceil output convention (reference pooling_convention=full):
+            # pad the high side so the last partial window is included
+            in_sz = data.shape[2 + i]
+            out_sz = -(-(in_sz + 2 * p - k) // s) + 1  # ceil div
+            needed = (out_sz - 1) * s + k - in_sz - p
+            hi = max(p, needed)
+        padding.append((lo, hi))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    if count_include_pad:
+        denom = 1.0
+        for k in kernel:
+            denom *= k
+        return summed / denom
+    ones = jnp.ones(data.shape, dtype=data.dtype)
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+    return summed / counts
+
+
+@register("_contrib_AdaptiveAvgPooling2D", attr_defaults={"output_size": ()})
+def _adaptive_avg_pool(data, output_size=()):
+    """Reference: src/operator/contrib/adaptive_avg_pooling.cc."""
+    if not output_size:
+        out_h = out_w = 1
+    elif isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    n, c, h, w = data.shape
+    if h % out_h == 0 and w % out_w == 0:
+        x = data.reshape(n, c, out_h, h // out_h, out_w, w // out_w)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, out_h, out_w), method="linear")
+
+
+@register("UpSampling", attr_defaults={"scale": 1, "sample_type": "nearest",
+                                       "num_filter": 0, "multi_input_mode": "concat",
+                                       "workspace": 512})
+def _upsampling(*args, scale=1, sample_type="nearest", **_ignored):
+    data = args[0]
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        n, c, h, w = data.shape
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), method="linear")
+    return out
+
+
+@register("_contrib_BilinearResize2D", attr_defaults={"height": 0, "width": 0,
+                                                      "scale_height": None,
+                                                      "scale_width": None})
+def _bilinear_resize(data, height=0, width=0, scale_height=None, scale_width=None):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height, width = int(h * scale_height), int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def _mean_var_outputs(attrs):
+    return 3 if dict(attrs).get("output_mean_var", False) else 1
+
+
+@register("BatchNorm", num_outputs=_mean_var_outputs,
+          attr_defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                         "use_global_stats": False, "output_mean_var": False,
+                         "axis": 1, "cudnn_off": False, "train_mode": False})
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, train_mode=False, **_ignored):
+    """Reference: src/operator/nn/batch_norm.cc. Returns (out, mean, var);
+    the Gluon layer owns the moving-stat update (functional state)."""
+    axis = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if train_mode and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).reshape(bshape)
+    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm", num_outputs=_mean_var_outputs,
+          attr_defaults={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Reference: src/operator/nn/layer_norm.cc."""
+    axis = axis % data.ndim
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
+    out = (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("InstanceNorm", attr_defaults={"eps": 1e-3})
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+
+
+@register("LRN", attr_defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0,
+                                "nsize": 5})
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Reference: src/operator/nn/lrn.cc — cross-channel local response norm."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * data.ndim,
+                             [(0, 0)] * data.ndim)
+    return data / jnp.power(knorm + alpha * ssum / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+
+@register("Activation", attr_defaults={"act_type": "relu"})
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jnp.logaddexp(data, 0.0)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise MXNetError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", attr_defaults={"act_type": "leaky", "slope": 0.25,
+                                      "lower_bound": 0.125, "upper_bound": 0.334})
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **_ignored):
+    """Reference: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu)."""
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    raise MXNetError("unknown LeakyReLU act_type %r" % act_type)
+
+
+@register("softmax", attr_defaults={"axis": -1, "temperature": None,
+                                    "dtype": None, "use_length": False})
+def _softmax(data, axis=-1, temperature=None, **_ignored):
+    if temperature:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax", attr_defaults={"axis": -1, "temperature": None})
+def _log_softmax(data, axis=-1, temperature=None, **_ignored):
+    if temperature:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmin", attr_defaults={"axis": -1, "temperature": None})
+def _softmin(data, axis=-1, temperature=None, **_ignored):
+    if temperature:
+        data = data / temperature
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    """Reference: src/operator/loss_binary_op.cc — scalar total CE loss."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+def _softmax_output_fwd(data, label, grad_scale=1.0, ignore_label=-1.0,
+                        multi_output=False, use_ignore=False,
+                        preserve_shape=False, normalization="null",
+                        out_grad=False, smooth_alpha=0.0):
+    if multi_output:
+        out = jax.nn.softmax(data, axis=1)
+    else:
+        out = jax.nn.softmax(data, axis=-1)
+    return out
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _softmax_output_core(grad_scale, ignore_label, multi_output, use_ignore,
+                         normalization, smooth_alpha):
+    """Build a custom-vjp softmax-output closure for one static attr set.
+
+    The reference's SoftmaxOutput combines loss + gradient: backward is
+    (softmax - one_hot(label)) regardless of head grad
+    (reference: src/operator/softmax_output-inl.h)."""
+    axis_of = lambda out: 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def core(data, label):
+        return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+    def fwd(data, label):
+        out = core(data, label)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        axis = axis_of(out)
+        depth = out.shape[axis]
+        lab = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, depth, axis=axis, dtype=out.dtype)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) + smooth_alpha / (depth - 1) * (1.0 - oh)
+        grad = out - oh
+        if use_ignore:
+            keep = (label != ignore_label).astype(out.dtype)
+            keep = jnp.expand_dims(keep, axis) if keep.ndim < grad.ndim else keep
+            grad = grad * keep
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / out.shape[0]
+        if normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(label != ignore_label), 1)
+            scale = scale / valid
+        return (grad * scale, jnp.zeros_like(label))
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+@register("SoftmaxOutput", attr_defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                                          "multi_output": False, "use_ignore": False,
+                                          "preserve_shape": False,
+                                          "normalization": "null",
+                                          "out_grad": False, "smooth_alpha": 0.0})
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    core = _softmax_output_core(float(grad_scale), float(ignore_label),
+                                bool(multi_output), bool(use_ignore),
+                                str(normalization), float(smooth_alpha))
+    return core(data, label)
+
+alias("Softmax", "SoftmaxOutput")
+
+
+@register("LinearRegressionOutput", attr_defaults={"grad_scale": 1.0})
+def _linear_regression_output(data, label, grad_scale=1.0):
+    """Reference: src/operator/regression_output.cc."""
+    @jax.custom_vjp
+    def core(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return ((d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    core.defvjp(fwd, bwd)
+    return core(data, label)
+
+
+@register("LogisticRegressionOutput", attr_defaults={"grad_scale": 1.0})
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def core(d, l):
+        return jax.nn.sigmoid(d)
+
+    def fwd(d, l):
+        return jax.nn.sigmoid(d), (jax.nn.sigmoid(d), l)
+
+    def bwd(res, g):
+        o, l = res
+        return ((o - l.reshape(o.shape)) * grad_scale, jnp.zeros_like(l))
+
+    core.defvjp(fwd, bwd)
+    return core(data, label)
+
+
+@register("MAERegressionOutput", attr_defaults={"grad_scale": 1.0})
+def _mae_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def core(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return (jnp.sign(d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    core.defvjp(fwd, bwd)
+    return core(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (stateless; key threaded by runtime)
+# ---------------------------------------------------------------------------
+
+@register("Dropout", needs_rng=True,
+          attr_defaults={"p": 0.5, "mode": "training", "axes": (),
+                         "train_mode": False})
+def _dropout(key, data, p=0.5, mode="training", axes=(), train_mode=False,
+             **_ignored):
+    """Reference: src/operator/nn/dropout.cc. The per-device RandGenerator
+    resource becomes an explicit PRNG key input."""
+    if not train_mode and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (reference: src/operator/rnn.cc, rnn_impl.h; cuDNN-packed params)
+# ---------------------------------------------------------------------------
+
+def _rnn_num_outputs(attrs):
+    a = dict(attrs)
+    if not a.get("state_outputs", False):
+        return 1
+    return 3 if a.get("mode", "lstm") == "lstm" else 2
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total flat parameter count, cuDNN layout (W, R, bW, bR per layer/dir)."""
+    g = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        size += dirs * g * state_size * (isz + state_size + 2)
+    return size
+
+
+def _unpack_rnn_params(params, num_layers, input_size, state_size,
+                      bidirectional, mode):
+    g = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    offset = 0
+    layers = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        per_dir = []
+        for _ in range(dirs):
+            W = params[offset: offset + g * state_size * isz].reshape(
+                g * state_size, isz)
+            offset += g * state_size * isz
+            R = params[offset: offset + g * state_size * state_size].reshape(
+                g * state_size, state_size)
+            offset += g * state_size * state_size
+            bW = params[offset: offset + g * state_size]
+            offset += g * state_size
+            bR = params[offset: offset + g * state_size]
+            offset += g * state_size
+            per_dir.append((W, R, bW, bR))
+        layers.append(per_dir)
+    return layers
+
+
+def _cell_step(mode, H):
+    def step(carry, x_t, W, R, bW, bR):
+        if mode == "lstm":
+            h, c = carry
+            z = x_t @ W.T + h @ R.T + bW + bR
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        if mode == "gru":
+            (h,) = carry
+            zx = x_t @ W.T + bW
+            zh = h @ R.T + bR
+            rx, zx_, nx = jnp.split(zx, 3, axis=-1)
+            rh, zh_, nh = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx_ + zh_)
+            n = jnp.tanh(nx + r * nh)
+            h = (1 - z) * n + z * h
+            return (h,), h
+        (h,) = carry
+        z = x_t @ W.T + h @ R.T + bW + bR
+        h = jnp.tanh(z) if mode == "rnn_tanh" else jnp.maximum(z, 0)
+        return (h,), h
+    return step
+
+
+@register("RNN", num_outputs=_rnn_num_outputs,
+          attr_defaults={"state_size": 0, "num_layers": 1, "bidirectional": False,
+                         "mode": "lstm", "p": 0.0, "state_outputs": False,
+                         "projection_size": None, "train_mode": False})
+def _rnn(data, params, state, *maybe_cell, state_size=0, num_layers=1,
+         bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
+         **_ignored):
+    """Fused multilayer RNN over time via lax.scan (sequence layout TNC,
+    matching the reference's RNN op). Each timestep is a single MXU matmul
+    per direction; XLA unrolls nothing — the scan keeps compile time flat
+    for long sequences."""
+    T, N, I = data.shape
+    H = state_size
+    dirs = 2 if bidirectional else 1
+    cell = maybe_cell[0] if (mode == "lstm" and maybe_cell) else None
+    layers = _unpack_rnn_params(params, num_layers, I, H, bidirectional, mode)
+    step = _cell_step(mode, H)
+
+    x = data
+    h_states, c_states = [], []
+    for li, per_dir in enumerate(layers):
+        outs = []
+        for di, (W, R, bW, bR) in enumerate(per_dir):
+            h0 = state[li * dirs + di]
+            carry = (h0, cell[li * dirs + di]) if mode == "lstm" else (h0,)
+            xs = jnp.flip(x, axis=0) if di == 1 else x
+
+            def scan_fn(c, x_t, W=W, R=R, bW=bW, bR=bR):
+                return step(c, x_t, W, R, bW, bR)
+
+            carry, ys = lax.scan(scan_fn, carry, xs)
+            if di == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(carry[0])
+            if mode == "lstm":
+                c_states.append(carry[1])
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+    out = x
+    if not state_outputs:
+        return out
+    hN = jnp.stack(h_states, axis=0)
+    if mode == "lstm":
+        return out, hN, jnp.stack(c_states, axis=0)
+    return out, hN

@@ -1,0 +1,178 @@
+"""Optimizer update operators.
+
+Reference: src/operator/optimizer_op.cc — in the reference, "the update IS
+an operator" pushed through the engine; here each update is a pure fused
+XLA kernel. Convention: ``num_outputs == len(mutate_inputs)`` and output i
+is the new value of input ``mutate_inputs[i]`` — the NDArray layer writes
+results back in place, preserving the reference's mutation semantics.
+
+All updates apply ``rescale_grad``, optional gradient clipping and weight
+decay exactly as the reference kernels do, so Python-side Optimizer classes
+stay thin (reference: python/mxnet/optimizer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", mutate_inputs=(0,),
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0, "lazy_update": True})
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", mutate_inputs=(0, 2), num_outputs=2,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0,
+                         "lazy_update": True})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight)
+    return weight + mom_new, mom_new
+
+
+@register("nag_mom_update", mutate_inputs=(0, 2), num_outputs=2,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+@register("adam_update", mutate_inputs=(0, 2, 3), num_outputs=3,
+          attr_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                         "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0, "lazy_update": True})
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon),
+            mean_new, var_new)
+
+
+@register("rmsprop_update", mutate_inputs=(0, 2), num_outputs=2,
+          attr_defaults={"lr": 0.001, "gamma1": 0.95, "epsilon": 1e-8,
+                         "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0,
+                         "clip_weights": -1.0})
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+@register("rmspropalex_update", mutate_inputs=(0, 2, 3, 4), num_outputs=4,
+          attr_defaults={"lr": 0.001, "gamma1": 0.95, "gamma2": 0.9,
+                         "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0, "clip_weights": -1.0})
+def _rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_acc_new = gamma1 * g_acc + (1 - gamma1) * g
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(
+        n_new - jnp.square(g_acc_new) + epsilon)
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_acc_new, delta_new
+
+
+@register("ftrl_update", mutate_inputs=(0, 2, 3), num_outputs=3,
+          attr_defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) <= lamda1, jnp.zeros_like(weight),
+        -(z_new - jnp.sign(z_new) * lamda1)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w, z_new, n_new
+
+
+@register("ftml_update", mutate_inputs=(0, 2, 3, 4), num_outputs=4,
+          attr_defaults={"lr": 0.0025, "beta1": 0.6, "beta2": 0.999,
+                         "epsilon": 1e-8, "t": 1, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_grad": -1.0})
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                 **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_grad) + wd * weight
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+@register("signsgd_update", mutate_inputs=(0,),
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0})
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", mutate_inputs=(0, 2), num_outputs=2,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0,
+                         "wd_lh": 0.0})
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **_ig):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new) - lr * wd * weight
+    return w, mom_new
+
+
+@register("mp_sgd_update", mutate_inputs=(0, 2), num_outputs=2,
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0, "lazy_update": True})
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **_ig):
+    """Mixed-precision SGD: fp32 master weights, low-precision working copy
+    (reference: src/operator/optimizer_op.cc MP_SGD)."""
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", mutate_inputs=(0, 2, 3), num_outputs=3,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0,
+                         "lazy_update": True})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_ig):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32

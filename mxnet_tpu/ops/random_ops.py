@@ -1,0 +1,116 @@
+"""Random sampling operators.
+
+Reference: src/operator/random/sample_op.cc, multisample_op.cc,
+shuffle_op.cc and the per-device RandGenerator
+(include/mxnet/random_generator.h). TPU-native design: counter-based
+stateless PRNG — every op takes an explicit threefry key supplied by the
+runtime (mxnet_tpu.random keeps the global seed state), so sampling is
+reproducible, parallelizable across a device mesh by key-splitting, and
+trace-safe under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None or shape == ():
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform", needs_rng=True, differentiable=False,
+          attr_defaults={"low": 0.0, "high": 1.0, "shape": (), "dtype": "float32"})
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32", **_ig):
+    return jax.random.uniform(key, _shape(shape), dtype=np_dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", needs_rng=True, differentiable=False,
+          attr_defaults={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": "float32"})
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32", **_ig):
+    return loc + scale * jax.random.normal(key, _shape(shape),
+                                           dtype=np_dtype(dtype))
+
+
+@register("_random_gamma", needs_rng=True, differentiable=False,
+          attr_defaults={"alpha": 1.0, "beta": 1.0, "shape": (), "dtype": "float32"})
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32", **_ig):
+    return beta * jax.random.gamma(key, alpha, _shape(shape),
+                                   dtype=np_dtype(dtype))
+
+
+@register("_random_exponential", needs_rng=True, differentiable=False,
+          attr_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32", **_ig):
+    return jax.random.exponential(key, _shape(shape),
+                                  dtype=np_dtype(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True, differentiable=False,
+          attr_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32", **_ig):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register("_random_randint", needs_rng=True, differentiable=False,
+          attr_defaults={"low": 0, "high": 1, "shape": (), "dtype": "int32"})
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32", **_ig):
+    return jax.random.randint(key, _shape(shape), int(low), int(high),
+                              dtype=np_dtype(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True, differentiable=False,
+          attr_defaults={"k": 1, "p": 1.0, "shape": (), "dtype": "float32"})
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32", **_ig):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, float(k), _shape(shape)) * (1.0 - p) / p
+    return jax.random.poisson(kp, lam).astype(np_dtype(dtype))
+
+
+@register("_sample_multinomial", needs_rng=True, differentiable=False,
+          num_outputs=lambda attrs: 2 if dict(attrs).get("get_prob") else 1,
+          attr_defaults={"shape": (), "get_prob": False, "dtype": "int32"})
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32",
+                        **_ig):
+    """Categorical sampling from probabilities along the last axis
+    (reference: src/operator/random/multisample_op.cc)."""
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in _shape(shape):
+        n *= s
+    batch = data.shape[:-1]
+    draws = jax.random.categorical(key, logits, axis=-1,
+                                   shape=_shape(shape) + batch if shape else batch)
+    # moveaxis so batch dims lead, sample dims trail (MXNet convention)
+    if shape:
+        k = len(_shape(shape))
+        draws = jnp.moveaxis(draws, tuple(range(k)),
+                             tuple(range(draws.ndim - k, draws.ndim)))
+    out = draws.astype(np_dtype(dtype))
+    if get_prob:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        gathered = jnp.take_along_axis(
+            jnp.broadcast_to(logp, draws.shape + (data.shape[-1],)),
+            draws[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, gathered
+    return out
+
+
+@register("_shuffle", needs_rng=True, differentiable=False)
+def _shuffle(key, data, **_ig):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", needs_rng=True, differentiable=False,
+          attr_defaults={"range_max": 1, "shape": ()})
+def _sample_unique_zipfian(key, range_max=1, shape=(), **_ig):
+    u = jax.random.uniform(key, _shape(shape))
+    out = jnp.expm1(u * jnp.log1p(float(range_max) - 1.0)).astype(jnp.int64)
+    return jnp.clip(out, 0, range_max - 1).astype(jnp.int32)

@@ -1,0 +1,152 @@
+"""Reduction / ordering operators.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc (sum/mean/...,
+axis/keepdims/exclude attrs) and src/operator/tensor/ordering_op.cc
+(sort/argsort/topk). Reductions lower to single XLA reduce ops — the MXU /
+VPU tiling the reference gets from mshadow expression templates comes from
+XLA here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _norm_axis(ndim, axis, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce(fn_name, f):
+    def _g(x, axis=None, keepdims=False, exclude=False):
+        axes = _norm_axis(x.ndim, axis, exclude)
+        return f(x, axis=axes, keepdims=bool(keepdims))
+    register(fn_name, attr_defaults={"axis": None, "keepdims": False,
+                                     "exclude": False})(_g)
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+
+
+@register("norm", attr_defaults={"ord": 2, "axis": None, "keepdims": False})
+def _norm(x, ord=2, axis=None, keepdims=False):
+    axes = None if axis is None else _norm_axis(x.ndim, axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=bool(keepdims)))
+
+
+@register("argmax", differentiable=False,
+          attr_defaults={"axis": None, "keepdims": False})
+def _argmax(x, axis=None, keepdims=False):
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        out = out.reshape((1,) * x.ndim) if keepdims else out
+    else:
+        out = jnp.argmax(x, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+    return out.astype(x.dtype)
+
+
+@register("argmin", differentiable=False,
+          attr_defaults={"axis": None, "keepdims": False})
+def _argmin(x, axis=None, keepdims=False):
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1))
+        out = out.reshape((1,) * x.ndim) if keepdims else out
+    else:
+        out = jnp.argmin(x, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+    return out.astype(x.dtype)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+@register("sort", attr_defaults={"axis": -1, "is_ascend": True})
+def _sort(x, axis=-1, is_ascend=True):
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False,
+          attr_defaults={"axis": -1, "is_ascend": True, "dtype": "float32"})
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import np_dtype
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(np_dtype(dtype))
+
+
+def _topk_num_outputs(attrs):
+    return 2 if dict(attrs).get("ret_typ", "indices") == "both" else 1
+
+
+@register("topk", differentiable=False, num_outputs=_topk_num_outputs,
+          attr_defaults={"axis": -1, "k": 1, "ret_typ": "indices",
+                         "is_ascend": False, "dtype": "float32"})
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: src/operator/tensor/ordering_op-inl.h. Uses lax.top_k
+    (TPU-native sort network) with a negate trick for ascending order."""
+    from ..base import np_dtype
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(np_dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros(xm.shape, dtype=x.dtype)
+        mask = mask.at[..., :].set(0)
+        onehots = jnp.sum(jnp.eye(xm.shape[-1], dtype=x.dtype)[idx], axis=-2)
+        return jnp.moveaxis(onehots, -1, axis)
+    return vals, idx.astype(np_dtype(dtype))
+
+
+@register("L2Normalization", attr_defaults={"eps": 1e-10, "mode": "instance"})
+def _l2_normalization(x, eps=1e-10, mode="instance"):
+    """Reference: src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / denom

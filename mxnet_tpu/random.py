@@ -1,0 +1,41 @@
+"""Global PRNG state.
+
+Reference: python/mxnet/random.py + per-device RandGenerator
+(include/mxnet/random_generator.h). TPU-native design: a single counter
+advanced per random op, folded into a threefry key — deterministic given
+``seed()``, cheap to split across a device mesh, and safe to capture in
+traced programs (the trace takes the key as an input).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "seed"):
+        _state.seed = 0
+        _state.counter = 0
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (reference: python/mxnet/random.py:30)."""
+    _ensure()
+    _state.seed = int(seed_state)
+    _state.counter = 0
+
+
+def current_seed():
+    _ensure()
+    return _state.seed
+
+
+def next_key():
+    """Return a fresh jax PRNG key; advances the global counter."""
+    import jax
+    _ensure()
+    _state.counter += 1
+    return jax.random.fold_in(jax.random.PRNGKey(_state.seed), _state.counter)

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-device sharding paths
+are exercised without TPU hardware (mirrors the reference's use of
+multiple mx.cpu(i) fake contexts, SURVEY.md §4). Must run before jax
+import anywhere in the test process.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,154 @@
+"""Autograd tests (mirrors reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x * 2)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(4.0), rtol=1e-5)
+
+
+def test_reuse_variable():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x  # dy/dx = 2x + 1
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_multiple_variables():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy())
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(y_const * x)/dx
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_not_recording_outside_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    assert y._ag_node is None
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_matrix_grad():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(5, 4).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, num_hidden=5, no_bias=True)
+        loss = y.sum()
+    loss.backward()
+    # d(sum(x W^T))/dW = sum over batch of x
+    expected = np.tile(x.asnumpy().sum(axis=0), (5, 1))
+    np.testing.assert_allclose(w.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_softmax_output_grad():
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    label = nd.array([0, 1, 2, 1])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    sm = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(axis=1, keepdims=True)
+    oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    np.testing.assert_allclose(x.grad.asnumpy(), sm - oh, rtol=1e-4, atol=1e-6)
+
+
+def test_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    grads = autograd.grad([y], [x])
+    np.testing.assert_allclose(grads[0].asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_numeric_gradient_check():
+    """Finite-difference check (the reference's check_numeric_gradient
+    pattern, python/mxnet/test_utils.py:790)."""
+    x_np = np.random.rand(3, 3).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.tanh(x) * x).sum()
+    y.backward()
+    analytic = x.grad.asnumpy()
+    eps = 1e-3
+    numeric = np.zeros_like(x_np)
+    for i in range(3):
+        for j in range(3):
+            xp = x_np.copy(); xp[i, j] += eps
+            xm = x_np.copy(); xm[i, j] -= eps
+            numeric[i, j] = ((np.tanh(xp) * xp).sum() - (np.tanh(xm) * xm).sum()) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)

@@ -1,0 +1,182 @@
+"""NDArray core tests (mirrors reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2, 2), 7).asnumpy(), np.full((2, 2), 7.0))
+
+
+def test_arange():
+    np.testing.assert_allclose(nd.arange(0, 10, 2).asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arith_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a - 1).asnumpy(), [[0, 1], [2, 3]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 / a).asnumpy(), 1.0 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    np.testing.assert_allclose((2 ** a).asnumpy(), 2.0 ** a.asnumpy())
+
+
+def test_comparison_dtypes():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == 2).asnumpy(), [0, 1, 0])
+    assert (a > b).dtype == np.float32
+
+
+def test_inplace_ops():
+    a = nd.ones((3,))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 4)
+    assert a.reshape((-3, 0)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    b = nd.zeros((8, 6))
+    assert b.reshape((-4, 2, -1, 0)).shape == (2, 4, 6)
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3, 0].asnumpy(), [4, 8])
+    idx = nd.array([0, 2], dtype="int32")
+    np.testing.assert_allclose(a[idx].asnumpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1] = 5.0
+    assert a.asnumpy()[1].sum() == 15
+    a[0, 0] = 1.0
+    assert a.asnumpy()[0, 0] == 1
+    a[:] = 2.0
+    assert (a.asnumpy() == 2).all()
+
+
+def test_reduce_methods():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    np.testing.assert_allclose(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    np.testing.assert_allclose(a.mean(axis=1).asnumpy(), [1, 4])
+    assert a.max().asscalar() == 5
+    assert a.argmax(axis=1).asnumpy().tolist() == [2, 2]
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy()
+        if False else nd.dot(a, nd.array(b.asnumpy().T), transpose_b=True).asnumpy(),
+        a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_astype_copy():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0, 0] = 9
+    assert a.asnumpy()[0, 0] == 1
+
+
+def test_context_placement():
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    assert a.context == mx.cpu(0)
+    b = a.as_in_context(mx.tpu(0))
+    assert b.context == mx.tpu(0)
+    np.testing.assert_allclose(b.asnumpy(), a.asnumpy())
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    data = {"w": nd.ones((2, 3)), "b": nd.zeros((3,))}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), np.ones((2, 3)))
+    lst = [nd.ones((2,)), nd.zeros((1,))]
+    nd.save(fname, lst)
+    loaded_list = nd.load(fname)
+    assert isinstance(loaded_list, list) and len(loaded_list) == 2
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=1)
+    assert c.shape == (2, 6)
+    parts = nd.split(c, num_outputs=2, axis=1)
+    np.testing.assert_allclose(parts[0].asnumpy(), a.asnumpy())
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_wait_and_waitall():
+    a = nd.ones((4, 4))
+    b = (a * 2).wait_to_read()
+    nd.waitall()
+    np.testing.assert_allclose(b.asnumpy(), 2 * np.ones((4, 4)))
+
+
+def test_generated_namespace():
+    a = nd.array([-1.0, 2.0])
+    np.testing.assert_allclose(nd.relu(a).asnumpy(), [0, 2])
+    np.testing.assert_allclose(nd.abs(a).asnumpy(), [1, 2])
+    assert hasattr(nd._internal, "_plus_scalar")
+    out = nd._internal._plus_scalar(a, scalar=1.0)
+    np.testing.assert_allclose(out.asnumpy(), [0, 3])
+
+
+def test_out_kwarg():
+    a = nd.array([1.0, 2.0])
+    o = nd.zeros((2,))
+    nd.relu(a, out=o)
+    np.testing.assert_allclose(o.asnumpy(), [1, 2])
+
+
+def test_random_seed_determinism():
+    mx.seed(42)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.seed(42)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = nd.random.uniform(shape=(5,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_random_moments():
+    mx.seed(0)
+    u = nd.random.uniform(0, 1, shape=(10000,)).asnumpy()
+    assert 0.45 < u.mean() < 0.55
+    n = nd.random.normal(0, 1, shape=(10000,)).asnumpy()
+    assert abs(n.mean()) < 0.05
+    assert 0.9 < n.std() < 1.1

@@ -1,0 +1,296 @@
+"""Operator tests (mirrors reference tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_fully_connected():
+    x = nd.array(np.random.rand(4, 10).astype(np.float32))
+    w = nd.array(np.random.rand(6, 10).astype(np.float32))
+    b = nd.array(np.random.rand(6).astype(np.float32))
+    out = nd.FullyConnected(x, w, b, num_hidden=6)
+    ref = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    out2 = nd.FullyConnected(x, w, num_hidden=6, no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(), x.asnumpy() @ w.asnumpy().T, rtol=1e-5)
+
+
+def test_fully_connected_4d_flatten():
+    x = nd.array(np.random.rand(2, 3, 4, 5).astype(np.float32))
+    w = nd.array(np.random.rand(7, 60).astype(np.float32))
+    out = nd.FullyConnected(x, w, num_hidden=7, no_bias=True)
+    assert out.shape == (2, 7)
+
+
+def test_convolution_identity():
+    # 1x1 kernel with identity weights reproduces input channels
+    x = nd.array(np.random.rand(1, 3, 5, 5).astype(np.float32))
+    w = nd.array(np.eye(3, dtype=np.float32).reshape(3, 3, 1, 1))
+    out = nd.Convolution(x, w, kernel=(1, 1), num_filter=3, no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-5)
+
+
+def test_convolution_vs_scipy():
+    from scipy import signal
+    x_np = np.random.rand(1, 1, 7, 7).astype(np.float32)
+    w_np = np.random.rand(1, 1, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x_np), nd.array(w_np), kernel=(3, 3),
+                         num_filter=1, no_bias=True)
+    ref = signal.correlate2d(x_np[0, 0], w_np[0, 0], mode="valid")
+    np.testing.assert_allclose(out.asnumpy()[0, 0], ref, rtol=1e-4)
+
+
+def test_convolution_stride_pad_group():
+    x = nd.array(np.random.rand(2, 4, 8, 8).astype(np.float32))
+    w = nd.array(np.random.rand(6, 2, 3, 3).astype(np.float32))
+    out = nd.Convolution(x, w, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         num_filter=6, num_group=2, no_bias=True)
+    assert out.shape == (2, 6, 4, 4)
+
+
+def test_deconvolution_shape():
+    x = nd.array(np.random.rand(1, 4, 5, 5).astype(np.float32))
+    w = nd.array(np.random.rand(4, 3, 4, 4).astype(np.float32))
+    out = nd.Deconvolution(x, w, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                           num_filter=3)
+    assert out.shape == (1, 3, 10, 10)
+
+
+def test_deconv_is_conv_transpose():
+    # deconv(conv gradient identity): compare against jax reference via autograd
+    x_np = np.random.rand(1, 2, 6, 6).astype(np.float32)
+    w_np = np.random.rand(2, 3, 3, 3).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x_np), nd.array(w_np), kernel=(3, 3),
+                           num_filter=3)
+    assert out.shape == (1, 3, 8, 8)
+    # sum equals sum(x) * sum(w) channel-mixed: check via explicit loop on one pixel
+    total = out.asnumpy().sum()
+    ref_total = 0.0
+    for ic in range(2):
+        ref_total += x_np[0, ic].sum() * w_np[ic].sum()
+    np.testing.assert_allclose(total, ref_total, rtol=1e-4)
+
+
+def test_pooling_max_avg():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    np.testing.assert_allclose(mp.asnumpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    np.testing.assert_allclose(ap.asnumpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    gp = nd.Pooling(x, global_pool=True, pool_type="max", kernel=(1, 1))
+    assert gp.asnumpy().reshape(()) == 15
+
+
+def test_pooling_full_convention():
+    x = nd.array(np.random.rand(1, 1, 5, 5).astype(np.float32))
+    out_valid = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out_valid.shape == (1, 1, 2, 2)
+    out_full = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                          pooling_convention="full")
+    assert out_full.shape == (1, 1, 3, 3)
+
+
+def test_batchnorm_inference():
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32))
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mean = nd.zeros((3,))
+    var = nd.ones((3,))
+    out = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False, eps=0.0)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-5)
+
+
+def test_batchnorm_training_stats():
+    x_np = np.random.rand(8, 3, 4, 4).astype(np.float32)
+    x = nd.array(x_np)
+    out = nd.invoke_op("BatchNorm", [x, nd.ones((3,)), nd.zeros((3,)),
+                                     nd.zeros((3,)), nd.ones((3,))],
+                       {"train_mode": True, "fix_gamma": False, "eps": 1e-5,
+                        "output_mean_var": True})
+    o = out[0].asnumpy()
+    # normalized output has ~zero mean, ~unit var per channel
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(o.var(axis=(0, 2, 3)), np.ones(3), rtol=1e-2)
+
+
+def test_layernorm():
+    x = nd.array(np.random.rand(4, 10).astype(np.float32))
+    out = nd.LayerNorm(x, nd.ones((10,)), nd.zeros((10,)))
+    o = out.asnumpy()
+    assert abs(o.mean(axis=-1)).max() < 1e-5
+
+
+def test_softmax_logsoftmax():
+    x = nd.array(np.random.rand(3, 5).astype(np.float32))
+    s = nd.softmax(x).asnumpy()
+    np.testing.assert_allclose(s.sum(axis=-1), np.ones(3), rtol=1e-5)
+    ls = nd.log_softmax(x).asnumpy()
+    np.testing.assert_allclose(np.exp(ls), s, rtol=1e-5)
+
+
+def test_activation_types():
+    x = nd.array(np.array([-2.0, 0.0, 2.0], dtype=np.float32))
+    np.testing.assert_allclose(nd.Activation(x, act_type="relu").asnumpy(), [0, 0, 2])
+    np.testing.assert_allclose(nd.Activation(x, act_type="tanh").asnumpy(),
+                               np.tanh(x.asnumpy()), rtol=1e-5)
+    np.testing.assert_allclose(nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
+                               [-0.2, 0, 2], rtol=1e-5)
+    elu = nd.LeakyReLU(x, act_type="elu", slope=1.0).asnumpy()
+    np.testing.assert_allclose(elu, [np.expm1(-2.0), 0, 2], rtol=1e-5)
+
+
+def test_embedding_take():
+    w = nd.array(np.random.rand(10, 4).astype(np.float32))
+    idx = nd.array([1, 3, 1], dtype="int32")
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[1, 3, 1]])
+    t = nd.take(w, idx, axis=0)
+    np.testing.assert_allclose(t.asnumpy(), w.asnumpy()[[1, 3, 1]])
+
+
+def test_one_hot_pick():
+    idx = nd.array([0, 2], dtype="int32")
+    oh = nd.one_hot(idx, depth=3)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    x = nd.array([[0.1, 0.2, 0.7], [0.5, 0.3, 0.2]])
+    p = nd.pick(x, nd.array([2, 0]), axis=1)
+    np.testing.assert_allclose(p.asnumpy(), [0.7, 0.5], rtol=1e-6)
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2]])
+    i = nd.topk(x, k=2)
+    np.testing.assert_allclose(i.asnumpy(), [[0, 2]])
+    s = nd.sort(x, is_ascend=False)
+    np.testing.assert_allclose(s.asnumpy(), [[3, 2, 1]])
+
+
+def test_sequence_mask():
+    data = nd.ones((4, 2, 3))  # (T, N, C)
+    lens = nd.array([2, 3])
+    out = nd.SequenceMask(data, lens, use_sequence_length=True, value=0.0)
+    o = out.asnumpy()
+    assert o[:2, 0].sum() == 6 and o[2:, 0].sum() == 0
+    assert o[:3, 1].sum() == 9 and o[3:, 1].sum() == 0
+
+
+def test_sequence_last_reverse():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+    lens = nd.array([1, 3])
+    last = nd.SequenceLast(data, lens, use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy()[0], data.asnumpy()[0, 0])
+    np.testing.assert_allclose(last.asnumpy()[1], data.asnumpy()[2, 1])
+    rev = nd.SequenceReverse(data, lens, use_sequence_length=True)
+    np.testing.assert_allclose(rev.asnumpy()[0, 1], data.asnumpy()[2, 1])
+
+
+def test_rnn_lstm_shapes():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    psize = rnn_param_size(L, I, H, False, "lstm")
+    params = nd.random.uniform(-0.1, 0.1, shape=(psize,))
+    state = nd.zeros((L, N, H))
+    cell = nd.zeros((L, N, H))
+    x = nd.random.uniform(shape=(T, N, I))
+    out = nd.RNN(x, params, state, cell, state_size=H, num_layers=L,
+                 mode="lstm", state_outputs=True)
+    assert out[0].shape == (T, N, H)
+    assert out[1].shape == (L, N, H)
+    assert out[2].shape == (L, N, H)
+
+
+def test_rnn_gru_bidirectional():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H = 4, 2, 3, 5
+    psize = rnn_param_size(1, I, H, True, "gru")
+    params = nd.random.uniform(-0.1, 0.1, shape=(psize,))
+    state = nd.zeros((2, N, H))
+    x = nd.random.uniform(shape=(T, N, I))
+    out = nd.RNN(x, params, state, state_size=H, num_layers=1,
+                 bidirectional=True, mode="gru")
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_optimizer_sgd_update():
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    nd.sgd_update(w, g, lr=0.1, wd=0.0)
+    np.testing.assert_allclose(w.asnumpy(), [0.9, 0.9, 0.9], rtol=1e-6)
+
+
+def test_optimizer_adam_update():
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    m = nd.zeros((3,))
+    v = nd.zeros((3,))
+    nd.adam_update(w, g, m, v, lr=0.1)
+    assert (w.asnumpy() < 1.0).all()
+    assert (m.asnumpy() > 0).all()
+
+
+def test_where_clip():
+    c = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([-1.0, -2.0, -3.0])
+    np.testing.assert_allclose(nd.where(c, x, y).asnumpy(), [1, -2, 3])
+    np.testing.assert_allclose(nd.clip(x, 1.5, 2.5).asnumpy(), [1.5, 2, 2.5])
+
+
+def test_gather_scatter_nd():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array([[0, 2], [1, 3]], dtype="int32")
+    out = nd.gather_nd(data, idx)
+    np.testing.assert_allclose(out.asnumpy(), [1, 11])
+    s = nd.scatter_nd(out, idx, shape=(3, 4))
+    assert s.asnumpy()[0, 1] == 1 and s.asnumpy()[2, 3] == 11
+
+
+def test_cast_storage_dtype():
+    x = nd.array([1.5, 2.5])
+    assert nd.Cast(x, dtype="int32").dtype == np.int32
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # not training: identity
+    out = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    # train_mode attr on: roughly half dropped, scaled
+    out2 = nd.invoke_op("Dropout", [x], {"p": 0.5, "train_mode": True})
+    o = out2.asnumpy()
+    frac = (o == 0).mean()
+    assert 0.4 < frac < 0.6
+    np.testing.assert_allclose(o[o != 0], 2.0)
+
+
+def test_smooth_l1():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    out = nd.smooth_l1(x, scalar=1.0).asnumpy()
+    np.testing.assert_allclose(out, [1.5, 0.125, 0.125, 1.5], rtol=1e-6)
+
+
+def test_lrn_shape():
+    x = nd.random.uniform(shape=(2, 8, 4, 4))
+    out = nd.LRN(x, nsize=5)
+    assert out.shape == (2, 8, 4, 4)
+
+
+def test_upsampling():
+    x = nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, :2, :2],
+                               [[0, 0], [0, 1]] if False else [[0, 0], [0, 0]])
+
+
+def test_broadcast_ops_family():
+    a = nd.array([[1.0], [2.0]])
+    b = nd.array([[3.0, 4.0]])
+    np.testing.assert_allclose(nd.broadcast_mul(a, b).asnumpy(), [[3, 4], [6, 8]])
+    np.testing.assert_allclose(nd.broadcast_maximum(a, b).asnumpy(), [[3, 4], [3, 4]])
+    np.testing.assert_allclose(nd.broadcast_to(a, shape=(2, 3)).asnumpy(),
+                               np.broadcast_to(a.asnumpy(), (2, 3)))
