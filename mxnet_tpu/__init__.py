@@ -43,7 +43,14 @@ def __getattr__(name):
         globals()["kv"] = mod
         return mod
     if name in _LAZY:
-        mod = importlib.import_module("." + name, __name__)
+        try:
+            mod = importlib.import_module("." + name, __name__)
+        except ModuleNotFoundError as e:
+            if e.name == __name__ + "." + name:
+                raise AttributeError(
+                    "mxnet_tpu.%s is not available in this build" % name
+                ) from None
+            raise
         globals()[name] = mod
         return mod
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -207,9 +207,10 @@ def _topo_entries(head_nodes):
     return order
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
-    """Compute gradients of heads w.r.t. marked variables
-    (reference: Imperative::Backward, src/imperative/imperative.cc:270)."""
+def _run_backward(heads, head_grads=None):
+    """Walk the tape in reverse, returning (grad_map keyed by id(node),
+    leaf_nodes dict). Pure with respect to NDArray state — callers decide
+    whether to write results into ``.grad`` slots."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
 
@@ -265,14 +266,45 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         with_key = entry.key is not None
         inputs = ((entry.key,) + entry.input_values) if with_key \
             else entry.input_values
-        fn = _vjp_fn(entry.op.name, canonical_attrs(entry.attrs), with_key)
-        in_grads = fn(inputs, tuple(cts))
+        from .ops.registry import _REGISTRY
+        if entry.op.name in _REGISTRY:
+            fn = _vjp_fn(entry.op.name, canonical_attrs(entry.attrs), with_key)
+            in_grads = fn(inputs, tuple(cts))
+        else:
+            # synthetic tape entries (e.g. _grad_of_grad for higher-order
+            # autograd) are differentiated directly, uncached
+            import jax as _jax
+
+            def _fwd(*arrs):
+                return _normalize(entry.op.fn(*arrs, **entry.attrs))
+
+            _, _vjp = _jax.vjp(_fwd, *inputs)
+            in_grads = _vjp(tuple(cts))
+            if with_key:
+                in_grads = in_grads[1:]
         for node, g in zip(entry.input_nodes, in_grads):
             if node is None or g is None:
                 continue
             if hasattr(g, "dtype") and g.dtype.name == "float0":
                 continue
             add_grad(node, g)
+
+    return grad_map, leaf_nodes
+
+
+def _as_list(x):
+    if x is None or isinstance(x, (list, tuple)):
+        return x
+    return [x]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables and accumulate
+    them into each leaf's ``.grad`` per its ``grad_req``
+    (reference: Imperative::Backward, src/imperative/imperative.cc:270;
+    accepts a single NDArray or a list for both arguments like the
+    reference's _parse_head)."""
+    grad_map, leaf_nodes = _run_backward(_as_list(heads), _as_list(head_grads))
 
     # write accumulated gradients into leaf arrays
     for node in leaf_nodes.values():
@@ -297,18 +329,76 @@ def _normalize(out):
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Functional gradient API (reference: autograd.py grad)."""
+    """Functional gradient API: returns gradients of ``heads`` w.r.t.
+    ``variables`` WITHOUT touching any ``.grad`` buffers or grad_req state
+    (reference: python/mxnet/autograd.py grad). With ``create_graph=True``
+    the returned arrays are recorded so they can be differentiated again
+    (higher-order gradients)."""
     from .ndarray.ndarray import NDArray
-    if create_graph:
-        raise MXNetError("create_graph=True (higher-order grad) pending")
-    saved = [(v.grad, v._grad_req) for v in variables]
-    for v in variables:
+    heads_l = _as_list(heads)
+    head_grads = _as_list(head_grads)
+    vars_single = not isinstance(variables, (list, tuple))
+    vars_l = [variables] if vars_single else list(variables)
+    for v in vars_l:
         if v._ag_node is None or not v._ag_node.is_leaf:
-            raise MXNetError("grad requires marked leaf variables")
-        v._ag_node.grad_req = "write"
-    backward(heads, head_grads, retain_graph=bool(retain_graph),
-             train_mode=train_mode)
-    outs = [v.grad for v in variables]
-    for v, (g, req) in zip(variables, saved):
-        pass
-    return outs
+            raise MXNetError("grad requires marked leaf variables "
+                             "(call attach_grad / mark_variables first)")
+    if create_graph:
+        return _grad_create_graph(heads_l, vars_l, head_grads, vars_single)
+    grad_map, _ = _run_backward(heads_l, head_grads)
+    outs = []
+    for v in vars_l:
+        g = grad_map.get(id(v._ag_node))
+        if g is None:
+            raise MXNetError(
+                "one of the variables does not participate in the "
+                "computation of the heads (reference: autograd.grad)")
+        outs.append(NDArray(g, ctx=v.context))
+    return outs[0] if vars_single else outs
+
+
+def _grad_create_graph(heads, variables, head_grads, single):
+    """Higher-order grad: symbolically replay the tape as a pure function
+    of the leaf variables' values and take ``jax.vjp``. The whole
+    grads-from-variables computation is one pure function ``grad_fn``; it
+    is evaluated eagerly for the returned values and, when recording,
+    appended to the tape as a single synthetic entry — so a further
+    ``backward`` on the result differentiates *through* grad_fn
+    (vjp-of-vjp), giving d²y/dx²."""
+    from .ndarray.ndarray import NDArray
+    import jax
+    import jax.numpy as jnp
+
+    entries = _topo_entries([h._ag_node for h in heads])
+    var_nodes = [v._ag_node for v in variables]
+    head_nodes = [h._ag_node for h in heads]
+    ct_vals = None if head_grads is None else tuple(
+        hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        for hg in head_grads)
+
+    def grad_fn(*var_vals, **_attrs):
+        def replay(vv):
+            env = {id(n): val for n, val in zip(var_nodes, vv)}
+            for e in entries:
+                ins = [env.get(id(n), recorded) if n is not None else recorded
+                       for n, recorded in zip(e.input_nodes, e.input_values)]
+                if e.key is not None:
+                    ins = [e.key] + ins
+                outs = _normalize(e.op.fn(*ins, **e.attrs))
+                for i, onode in enumerate(e.output_nodes):
+                    env[id(onode)] = outs[i]
+            return tuple(env[id(n)] for n in head_nodes)
+
+        out_vals, vjp = jax.vjp(replay, tuple(var_vals))
+        cts = ct_vals if ct_vals is not None else tuple(
+            jnp.ones(o.shape, o.dtype) for o in out_vals)
+        (grads,) = vjp(cts)
+        return tuple(grads)
+
+    grads = grad_fn(*(v._data for v in variables))
+    outs = [NDArray(g, ctx=v.context) for v, g in zip(variables, grads)]
+    if is_recording():
+        from .ops.registry import OpDef
+        op = OpDef("_grad_of_grad", grad_fn, num_outputs=len(outs))
+        record_op(op, {}, list(variables), outs, key=None)
+    return outs[0] if single else outs

@@ -116,6 +116,8 @@ class NDArray:
 
     # -- dtype / device movement ------------------------------------------
     def astype(self, dtype, copy=True):
+        if not copy and self.dtype == np_dtype(dtype):
+            return self
         return invoke_op("Cast", [self], {"dtype": np_dtype(dtype).name})
 
     def copy(self):
@@ -443,12 +445,22 @@ def invoke_op(name, inputs, attrs, out=None):
     semantics for mutating ops; 6. wraps outputs.
     """
     op = _reg.get_op(name)
+    from .. import autograd
+
+    # Thread the runtime train/predict mode into ops that declare a
+    # ``train_mode`` attr (Dropout, BatchNorm, RNN) unless the caller passed
+    # one explicitly — the analog of the reference's thread-local
+    # ``is_training_`` flag (include/mxnet/imperative.h:148-153).
+    if "train_mode" in op.attr_defaults and (attrs is None
+                                             or "train_mode" not in attrs):
+        attrs = dict(attrs or {})
+        attrs["train_mode"] = autograd.is_training()
+
     arrays = [x._data if isinstance(x, NDArray) else x for x in inputs]
     key = None
     if op.needs_rng:
         key = _random.next_key()
         arrays = [key] + arrays
-    raw_out = _reg.invoke_raw(op, arrays, attrs)
 
     ctx = None
     for x in inputs:
@@ -458,6 +470,15 @@ def invoke_op(name, inputs, attrs, out=None):
     if ctx is None:
         ctx = current_context()
 
+    raw_out = _reg.invoke_raw(op, arrays, attrs)
+    if not any(isinstance(x, NDArray) for x in inputs):
+        # creation ops: honor the claimed context's device (the reference
+        # allocates on ctx; JAX would otherwise use the default device)
+        dev = ctx.jax_device()
+        if any(getattr(o, "device", None) != dev for o in raw_out):
+            import jax
+            raw_out = tuple(jax.device_put(o, dev) for o in raw_out)
+
     if op.mutate_inputs:
         for out_i, in_i in enumerate(op.mutate_inputs):
             tgt = inputs[in_i]
@@ -466,7 +487,6 @@ def invoke_op(name, inputs, attrs, out=None):
 
     outputs = tuple(NDArray(o, ctx=ctx) for o in raw_out)
 
-    from .. import autograd
     if autograd.is_recording() and op.differentiable:
         autograd.record_op(op, attrs, inputs, outputs, key=key)
 
@@ -551,9 +571,13 @@ def _as_shape(shape):
 
 
 def waitall():
-    """Block until all launched work completes (reference: MXNDArrayWaitAll).
-    PjRt runs ops in dispatch order per device, so syncing a trivial new
-    computation would not cover in-flight donated buffers; instead JAX
-    exposes this directly."""
+    """Block until all launched work completes (reference: MXNDArrayWaitAll,
+    engine WaitForAll). Blocks on every live jax.Array — the PjRt analog of
+    draining the dependency engine — then on any pending effects. Surfaces
+    deferred device errors at this sync point, matching the reference's
+    exception-propagation-to-sync contract
+    (src/engine/threaded_engine.cc:474-476)."""
     import jax
+    for arr in jax.live_arrays():
+        arr.block_until_ready()
     jax.effects_barrier()

@@ -204,7 +204,7 @@ def _ones_like(x):
 
 @register("shape_array", differentiable=False)
 def _shape_array(x):
-    return jnp.asarray(x.shape, dtype=jnp.int64 if False else jnp.int32)
+    return jnp.asarray(x.shape, dtype=jnp.int32)
 
 
 @register("size_array", differentiable=False)

@@ -22,24 +22,30 @@ from ..base import np_dtype, MXNetError
 # ---------------------------------------------------------------------------
 
 def infer_reshape(src_shape, target, reverse=False):
-    src = list(src_shape)
+    # Parse the target into groups first so ``reverse`` keeps each
+    # (-4, d1, d2) triple intact (reference InferReshapeShape reverses the
+    # dims and re-infers right-to-left).
     tgt = list(target)
+    groups = []
+    i = 0
+    while i < len(tgt):
+        if tgt[i] == -4:
+            if i + 2 >= len(tgt):
+                raise MXNetError("reshape: -4 needs two following entries")
+            groups.append((tgt[i], tgt[i + 1], tgt[i + 2]))
+            i += 3
+        else:
+            groups.append((tgt[i],))
+            i += 1
+    src = list(src_shape)
     if reverse:
         src = src[::-1]
-        tgt_rev = []
-        # reverse while keeping -4's two successor entries attached in order
-        i = len(tgt) - 1
-        parts = []
-        while i >= 0:
-            parts.append(tgt[i])
-            i -= 1
-        tgt = parts
+        groups = groups[::-1]
     out = []
     src_i = 0
     infer_idx = -1
-    i = 0
-    while i < len(tgt):
-        t = tgt[i]
+    for g in groups:
+        t = g[0]
         if t > 0:
             out.append(t)
             src_i += 1
@@ -60,10 +66,16 @@ def infer_reshape(src_shape, target, reverse=False):
         elif t == -3:
             if src_i + 1 >= len(src):
                 raise MXNetError("reshape: -3 needs two source dims")
-            out.append(src[src_i] * src_i_next(src, src_i))
+            out.append(src[src_i] * src[src_i + 1])
             src_i += 2
         elif t == -4:
-            d1, d2 = tgt[i + 1], tgt[i + 2]
+            if src_i >= len(src):
+                raise MXNetError("reshape: -4 out of source dims")
+            d1, d2 = g[1], g[2]
+            if reverse:
+                # in reversed coordinates the split pair appears swapped so
+                # that un-reversing restores (d1, d2) order
+                d1, d2 = d2, d1
             d = src[src_i]
             if d1 == -1 and d2 == -1:
                 raise MXNetError("reshape: -4 with two -1s")
@@ -73,10 +85,8 @@ def infer_reshape(src_shape, target, reverse=False):
                 d2 = d // d1
             out.extend([d1, d2])
             src_i += 1
-            i += 2
         else:
             raise MXNetError("reshape: invalid code %d" % t)
-        i += 1
     total = 1
     for s in src_shape:
         total *= s
@@ -89,10 +99,6 @@ def infer_reshape(src_shape, target, reverse=False):
     if reverse:
         out = out[::-1]
     return tuple(out)
-
-
-def src_i_next(src, i):
-    return src[i + 1]
 
 
 @register("Reshape", attr_defaults={"shape": None, "reverse": False})

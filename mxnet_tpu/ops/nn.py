@@ -525,44 +525,56 @@ def _gates(mode):
     return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
 
 
-def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
-    """Total flat parameter count, cuDNN layout (W, R, bW, bR per layer/dir)."""
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode,
+                   projection_size=None):
+    """Total flat parameter count, cuDNN layout (W, R, bW, bR per layer/dir;
+    LSTMP adds a recurrent projection matrix P per layer/dir)."""
     g = _gates(mode)
     dirs = 2 if bidirectional else 1
+    hout = projection_size if projection_size else state_size
     size = 0
     for layer in range(num_layers):
-        isz = input_size if layer == 0 else state_size * dirs
-        size += dirs * g * state_size * (isz + state_size + 2)
+        isz = input_size if layer == 0 else hout * dirs
+        size += dirs * g * state_size * (isz + hout + 2)
+        if projection_size:
+            size += dirs * projection_size * state_size
     return size
 
 
 def _unpack_rnn_params(params, num_layers, input_size, state_size,
-                      bidirectional, mode):
+                       bidirectional, mode, projection_size=None):
     g = _gates(mode)
     dirs = 2 if bidirectional else 1
+    hout = projection_size if projection_size else state_size
     offset = 0
     layers = []
     for layer in range(num_layers):
-        isz = input_size if layer == 0 else state_size * dirs
+        isz = input_size if layer == 0 else hout * dirs
         per_dir = []
         for _ in range(dirs):
             W = params[offset: offset + g * state_size * isz].reshape(
                 g * state_size, isz)
             offset += g * state_size * isz
-            R = params[offset: offset + g * state_size * state_size].reshape(
-                g * state_size, state_size)
-            offset += g * state_size * state_size
+            R = params[offset: offset + g * state_size * hout].reshape(
+                g * state_size, hout)
+            offset += g * state_size * hout
             bW = params[offset: offset + g * state_size]
             offset += g * state_size
             bR = params[offset: offset + g * state_size]
             offset += g * state_size
-            per_dir.append((W, R, bW, bR))
+            if projection_size:
+                P = params[offset: offset + projection_size * state_size].reshape(
+                    projection_size, state_size)
+                offset += projection_size * state_size
+            else:
+                P = None
+            per_dir.append((W, R, bW, bR, P))
         layers.append(per_dir)
     return layers
 
 
-def _cell_step(mode, H):
-    def step(carry, x_t, W, R, bW, bR):
+def _cell_step(mode):
+    def step(carry, x_t, W, R, bW, bR, P=None):
         if mode == "lstm":
             h, c = carry
             z = x_t @ W.T + h @ R.T + bW + bR
@@ -571,6 +583,8 @@ def _cell_step(mode, H):
             g = jnp.tanh(g)
             c = f * c + i * g
             h = o * jnp.tanh(c)
+            if P is not None:  # LSTMP recurrent projection
+                h = h @ P.T
             return (h, c), h
         if mode == "gru":
             (h,) = carry
@@ -590,35 +604,45 @@ def _cell_step(mode, H):
     return step
 
 
-@register("RNN", num_outputs=_rnn_num_outputs,
+@register("RNN", num_outputs=_rnn_num_outputs, needs_rng=True,
           attr_defaults={"state_size": 0, "num_layers": 1, "bidirectional": False,
                          "mode": "lstm", "p": 0.0, "state_outputs": False,
                          "projection_size": None, "train_mode": False})
-def _rnn(data, params, state, *maybe_cell, state_size=0, num_layers=1,
+def _rnn(key, data, params, state, *maybe_cell, state_size=0, num_layers=1,
          bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
-         **_ignored):
+         projection_size=None, train_mode=False, **_ignored):
     """Fused multilayer RNN over time via lax.scan (sequence layout TNC,
-    matching the reference's RNN op). Each timestep is a single MXU matmul
-    per direction; XLA unrolls nothing — the scan keeps compile time flat
-    for long sequences."""
+    matching the reference's RNN op, src/operator/rnn.cc). Each timestep is
+    a single MXU matmul per direction; the scan keeps compile time flat for
+    long sequences. Inter-layer dropout ``p`` (cuDNN semantics: applied to
+    the input of layers 1..L-1, training only) and LSTMP ``projection_size``
+    are honored."""
     T, N, I = data.shape
     H = state_size
     dirs = 2 if bidirectional else 1
+    if projection_size and mode != "lstm":
+        raise MXNetError("projection_size is only supported for lstm")
     cell = maybe_cell[0] if (mode == "lstm" and maybe_cell) else None
-    layers = _unpack_rnn_params(params, num_layers, I, H, bidirectional, mode)
-    step = _cell_step(mode, H)
+    layers = _unpack_rnn_params(params, num_layers, I, H, bidirectional, mode,
+                                projection_size)
+    step = _cell_step(mode)
 
     x = data
     h_states, c_states = [], []
     for li, per_dir in enumerate(layers):
+        if li > 0 and p > 0.0 and train_mode:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype) / keep
+            x = x * mask
         outs = []
-        for di, (W, R, bW, bR) in enumerate(per_dir):
+        for di, (W, R, bW, bR, P) in enumerate(per_dir):
             h0 = state[li * dirs + di]
             carry = (h0, cell[li * dirs + di]) if mode == "lstm" else (h0,)
             xs = jnp.flip(x, axis=0) if di == 1 else x
 
-            def scan_fn(c, x_t, W=W, R=R, bW=bW, bR=bR):
-                return step(c, x_t, W, R, bW, bR)
+            def scan_fn(c, x_t, W=W, R=R, bW=bW, bR=bR, P=P):
+                return step(c, x_t, W, R, bW, bR, P)
 
             carry, ys = lax.scan(scan_fn, carry, xs)
             if di == 1:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import register, alias
 from ..base import np_dtype
 
 
@@ -88,17 +88,24 @@ def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32",
     batch = data.shape[:-1]
     draws = jax.random.categorical(key, logits, axis=-1,
                                    shape=_shape(shape) + batch if shape else batch)
-    # moveaxis so batch dims lead, sample dims trail (MXNet convention)
-    if shape:
-        k = len(_shape(shape))
-        draws = jnp.moveaxis(draws, tuple(range(k)),
-                             tuple(range(draws.ndim - k, draws.ndim)))
-    out = draws.astype(np_dtype(dtype))
+    # gather log-probs while sample dims still lead: logp (batch, m)
+    # broadcasts against draws (sample + batch) by trailing alignment
+    gathered = None
     if get_prob:
         logp = jax.nn.log_softmax(logits, axis=-1)
         gathered = jnp.take_along_axis(
             jnp.broadcast_to(logp, draws.shape + (data.shape[-1],)),
             draws[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    # moveaxis so batch dims lead, sample dims trail (MXNet convention)
+    if shape:
+        k = len(_shape(shape))
+        perm = tuple(range(k))
+        dst = tuple(range(draws.ndim - k, draws.ndim))
+        draws = jnp.moveaxis(draws, perm, dst)
+        if gathered is not None:
+            gathered = jnp.moveaxis(gathered, perm, dst)
+    out = draws.astype(np_dtype(dtype))
+    if get_prob:
         return out, gathered
     return out
 
@@ -114,3 +121,19 @@ def _sample_unique_zipfian(key, range_max=1, shape=(), **_ig):
     u = jax.random.uniform(key, _shape(shape))
     out = jnp.expm1(u * jnp.log1p(float(range_max) - 1.0)).astype(jnp.int64)
     return jnp.clip(out, 0, range_max - 1).astype(jnp.int32)
+
+
+# Public legacy aliases (reference registers these as public op names:
+# src/operator/random/sample_op.cc "random_uniform"/"uniform" etc. and
+# multinomial as "sample_multinomial").
+alias("random_uniform", "_random_uniform")
+alias("uniform", "_random_uniform")
+alias("random_normal", "_random_normal")
+alias("normal", "_random_normal")
+alias("random_gamma", "_random_gamma")
+alias("random_exponential", "_random_exponential")
+alias("random_poisson", "_random_poisson")
+alias("random_randint", "_random_randint")
+alias("random_negative_binomial", "_random_negative_binomial")
+alias("sample_multinomial", "_sample_multinomial")
+alias("shuffle", "_shuffle")

@@ -122,20 +122,20 @@ def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
         x, axis = x.reshape(-1), 0
     axis = axis % x.ndim
     xm = jnp.moveaxis(x, axis, -1)
-    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    vals, idx_raw = lax.top_k(-xm if is_ascend else xm, k)
     if is_ascend:
         vals = -vals
+    if ret_typ == "mask":
+        # one-hot over the reduced axis while it is still last, then move back
+        onehots = jnp.sum(jnp.eye(xm.shape[-1], dtype=x.dtype)[idx_raw],
+                          axis=-2)
+        return jnp.moveaxis(onehots, -1, axis)
     vals = jnp.moveaxis(vals, -1, axis)
-    idx = jnp.moveaxis(idx, -1, axis)
+    idx = jnp.moveaxis(idx_raw, -1, axis)
     if ret_typ == "value":
         return vals
     if ret_typ == "indices":
         return idx.astype(np_dtype(dtype))
-    if ret_typ == "mask":
-        mask = jnp.zeros(xm.shape, dtype=x.dtype)
-        mask = mask.at[..., :].set(0)
-        onehots = jnp.sum(jnp.eye(xm.shape[-1], dtype=x.dtype)[idx], axis=-2)
-        return jnp.moveaxis(onehots, -1, axis)
     return vals, idx.astype(np_dtype(dtype))
 
 

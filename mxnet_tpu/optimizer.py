@@ -1,0 +1,630 @@
+"""Optimizer classes driving the fused update operators.
+
+Reference: python/mxnet/optimizer.py:444-1498 (17 optimizers, registry,
+Updater for kvstore-side application). The update math lives in
+mxnet_tpu/ops/optimizer_ops.py as single fused XLA kernels (the analog of
+src/operator/optimizer_op.cc, where "update IS an operator" so the whole
+step is one engine op); these classes own the bookkeeping: lr/wd
+schedules, per-param multipliers, update counts, state creation, and
+multi-precision (bf16/fp16 weights with fp32 master copies).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+import numpy
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray, zeros
+from .ndarray import register as _register_mod  # noqa: F401  (op funcs)
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "Signum", "NAG", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Ftrl", "FTML", "Adamax", "Nadam", "SGLD", "DCASGD",
+           "Test", "Updater", "get_updater", "create", "register"]
+
+
+class Optimizer(object):
+    """Base optimizer (reference: python/mxnet/optimizer.py:444)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """Register a subclass under its lowercased name."""
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("New optimizer %s is overriding existing "
+                            "optimizer %s", klass.__name__, name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create auxiliary state for the given weight. Override."""
+
+    def create_state_multi_precision(self, index, weight):
+        """Low-precision weights get an fp32 master copy when
+        multi_precision is on; state layout is (state, weight32)."""
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight_master_copy = weight.astype(numpy.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        if weight.dtype == numpy.float16 and not self.multi_precision:
+            logging.warning("Accumulating with float16 in optimizer can lead "
+                            "to poor accuracy or slow convergence. Consider "
+                            "using multi_precision=True option.")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        """Update the weight given gradient and state. Override."""
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight_master_copy = state[1]
+            grad32 = grad.astype(numpy.float32)
+            self.update(index, weight_master_copy, grad32, state[0])
+            weight._set_data(weight_master_copy.astype(weight.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        """Set individual learning-rate multipliers for parameters."""
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Set individual weight-decay multipliers. By default biases and
+        norm parameters (names not ending in _weight/_gamma) get wd 0."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _common_kwargs(opt, index):
+    kw = {"rescale_grad": opt.rescale_grad}
+    if opt.clip_gradient is not None:
+        kw["clip_gradient"] = opt.clip_gradient
+    return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision
+    (reference: optimizer.py SGD; kernels src/operator/optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            mom, w32 = state
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            kw = _common_kwargs(self, index)
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32, lr=lr, wd=wd,
+                                     momentum=self.momentum, **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, lr=lr, wd=wd, **kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class Signum(Optimizer):
+    """Sign-of-gradient SGD with momentum (reference: optimizer.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.signum_update(weight, grad, state, lr=lr, wd=wd,
+                             momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+        else:
+            nd.signsgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated gradient (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if state is not None:
+            nd.nag_mom_update(weight, grad, state, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py Adam; kernel adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= numpy.sqrt(coef2) / coef1
+        mean, var = state
+        kw = _common_kwargs(self, index)
+        nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        history = state
+        history += grad * grad
+        div = grad / (history.sqrt() + self.float_stable_eps)
+        weight._set_data((weight - lr * (div + weight * wd))._data)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, plain (Tieleman) or centered (Graves)
+    (reference: optimizer.py RMSProp; kernels rmsprop/rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # n
+                    zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # g
+                    zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # delta
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = _common_kwargs(self, index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            nd.rmsprop_update(weight, grad, state, lr=lr, wd=wd,
+                              gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta, lr=lr, wd=wd,
+                                  gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1.0 - self.rho) * grad * grad)._data)
+        current_delta = ((acc_delta + self.epsilon).sqrt()
+                         / (acc_g + self.epsilon).sqrt()) * grad
+        acc_delta._set_data(
+            (self.rho * acc_delta
+             + (1.0 - self.rho) * current_delta * current_delta)._data)
+        weight._set_data((weight - current_delta - wd * weight)._data)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: optimizer.py Ftrl; kernel ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # z
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        kw = _common_kwargs(self, index)
+        nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
+                       beta=self.beta, **kw)
+
+
+@register
+class FTML(Optimizer):
+    """FTML (reference: optimizer.py FTML; kernel ftml_update)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # d
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # v
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_grad"] = self.clip_gradient
+        nd.ftml_update(weight, grad, d, v, z, lr=lr, wd=wd, beta1=self.beta1,
+                       beta2=self.beta2, epsilon=self.epsilon, t=t, **kw)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax, Adam with infinity norm (reference: optimizer.py Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t._set_data((self.beta1 * m_t + (1.0 - self.beta1) * grad)._data)
+        u_t._set_data(nd.broadcast_maximum(self.beta2 * u_t, grad.abs())._data)
+        weight._set_data((weight - lr * m_t / u_t)._data)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._set_data((self.beta1 * m_t + (1.0 - self.beta1) * grad)._data)
+        v_t._set_data((self.beta2 * v_t + (1.0 - self.beta2) * grad * grad)._data)
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._set_data(
+            (weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon))._data)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        from .ndarray import random as _ndrandom
+        noise = _ndrandom.normal(0, numpy.sqrt(lr), shape=weight.shape,
+                                 dtype=weight.dtype, ctx=weight.context)
+        weight._set_data(
+            (weight - lr / 2 * (grad + wd * weight) + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight + self.lamda
+                       * grad * grad * (weight - previous_weight))
+        if mom is not None:
+            mom._set_data((mom * self.momentum + delta)._data)
+            delta = mom
+        previous_weight._set_data(weight._data)
+        weight._set_data((weight + delta)._data)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: simple accumulating SGD (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight - self.lr * grad * self.rescale_grad)._data)
+        state._set_data((state + grad)._data)
+
+
+class Updater(object):
+    """Applies an optimizer to (index, grad, weight) triples — the callable
+    installed on KVStore (reference: optimizer.py Updater / get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(self.states[index],
+                                                         weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(self.sync_state_context(i, context)
+                               for i in state)
+        return state
+
+    def set_states(self, states):
+        """Deserialize updater state (reference: Updater.set_states)."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        states = {}
+        for i, s in self.states.items():
+            states[i] = _to_numpy_state(s)
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def _to_numpy_state(state):
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, (tuple, list)):
+        return type(state)(_to_numpy_state(i) for i in state)
+    return state
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,204 @@
+"""Regression tests for round-1 VERDICT/ADVICE findings.
+
+Covers: train-mode threading into ops (reference thread-local is_training_,
+include/mxnet/imperative.h:148-153), side-effect-free autograd.grad,
+higher-order grad, multinomial get_prob, reshape reverse codes, RNN dropout
+/ projection, topk mask on a non-last axis.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------------------
+# train-mode wiring
+# ---------------------------------------------------------------------------
+
+def test_dropout_drops_under_record():
+    x = nd.ones((200, 200))
+    with ag.record():
+        y = nd.Dropout(x, p=0.5)
+    ynp = y.asnumpy()
+    assert (ynp == 0).mean() > 0.3  # roughly half dropped
+    assert np.allclose(ynp[ynp != 0], 2.0)  # inverted scaling
+
+
+def test_dropout_identity_in_predict():
+    x = nd.ones((50, 50))
+    y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), 1.0)
+    with ag.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert np.allclose(y2.asnumpy(), 1.0)
+
+
+def test_dropout_mode_always():
+    x = nd.ones((100, 100))
+    y = nd.Dropout(x, p=0.5, mode="always")
+    assert (y.asnumpy() == 0).mean() > 0.3
+
+
+def test_batchnorm_uses_batch_stats_in_train():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(32, 4) * 5 + 3)
+    gamma = nd.ones((4,))
+    beta = nd.zeros((4,))
+    mean = nd.zeros((4,))
+    var = nd.ones((4,))
+    with ag.record():
+        y = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    ynp = y.asnumpy()
+    # batch stats -> output normalized per-batch
+    assert np.allclose(ynp.mean(axis=0), 0.0, atol=1e-4)
+    assert np.allclose(ynp.std(axis=0), 1.0, atol=1e-2)
+    # predict mode -> moving stats (zeros/ones) leave data unnormalized
+    y2 = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    assert np.allclose(y2.asnumpy(), x.asnumpy(), atol=1e-2)
+
+
+def test_train_mode_scope_without_record():
+    x = nd.ones((100, 100))
+    with ag.train_mode():
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).mean() > 0.3
+
+
+def test_explicit_train_mode_attr_wins():
+    x = nd.ones((50, 50))
+    with ag.record():
+        y = nd.Dropout(x, p=0.5, train_mode=False)
+    assert np.allclose(y.asnumpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# autograd.grad
+# ---------------------------------------------------------------------------
+
+def test_grad_side_effect_free():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    g_before = x.grad.asnumpy().copy()
+    with ag.record():
+        z = (x * x * x).sum()
+    gz = ag.grad(z, [x])[0]
+    assert np.allclose(gz.asnumpy(), 3 * np.array([1.0, 4.0, 9.0]))
+    # .grad untouched by grad()
+    assert np.allclose(x.grad.asnumpy(), g_before)
+    assert gz is not x.grad
+
+
+def test_grad_unused_variable_raises():
+    x = nd.array([1.0])
+    w = nd.array([2.0])
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = x * 2.0
+    with pytest.raises(mx.MXNetError):
+        ag.grad(y, [w])
+
+
+def test_higher_order_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x * x).sum()  # y = x^3, dy/dx = 3x^2, d2y/dx2 = 6x
+        gx = ag.grad(y, [x], create_graph=True)[0]
+        z = gx.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 6 * np.array([1.0, 2.0, 3.0]))
+
+
+# ---------------------------------------------------------------------------
+# op fixes
+# ---------------------------------------------------------------------------
+
+def test_multinomial_get_prob_with_shape():
+    data = nd.array([[0.2, 0.8], [0.5, 0.5], [0.9, 0.1]])
+    out, logp = nd.sample_multinomial(data, shape=(4,), get_prob=True)
+    assert out.shape == (3, 4)
+    assert logp.shape == (3, 4)
+    o = out.asnumpy().astype(int)
+    expect = np.log(data.asnumpy())
+    got = logp.asnumpy()
+    for i in range(3):
+        for j in range(4):
+            assert np.allclose(got[i, j], expect[i, o[i, j]], atol=1e-5)
+
+
+def test_reshape_reverse_minus4():
+    x = nd.zeros((6, 4))
+    y = x.reshape((-4, -1, 2, 0), reverse=False)
+    assert y.shape == (3, 2, 4)
+    z = x.reshape((-4, -1, 2, 0), reverse=True)
+    # reverse: infer right-to-left; 0 -> 4, (-4,-1,2) splits 6 -> (3, 2)
+    assert z.shape == (3, 2, 4)
+    w = nd.zeros((2, 12)).reshape((0, -4, 3, -1), reverse=False)
+    assert w.shape == (2, 3, 4)
+
+
+def test_reshape_reverse_zero_and_minus1():
+    x = nd.zeros((2, 3, 4))
+    # forward: 0 picks dim0; reverse: rightmost code applies to rightmost dim
+    assert x.reshape((0, -1), reverse=False).shape == (2, 12)
+    assert x.reshape((-1, 0), reverse=True).shape == (6, 4)
+
+
+def test_topk_mask_non_last_axis():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    m = nd.topk(x, axis=0, k=1, ret_typ="mask")
+    expect = np.zeros((3, 4), dtype=np.float32)
+    expect[2, :] = 1.0
+    assert np.allclose(m.asnumpy(), expect)
+
+
+def test_rnn_dropout_and_projection():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H, L, P = 5, 2, 3, 4, 2, 2
+    psize = rnn_param_size(L, I, H, False, "lstm", projection_size=P)
+    params = nd.random_uniform(shape=(psize,), low=-0.1, high=0.1)
+    h0 = nd.zeros((L, N, P))
+    c0 = nd.zeros((L, N, H))
+    x = nd.random_uniform(shape=(T, N, I))
+    out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm",
+                 projection_size=P)
+    assert out.shape == (T, N, P)
+    # dropout between layers changes output in train mode
+    psize2 = rnn_param_size(L, I, H, False, "lstm")
+    params2 = nd.random_uniform(shape=(psize2,), low=-0.5, high=0.5)
+    h02 = nd.zeros((L, N, H))
+    c02 = nd.zeros((L, N, H))
+    base = nd.RNN(x, params2, h02, c02, state_size=H, num_layers=L,
+                  mode="lstm").asnumpy()
+    with ag.train_mode():
+        dropped = nd.RNN(x, params2, h02, c02, state_size=H, num_layers=L,
+                         mode="lstm", p=0.9).asnumpy()
+    assert not np.allclose(base, dropped)
+
+
+def test_astype_copy_false_same_dtype():
+    x = nd.ones((2, 2))
+    assert x.astype("float32", copy=False) is x
+    assert x.astype("float16").dtype == np.float16
+
+
+def test_waitall():
+    x = nd.ones((16, 16))
+    y = x * 2
+    nd.waitall()
+    assert np.allclose(y.asnumpy(), 2.0)
+
+
+def test_creation_op_honors_context_device():
+    import jax
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs multi-device mesh")
+    x = nd.zeros((2, 2), ctx=mx.tpu(1))
+    assert x._data.device == mx.tpu(1).jax_device()
