@@ -376,9 +376,28 @@ def _grad_create_graph(heads, variables, head_grads, single):
         hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
         for hg in head_grads)
 
-    def grad_fn(*var_vals, **_attrs):
+    # grad_fn must be a function of EVERY live leaf feeding the heads (not
+    # just the requested variables), so a later backward on the result can
+    # propagate mixed second derivatives (d²y/dx dw) into other leaves.
+    leaf_map = {}
+    for v, n in zip(variables, var_nodes):
+        leaf_map[id(n)] = (n, v)
+    for e in entries:
+        for n in e.input_nodes:
+            if n is not None and n.is_leaf and id(n) not in leaf_map:
+                arr = n.array_ref() if n.array_ref else None
+                if arr is not None:
+                    leaf_map[id(n)] = (n, arr)
+    leaf_nodes = [n for (n, _a) in leaf_map.values()]
+    leaf_arrays = [a for (_n, a) in leaf_map.values()]
+    n_vars = len(var_nodes)
+
+    def grad_fn(*leaf_vals, **_attrs):
+        env0 = {id(n): val for n, val in zip(leaf_nodes, leaf_vals)}
+
         def replay(vv):
-            env = {id(n): val for n, val in zip(var_nodes, vv)}
+            env = dict(env0)
+            env.update({id(n): val for n, val in zip(var_nodes, vv)})
             for e in entries:
                 ins = [env.get(id(n), recorded) if n is not None else recorded
                        for n, recorded in zip(e.input_nodes, e.input_values)]
@@ -389,16 +408,16 @@ def _grad_create_graph(heads, variables, head_grads, single):
                     env[id(onode)] = outs[i]
             return tuple(env[id(n)] for n in head_nodes)
 
-        out_vals, vjp = jax.vjp(replay, tuple(var_vals))
+        out_vals, vjp = jax.vjp(replay, tuple(leaf_vals[:n_vars]))
         cts = ct_vals if ct_vals is not None else tuple(
             jnp.ones(o.shape, o.dtype) for o in out_vals)
         (grads,) = vjp(cts)
         return tuple(grads)
 
-    grads = grad_fn(*(v._data for v in variables))
+    grads = grad_fn(*(a._data for a in leaf_arrays))
     outs = [NDArray(g, ctx=v.context) for v, g in zip(variables, grads)]
     if is_recording():
         from .ops.registry import OpDef
         op = OpDef("_grad_of_grad", grad_fn, num_outputs=len(outs))
-        record_op(op, {}, list(variables), outs, key=None)
+        record_op(op, {}, list(leaf_arrays), outs, key=None)
     return outs[0] if single else outs

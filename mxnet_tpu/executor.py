@@ -1,0 +1,235 @@
+"""Symbolic executor.
+
+Reference: python/mxnet/executor.py + src/executor/graph_executor.cc.
+
+TPU-native design: binding compiles the whole symbol graph into ONE jitted
+XLA program per (is_train, shape-signature) — the analog of
+GraphExecutor::Init's pass pipeline (InitGraph → InferShape → PlanMemory →
+InitCachedOps, graph_executor.cc:297-673), with XLA doing memory planning
+and op bulking. ``backward`` jits the vjp of the same pure graph function,
+rematerializing the forward (FLOPs-for-HBM, the right TPU default).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros
+from .context import current_context
+from . import random as _random
+from .ops import registry as _reg
+from .symbol.symbol import _graph_eval_fn, _topo
+
+__all__ = ["Executor"]
+
+
+class Executor(object):
+    """Bound computation graph (reference: executor.py Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, dict):
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind missing arguments: %s" % missing)
+            self.arg_arrays = [args[n] for n in arg_names]
+        else:
+            if len(args) != len(arg_names):
+                raise MXNetError("bind expects %d args, got %d"
+                                 % (len(arg_names), len(args)))
+            self.arg_arrays = list(args)
+        self.arg_dict = dict(zip(arg_names, self.arg_arrays))
+
+        if aux_states is None:
+            aux_states = []
+        if isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            self.aux_arrays = list(aux_states)
+        if len(self.aux_arrays) != len(aux_names):
+            raise MXNetError("bind expects %d aux states, got %d"
+                             % (len(aux_names), len(self.aux_arrays)))
+        self.aux_dict = dict(zip(aux_names, self.aux_arrays))
+
+        # grad_req: str | list | dict
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = {n: grad_req.get(n, "null") for n in arg_names}
+        self._grad_req = reqs
+
+        if args_grad is None:
+            self.grad_arrays = [
+                zeros(a.shape, ctx=self._ctx, dtype=a.dtype)
+                if reqs[n] != "null" else None
+                for n, a in zip(arg_names, self.arg_arrays)]
+        elif isinstance(args_grad, dict):
+            self.grad_arrays = [args_grad.get(n) for n in arg_names]
+        else:
+            self.grad_arrays = list(args_grad)
+        self.grad_dict = dict(zip(arg_names, self.grad_arrays))
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._needs_rng = any(
+            (not n.is_var) and _reg.get_op(n.op).needs_rng
+            for n in _topo(symbol._entries))
+        self._jitted = {}
+        self._vjp_jitted = {}
+        self.outputs = []
+        self._monitor_callback = None
+
+    # -- compilation -------------------------------------------------------
+    def _fwd(self, is_train):
+        if is_train not in self._jitted:
+            import jax
+            fn = _graph_eval_fn(self._symbol, is_train)
+            self._jitted[is_train] = jax.jit(fn)
+        return self._jitted[is_train]
+
+    def _vjp(self, grad_names_key):
+        """Jitted (arg_env, fixed_env, key, cotangents) -> grads for the
+        arguments listed in ``grad_names_key``."""
+        if grad_names_key not in self._vjp_jitted:
+            import jax
+            fn = _graph_eval_fn(self._symbol, True)
+            grad_names = list(grad_names_key)
+
+            def run(genv, fenv, key, cts):
+                def fwd(ge):
+                    env = dict(fenv)
+                    env.update(ge)
+                    outs, _aux = fn(env, key)
+                    return outs
+
+                _outs, vjp = jax.vjp(fwd, genv)
+                (gs,) = vjp(tuple(cts))
+                return gs
+
+            self._vjp_jitted[grad_names_key] = jax.jit(run)
+        return self._vjp_jitted[grad_names_key]
+
+    # -- execution ---------------------------------------------------------
+    def _env(self):
+        env = {n: a._data for n, a in zip(self._arg_names, self.arg_arrays)}
+        env.update({n: a._data
+                    for n, a in zip(self._aux_names, self.aux_arrays)})
+        return env
+
+    def forward(self, is_train=False, **kwargs):
+        """Run the compiled forward program
+        (reference: GraphExecutor::RunOps, graph_executor.cc:64,1318)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set_data(v._data)
+            else:
+                import jax.numpy as jnp
+                self.arg_dict[k]._set_data(
+                    jnp.asarray(v, dtype=self.arg_dict[k].dtype))
+        key = _random.next_key() if self._needs_rng else None
+        outs, new_aux = self._fwd(bool(is_train))(self._env(), key)
+        self._last_key = key
+        for name, val in new_aux.items():
+            self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Gradients of outputs w.r.t. bound args, accumulated per
+        grad_req (reference: GraphExecutor backward range run)."""
+        import jax.numpy as jnp
+        outs = self.outputs
+        if not outs:
+            raise MXNetError("call forward(is_train=True) before backward")
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, dtype=o.dtype) for o in outs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        grad_names = tuple(n for n in self._arg_names
+                           if self._grad_req[n] != "null")
+        if not grad_names:
+            return
+        env = self._env()
+        genv = {n: env.pop(n) for n in grad_names}
+        key = getattr(self, "_last_key", None)
+        if self._needs_rng and key is None:
+            key = _random.next_key()
+        gs = self._vjp(grad_names)(genv, env, key, tuple(cts))
+        for n in grad_names:
+            tgt = self.grad_dict[n]
+            if tgt is None:
+                continue
+            if self._grad_req[n] == "add":
+                tgt._set_data(tgt._data + gs[n])
+            else:
+                tgt._set_data(gs[n])
+
+    # -- parameter management ---------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Reference: executor.py copy_params_from."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                dst = self.arg_dict[name]
+                dst._set_data(array.astype(dst.dtype, copy=False)._data
+                              if array.dtype != dst.dtype else array._data)
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the arguments"
+                                 % name)
+        if aux_params is None:
+            return
+        for name, array in aux_params.items():
+            if name in self.aux_dict:
+                dst = self.aux_dict[name]
+                dst._set_data(array._data)
+            elif not allow_extra_params:
+                raise ValueError("Find name %s that is not in the auxiliary "
+                                 "states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes (reference: executor.py reshape).
+        Cheap here: jit re-specializes per shape signature automatically, so
+        only the argument buffers need reallocating."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        for name, shape, old in zip(self._arg_names, arg_shapes,
+                                    self.arg_arrays):
+            if shape == old.shape:
+                new_args.append(old)
+            else:
+                new_args.append(zeros(shape, ctx=self._ctx, dtype=old.dtype))
+        new_aux = []
+        for shape, old in zip(aux_shapes, self.aux_arrays):
+            new_aux.append(old if shape == old.shape
+                           else zeros(shape, ctx=self._ctx, dtype=old.dtype))
+        grad_req = {n: self._grad_req[n] for n in self._arg_names}
+        return Executor(self._symbol, self._ctx, new_args,
+                        grad_req=grad_req, aux_states=new_aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def debug_str(self):
+        lines = ["Symbol Outputs:"]
+        for n in self._symbol.list_outputs():
+            lines.append("\toutput[%s]" % n)
+        return "\n".join(lines)

@@ -1,0 +1,546 @@
+"""Data iterators.
+
+Reference: python/mxnet/io.py (DataDesc/DataBatch/DataIter at :60-180,
+NDArrayIter :182, ResizeIter :578, PrefetchingIter :658, CSVIter via the
+C++ registry src/io/iter_csv.cc).
+
+TPU-native design: batches are prepared on host in NumPy (shuffle/slice/
+pad are bandwidth-trivial) and shipped to device per batch — the same
+host-side staging the reference's PrefetcherIter does, but relying on
+PjRt's async host-to-device copies instead of a dedicated prefetch
+thread. ``PrefetchingIter`` adds explicit thread-based read-ahead for
+iterators whose ``next()`` is expensive (decode-heavy pipelines).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Data description: name, shape, plus dtype/layout
+    (reference: python/mxnet/io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        """Axis of the batch dimension in ``layout`` (0 if unspecified)."""
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch(object):
+    """One mini-batch (reference: python/mxnet/io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise TypeError("data must be a list of NDArrays")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise TypeError("label must be a list of NDArrays")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (reference: python/mxnet/io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data into an OrderedDict of name->numpy array
+    (reference: python/mxnet/io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = OrderedDict([(default_name, data[0])])
+        else:
+            data = OrderedDict(
+                [("_%d_%s" % (i, default_name), d) for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    out = OrderedDict()
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out[k] = np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: python/mxnet/io.py:182).
+
+    Supports shuffle and the three ``last_batch_handle`` modes of the
+    reference: ``pad`` (wrap the final short batch with leading samples,
+    reporting ``pad``), ``discard``, and ``roll_over`` (carry the remainder
+    to the next epoch).
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.num_data = new_n
+
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        """Ignore roll-over; restart from sample 0."""
+        if self.shuffle:
+            self._shuffle_data()
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+
+    def reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        # roll_over: keep the tail of the previous epoch at the front
+        if (self.last_batch_handle == "roll_over"
+                and 0 < self.cursor < self.num_data):
+            self.cursor = -self.batch_size + (self.cursor - self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self.getdata()
+        label = self.getlabel()
+        return DataBatch(data=data, label=label, pad=self.getpad(),
+                         index=None)
+
+    def _getdata(self, data_source, start=None, end=None):
+        """Slice [start, end) from each source array as NDArray."""
+        if start is None:
+            start = 0
+        if end is None:
+            end = data_source[0][1].shape[0] if data_source else 0
+        return [array(v[start:end]) for _, v in data_source]
+
+    def _concat(self, first, second):
+        return [array(np.concatenate((f.asnumpy(), s.asnumpy()), axis=0))
+                for f, s in zip(first, second)]
+
+    def _batchify(self, data_source):
+        """Assemble the current batch, handling the final short batch per
+        ``last_batch_handle``."""
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if (self.last_batch_handle == "roll_over" and self.cursor < 0):
+            # remainder carried over from previous epoch
+            assert (self._cache_data is not None
+                    or self._cache_label is not None), \
+                "next epoch should have cached data"
+            cache = (self._cache_data if data_source is self.data
+                     else self._cache_label)
+            second = self._getdata(data_source, end=self.cursor
+                                   + self.batch_size)
+            return self._concat(cache, second)
+        if self.cursor + self.batch_size <= self.num_data:
+            return self._getdata(data_source, start=self.cursor,
+                                 end=self.cursor + self.batch_size)
+        # final short batch
+        if self.last_batch_handle == "pad":
+            first = self._getdata(data_source, start=self.cursor,
+                                  end=self.num_data)
+            pad = self.batch_size - (self.num_data - self.cursor)
+            second = self._getdata(data_source, end=pad)
+            return self._concat(first, second)
+        # roll_over / discard: return the short tail (cached by next())
+        return self._getdata(data_source, start=self.cursor,
+                             end=self.num_data)
+
+    def getdata(self):
+        if (self.last_batch_handle == "roll_over"
+                and self.num_data - self.batch_size < self.cursor < self.num_data):
+            # cache the tail; caller sees StopIteration via iter_next bound
+            self._cache_data = self._batchify(self.data)
+            self._cache_label = self._batchify(self.label) if self.label else []
+            raise StopIteration
+        batch = self._batchify(self.data)
+        if self.cursor < 0:
+            self._cache_data = None
+            self._cache_label = None
+        return batch
+
+    def getlabel(self):
+        if not self.label:
+            return []
+        if (self.last_batch_handle == "roll_over" and self.cursor < 0
+                and self._cache_label is not None):
+            cache, second = self._cache_label, self._getdata(
+                self.label, end=self.cursor + self.batch_size)
+            return self._concat(cache, second)
+        return self._batchify(self.label)
+
+    def getpad(self):
+        if (self.last_batch_handle == "pad"
+                and self.cursor + self.batch_size > self.num_data):
+            return self.cursor + self.batch_size - self.num_data
+        if (self.last_batch_handle == "roll_over"
+                and -self.batch_size < self.cursor < 0):
+            return -self.cursor
+        return 0
+
+    def getindex(self):
+        return None
+
+    def _shuffle_data(self):
+        perm = np.random.permutation(self.data[0][1].shape[0])
+        self.data = [(k, v[perm]) for k, v in self.data]
+        self.label = [(k, v[perm]) for k, v in self.label]
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to ``size`` batches per epoch
+    (reference: python/mxnet/io.py:578)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-based read-ahead over one or more iterators
+    (reference: python/mxnet/io.py:658 — same double-buffer design; the
+    reference uses it to overlap C++ decode with training; here it overlaps
+    host batch prep with device compute)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for t in self.prefetch_threads:
+            t.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+        for t in self.prefetch_threads:
+            t.join(timeout=1.0)
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            # all sub-iterators end together
+            assert all(b is None for b in self.next_batch), \
+                "Number of entry mismatches between iterators"
+            return False
+        assert all(b is not None for b in self.next_batch), \
+            "Number of entry mismatches between iterators"
+        self.current_batch = DataBatch(
+            sum([b.data for b in self.next_batch], []),
+            sum([(b.label or []) for b in self.next_batch], []),
+            self.next_batch[0].pad,
+            self.next_batch[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(DataIter):
+    """Iterate over CSV files (reference: src/io/iter_csv.cc; the C++
+    iterator streams chunks — here the file is memory-mapped once via
+    numpy, which covers the same scale for host-side CSVs)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **_kw):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+            label_name="label")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class MNISTIter(DataIter):
+    """Iterate over the MNIST idx-format files (reference:
+    src/io/iter_mnist.cc:260 — same ubyte/idx decode, host-side)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 seed=0, **_kw):
+        import gzip
+        import struct
+
+        def _open(p):
+            return gzip.open(p, "rb") if str(p).endswith(".gz") else open(p, "rb")
+
+        with _open(image) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise MXNetError("bad MNIST image magic %d" % magic)
+            img = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                num, rows, cols)
+        with _open(label) as f:
+            magic, num_l = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise MXNetError("bad MNIST label magic %d" % magic)
+            lab = np.frombuffer(f.read(), dtype=np.uint8)
+        img = img.astype(np.float32) / 255.0
+        if flat:
+            img = img.reshape(num, rows * cols)
+        else:
+            img = img.reshape(num, 1, rows, cols)
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            perm = rng.permutation(num)
+            img, lab = img[perm], lab[perm]
+        self._inner = NDArrayIter(img, lab.astype(np.float32),
+                                  batch_size=batch_size,
+                                  last_batch_handle="discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

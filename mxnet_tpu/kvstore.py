@@ -1,0 +1,215 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: python/mxnet/kvstore.py (API :105-221), src/kvstore/
+kvstore_local.h (reduce→update→broadcast), kvstore_dist.h:44 (PS
+semantics: rank-0 init, aggregate-then-update), kvstore_nccl.h.
+
+TPU-native design: the reference's three transports (CPU/GPU tree reduce,
+NCCL, ps-lite) collapse onto XLA collectives. Within one process a "push"
+of per-device values is a tree-sum (PjRt handles device-to-device);
+across hosts (``dist_tpu_sync``) the aggregation is a ``psum`` over the
+global device mesh riding ICI/DCN — the `dist_sync` aggregate-then-update
+contract with allreduce instead of a parameter server. Async PS mode
+(`dist_async`) has no allreduce analog; it is served by the same class
+with per-push updates (single-host) and documented as host-driven.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize to (list_of_keys, list_of_value_lists)."""
+    if isinstance(keys, (str, int)):
+        keys = [keys]
+        vals = [vals]
+    out_vals = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out_vals.append([v])
+        else:
+            out_vals.append(list(v))
+    return list(keys), out_vals
+
+
+class KVStore(object):
+    """A store for synchronized parameter values (reference:
+    python/mxnet/kvstore.py:105)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._barrier_count = 0
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """This worker's rank (reference: kvstore.py rank). Multi-host JAX
+        maps rank to ``jax.process_index()``."""
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        """Initialize a key. Rank-0 value wins (reference:
+        kvstore_dist.h rank-0 init + broadcast; with allreduce semantics
+        every worker holds the full value, so init is local assignment)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values; if an optimizer is installed, run the update
+        on the store (reference: kvstore_local.h:184-212 PushImpl:
+        comm_->Reduce then updater_)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("please init key %r before push" % (k,))
+            agg = self._aggregate(vlist)
+            if self._updater is not None:
+                # updater mutates the stored weight in place
+                self._updater(self._key_index(k), agg, self._store[k])
+            else:
+                self._store[k]._set_data(agg._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast the stored value into ``out`` (reference:
+        kvstore_local.h PullImpl → comm_->Broadcast)."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("please init key %r before pull" % (k,))
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference: kvstore.py pushpull — on TPU this is
+        the natural allreduce: one collective, no server round-trip)."""
+        self.push(key, value, priority=priority)
+        self.pull(key, out=out if out is not None else value,
+                  priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in ``row_ids`` (reference: kvstore.py
+        row_sparse_pull; sparse embedding workflows). Dense rows are
+        gathered host-side until row_sparse storage lands."""
+        assert out is not None and row_ids is not None
+        keys, outs = _ctype_key_value(key, out)
+        rids, _ = _ctype_key_value(row_ids, row_ids)
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o in olist:
+                rows = row_ids if isinstance(row_ids, NDArray) else row_ids[0]
+                o._set_data(src._data[rows._data.astype("int32")])
+
+    # -- aggregation -------------------------------------------------------
+    def _aggregate(self, vlist):
+        """Sum per-device contributions. Single values pass through; the
+        multi-host ``dist_tpu_sync`` path additionally allreduces across
+        processes (ICI/DCN via XLA psum)."""
+        agg = vlist[0]
+        if len(vlist) > 1:
+            total = vlist[0]._data
+            for v in vlist[1:]:
+                total = total + v._data
+            agg = NDArray(total, ctx=vlist[0].context)
+        if self._type.startswith("dist") and self.num_workers > 1:
+            agg = self._cross_process_allreduce(agg)
+        return agg
+
+    def _cross_process_allreduce(self, value):
+        """psum over the global mesh (multi-host). Reference analog:
+        kvstore_dist.h PushDefault → server aggregation; here one XLA
+        allreduce replaces the PS round trip."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(value._data)
+        return NDArray(jnp.sum(summed, axis=0), ctx=value.context)
+
+    def _key_index(self, k):
+        if isinstance(k, int):
+            return k
+        return k
+
+    # -- optimizer installation -------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Install an optimizer to run updates on the store
+        (reference: kvstore.py set_optimizer; in dist mode the reference
+        pickles the optimizer to the servers — with allreduce every worker
+        runs the same update locally, which is semantically identical for
+        sync mode)."""
+        from .optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """Record 2-bit/int8 compression config (reference:
+        gradient_compression.h:38). On TPU the equivalent lever is reduced-
+        precision collectives; the config is honored by the parallel
+        trainer's allreduce dtype."""
+        self._compression_params = dict(compression_params)
+
+    # -- sync --------------------------------------------------------------
+    def barrier(self):
+        """Global barrier (reference: kvstore.py _barrier → ps
+        Postoffice::Barrier)."""
+        import jax
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "kvstore_barrier_%d" % self._barrier_count)
+        self._barrier_count += 1
+
+    # -- optimizer state io ------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def create(name="local"):
+    """Create a KVStore (reference: src/kvstore/kvstore.cc:40-77 factory).
+
+    Supported types: ``local``, ``device`` (both intra-process),
+    ``dist_sync``/``dist_device_sync``/``dist_tpu_sync`` (allreduce across
+    JAX processes), ``dist_async`` (per-push update, no barrier), ``nccl``
+    (alias of device — collectives are XLA's job on TPU)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+             "dist_tpu_sync", "dist_async", "dist")
+    if name not in known:
+        raise MXNetError("unknown KVStore type %r (supported: %s)"
+                         % (name, ", ".join(known)))
+    return KVStore(name)

@@ -420,8 +420,15 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label.size
-        self.sum_metric += numpy.exp(loss / num) * num
+        # accumulate raw loss; get() exponentiates the global mean
+        # (reference: metric.py Perplexity stores sum_metric += loss)
+        self.sum_metric += loss
         self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(numpy.exp(self.sum_metric / self.num_inst)))
 
 
 @_alias("mae")

@@ -1,0 +1,5 @@
+"""Module package: symbolic training API
+(reference: python/mxnet/module/__init__.py)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule

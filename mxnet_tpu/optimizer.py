@@ -16,7 +16,6 @@ import pickle
 import numpy
 
 from .base import MXNetError
-from .ndarray import ndarray as _nd
 from .ndarray.ndarray import NDArray, zeros
 from .ndarray import register as _register_mod  # noqa: F401  (op funcs)
 from . import ndarray as nd
@@ -178,7 +177,7 @@ register = Optimizer.register
 create = Optimizer.create_optimizer
 
 
-def _common_kwargs(opt, index):
+def _common_kwargs(opt):
     kw = {"rescale_grad": opt.rescale_grad}
     if opt.clip_gradient is not None:
         kw["clip_gradient"] = opt.clip_gradient
@@ -203,7 +202,7 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         if state is not None:
             nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
                               momentum=self.momentum, **kw)
@@ -215,7 +214,7 @@ class SGD(Optimizer):
             mom, w32 = state
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
-            kw = _common_kwargs(self, index)
+            kw = _common_kwargs(self)
             if mom is not None:
                 nd.mp_sgd_mom_update(weight, grad, mom, w32, lr=lr, wd=wd,
                                      momentum=self.momentum, **kw)
@@ -242,7 +241,7 @@ class Signum(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         if state is not None:
             nd.signum_update(weight, grad, state, lr=lr, wd=wd,
                              momentum=self.momentum, wd_lh=self.wd_lh, **kw)
@@ -266,7 +265,7 @@ class NAG(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         if state is not None:
             nd.nag_mom_update(weight, grad, state, lr=lr, wd=wd,
                               momentum=self.momentum, **kw)
@@ -298,7 +297,7 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= numpy.sqrt(coef2) / coef1
         mean, var = state
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, **kw)
@@ -351,7 +350,7 @@ class RMSProp(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         if self.clip_weights:
             kw["clip_weights"] = self.clip_weights
         if not self.centered:
@@ -410,7 +409,7 @@ class Ftrl(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         z, n = state
-        kw = _common_kwargs(self, index)
+        kw = _common_kwargs(self)
         nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
                        beta=self.beta, **kw)
 
@@ -596,6 +595,11 @@ class Updater(object):
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
             return state.as_in_context(context)
+        if isinstance(state, numpy.ndarray):
+            # deserialized states arrive as numpy (get_states converts for
+            # pickling); rehydrate on the weight's device
+            from .ndarray.ndarray import array
+            return array(state, ctx=context)
         if isinstance(state, (tuple, list)):
             return type(state)(self.sync_state_context(i, context)
                                for i in state)

@@ -1,0 +1,20 @@
+"""Symbol package: graph construction + generated op namespace
+(reference: python/mxnet/symbol/__init__.py)."""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     AUX_STATES)
+from . import _internal
+
+from . import register as _register
+_register.populate(__name__, __package__ + "._internal")
+
+
+def zeros(shape, dtype="float32", name=None):
+    from . import _zeros
+    return _zeros(shape=tuple(shape) if not isinstance(shape, int) else (shape,),
+                  dtype=dtype, name=name)
+
+
+def ones(shape, dtype="float32", name=None):
+    from . import _ones
+    return _ones(shape=tuple(shape) if not isinstance(shape, int) else (shape,),
+                 dtype=dtype, name=name)

@@ -1,0 +1,1 @@
+"""Internal symbol op namespace (reference: mxnet.symbol._internal)."""

@@ -1,0 +1,48 @@
+"""Generate the Symbol op namespace from the registry.
+
+Reference: python/mxnet/symbol/register.py — same codegen flow as the
+ndarray namespace, producing graph-node constructors instead of eager
+calls."""
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _apply_op
+
+__all__ = ["make_op_func", "populate"]
+
+
+def make_op_func(opdef):
+    def op_func(*args, name=None, attr=None, **kwargs):
+        attrs = {}
+        sym_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                attrs[k] = v
+        attrs.update(sym_kwargs)
+        out = _apply_op(opdef, args, attrs, name)
+        if attr:
+            out._set_attr(**attr)
+        return out
+
+    op_func.__name__ = opdef.name
+    op_func.__qualname__ = opdef.name
+    op_func.__doc__ = opdef.doc
+    return op_func
+
+
+def populate(target_module_name, internal_module_name=None):
+    target = sys.modules[target_module_name]
+    internal = (sys.modules[internal_module_name]
+                if internal_module_name else None)
+    for name in _reg.list_ops():
+        fn = make_op_func(_reg.get_op(name))
+        if name.startswith("_"):
+            if internal is not None:
+                setattr(internal, name, fn)
+            setattr(target, name, fn)
+        else:
+            setattr(target, name, fn)

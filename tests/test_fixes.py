@@ -202,3 +202,60 @@ def test_creation_op_honors_context_device():
         pytest.skip("needs multi-device mesh")
     x = nd.zeros((2, 2), ctx=mx.tpu(1))
     assert x._data.device == mx.tpu(1).jax_device()
+
+
+# -- round-2 review fixes ----------------------------------------------------
+
+def test_updater_state_roundtrip_then_update():
+    """set_states must rehydrate numpy states into NDArrays so the next
+    update works (reference: optimizer.py Updater.set_states)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,)) * 0.1
+    upd = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    upd2.set_states(blob)
+    upd2(0, g, w)  # must not crash on numpy states
+    assert w.shape == (4,)
+
+
+def test_grad_create_graph_mixed_second_derivative():
+    """d/dw of d/dx (x*x*w) must be 2x, not zero."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd as ag
+    x = mx.nd.array([2.0])
+    w = mx.nd.array([3.0])
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = x * x * w
+        gx = ag.grad(y, [x], create_graph=True)[0]   # 2*x*w
+    gx.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0], rtol=1e-5)  # 2x
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0], rtol=1e-5)  # 2w
+
+
+def test_perplexity_batch_invariance():
+    """Perplexity over two batches == perplexity over the union."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import metric
+    p1 = mx.nd.array([[0.9, 0.1]])
+    p2 = mx.nd.array([[0.1, 0.9]])
+    l1 = mx.nd.array([0])
+    l2 = mx.nd.array([0])
+    m = metric.Perplexity(ignore_label=None)
+    m.update([l1], [p1])
+    m.update([l2], [p2])
+    split = m.get()[1]
+    m2 = metric.Perplexity(ignore_label=None)
+    m2.update([mx.nd.array([0, 0])],
+              [mx.nd.array([[0.9, 0.1], [0.1, 0.9]])])
+    combined = m2.get()[1]
+    np.testing.assert_allclose(split, combined, rtol=1e-6)
+    np.testing.assert_allclose(combined, np.exp(-(np.log(0.9) + np.log(0.1)) / 2),
+                               rtol=1e-6)

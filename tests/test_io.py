@@ -1,0 +1,95 @@
+"""Data iterator tests (reference: tests/python/unittest/test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    np.testing.assert_allclose(batches[1].data[0].asnumpy(), data[5:10])
+    # second epoch after reset
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = io.NDArrayIter(data, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    assert batches[-1].data[0].shape == (5, 2)
+    # padded tail wraps to the start
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[3:], data[:2])
+
+
+def test_ndarrayiter_discard():
+    data = np.zeros((23, 2), dtype=np.float32)
+    it = io.NDArrayIter(data, batch_size=5, last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarrayiter_shuffle_keeps_pairing():
+    data = np.arange(40).astype(np.float32).reshape(40, 1)
+    label = np.arange(40).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=8, shuffle=True)
+    for batch in it:
+        np.testing.assert_allclose(batch.data[0].asnumpy()[:, 0],
+                                   batch.label[0].asnumpy())
+
+
+def test_ndarrayiter_dict_input():
+    it = io.NDArrayIter({"a": np.zeros((10, 2)), "b": np.zeros((10, 3))},
+                        batch_size=5)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    b = next(it)
+    assert len(b.data) == 2
+
+
+def test_provide_data_desc():
+    data = np.zeros((10, 3, 4, 4), dtype=np.float32)
+    it = io.NDArrayIter(data, batch_size=2)
+    desc = it.provide_data[0]
+    assert desc.name == "data"
+    assert desc.shape == (2, 3, 4, 4)
+    assert io.DataDesc.get_batch_axis("NCHW") == 0
+
+
+def test_resize_iter():
+    data = np.zeros((20, 2), dtype=np.float32)
+    base = io.NDArrayIter(data, batch_size=5)
+    it = io.ResizeIter(base, 7)
+    assert len(list(it)) == 7
+    it.reset()
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(60).reshape(20, 3).astype(np.float32)
+    base = io.NDArrayIter(data, batch_size=4)
+    it = io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype(np.float32)
+    f = tmp_path / "d.csv"
+    np.savetxt(f, data, delimiter=",")
+    it = io.CSVIter(data_csv=str(f), data_shape=(3,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)

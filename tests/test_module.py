@@ -1,0 +1,168 @@
+"""Module training tests — the SURVEY §7 stage-4 judged milestone
+(reference: tests/python/train/test_mlp.py, tests/python/unittest/test_module.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+from mxnet_tpu.module import Module
+
+
+def _synthetic_mnist(n=2000, seed=7):
+    """MNIST-scale 10-class problem: 784-dim inputs whose class signal is a
+    linear projection + nonlinearity, learnable to >97% by an MLP."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype(np.float32) * 1.2
+    labels = rng.randint(0, 10, size=n)
+    data = centers[labels] + rng.randn(n, 784).astype(np.float32)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def test_mlp_fit_convergence():
+    """MNIST-equivalent convergence: >=97% train accuracy in a few epochs
+    (mirrors tests/python/train/test_mlp.py accuracy assertion)."""
+    data, labels = _synthetic_mnist()
+    train = io.NDArrayIter(data, labels, batch_size=100, shuffle=True)
+    val = io.NDArrayIter(data, labels, batch_size=100)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=5)
+    score = mod.score(val, "acc")
+    assert score[0][1] >= 0.97, "accuracy %f too low" % score[0][1]
+
+
+def test_module_forward_shapes():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 784))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.zeros((16, 784))],
+                         label=[mx.nd.zeros((16,))])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (16, 10)
+
+
+def test_module_predict():
+    data, labels = _synthetic_mnist(200)
+    it = io.NDArrayIter(data, labels, batch_size=50)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (200, 10)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    data, labels = _synthetic_mnist(300)
+    it = io.NDArrayIter(data, labels, batch_size=50)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=1,
+            optimizer_params={"learning_rate": 0.05})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+
+    mod2 = Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    it.reset()
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_save_load_optimizer_states(tmp_path):
+    data, labels = _synthetic_mnist(200)
+    it = io.NDArrayIter(data, labels, batch_size=50)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    assert os.path.exists(prefix + "-0001.states")
+    mod.load_optimizer_states(prefix + "-0001.states")
+
+
+def test_module_adam_convergence():
+    data, labels = _synthetic_mnist(1000)
+    train = io.NDArrayIter(data, labels, batch_size=100, shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.002}, num_epoch=4)
+    score = mod.score(io.NDArrayIter(data, labels, batch_size=100), "acc")
+    assert score[0][1] >= 0.95
+
+
+def test_conv_module_trains():
+    """Small LeNet-style conv net end to end (mirrors
+    tests/python/train/test_conv.py)."""
+    rng = np.random.RandomState(3)
+    n = 400
+    labels = rng.randint(0, 4, size=n)
+    base = rng.randn(4, 1, 12, 12).astype(np.float32) * 2
+    data = base[labels] + rng.randn(n, 1, 12, 12).astype(np.float32) * 0.5
+
+    d = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    fl = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(fl, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    it = io.NDArrayIter(data, labels.astype(np.float32), batch_size=40,
+                        shuffle=True)
+    mod = Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    score = mod.score(io.NDArrayIter(data, labels.astype(np.float32),
+                                     batch_size=40), "acc")
+    assert score[0][1] >= 0.95
+
+
+def test_bucketing_module():
+    """Variable-length input via BucketingModule (reference:
+    tests/python/train/test_bucketing.py shape)."""
+    buckets = [8, 16]
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        # params must be shape-invariant across buckets (as with shared
+        # RNN weights in the reference): reduce the bucketed axis first
+        pooled = mx.sym.mean(data, axis=1, keepdims=True)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=4, name="fc")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=16,
+                                    context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None)
+    for key in [16, 8, 16]:
+        batch = io.DataBatch(
+            data=[mx.nd.ones((4, key))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[io.DataDesc("data", (4, key))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod.get_outputs()[0].shape == (4, 4)

@@ -1,0 +1,149 @@
+"""Symbol API tests (reference: tests/python/unittest/test_symbol.py,
+test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 784))
+    assert arg_shapes == [(32, 784), (64, 784), (64,), (10, 64), (10,), (32,)]
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 8, 8))
+    assert arg_shapes[1] == (8, 3, 3, 3)       # conv weight
+    assert out_shapes == [(2, 8, 8, 8)]
+    assert aux_shapes == [(8,), (8,)]          # moving mean/var
+    assert bn.list_auxiliary_states() == ["bn1_moving_mean", "bn1_moving_var"]
+
+
+def test_infer_type():
+    out = _mlp()
+    arg_types, out_types, _ = out.infer_type(data=np.float32)
+    assert out_types[0] == np.float32
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    a1, o1, _ = out.infer_shape(data=(8, 32))
+    a2, o2, _ = out2.infer_shape(data=(8, 32))
+    assert o1 == o2
+
+
+def test_save_load(tmp_path):
+    out = _mlp()
+    f = str(tmp_path / "net.json")
+    out.save(f)
+    out2 = sym.load(f)
+    assert out2.list_arguments() == out.list_arguments()
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net2 = sym.FullyConnected(name="fc3", num_hidden=10)
+    composed = net2(data=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc3_weight" in args
+
+
+def test_group_and_getitem():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    fc2 = sym.FullyConnected(data, num_hidden=6, name="fc2")
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert g[1].list_outputs() == ["fc2_output"]
+    assert g["fc1_output"].list_outputs() == ["fc1_output"]
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    _, out_shapes, _ = fc1.infer_shape(data=(4, 16))
+    assert out_shapes == [(4, 64)]
+
+
+def test_symbol_arithmetic_exec():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2.0 * a + b
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([[1.0, 2.0]]),
+                           "b": mx.nd.array([[3.0, 4.0]])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [[5.0, 8.0]])
+
+
+def test_executor_forward_backward():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    loss = sym.LinearRegressionOutput(fc, name="lro")
+    ex = loss.simple_bind(mx.cpu(), data=(4, 5))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["fc_weight"][:] = rng.randn(3, 5).astype(np.float32)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    ex.forward(is_train=True, data=x, lro_label=y)
+    ex.backward()
+    # numeric check of the loss-op gradient: d/dpred 0.5*(pred-y)^2 = pred-y
+    pred = x @ ex.arg_dict["fc_weight"].asnumpy().T
+    gw = ex.grad_dict["fc_weight"].asnumpy()
+    expected_gw = (pred - y).T @ x / 1.0
+    np.testing.assert_allclose(gw, expected_gw, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_aux_update_in_executor():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = bn.simple_bind(mx.cpu(), data=(8, 3))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.RandomState(1).randn(8, 3).astype(np.float32) * 2 + 5
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    # moving_mean = 0.5*0 + 0.5*batch_mean
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-4)
+    # inference uses moving stats
+    out = ex.forward(is_train=False, data=x)[0].asnumpy()
+    expect = (x - mm) / np.sqrt(ex.aux_dict["bn_moving_var"].asnumpy() + 1e-3)
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-3)
+
+
+def test_variable_shape_attr():
+    data = sym.Variable("data", shape=(4, 7))
+    fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert arg_shapes[0] == (4, 7)
+    assert out_shapes == [(4, 2)]
