@@ -1,0 +1,69 @@
+"""Benchmark driver: ResNet-50 fp32 training throughput on one chip.
+
+Mirrors the reference's benchmark methodology
+(example/image-classification/benchmark_score.py + train_imagenet.py;
+published numbers docs/faq/perf.md:205-214). Baseline: ResNet-50 training,
+batch 32, fp32, 1x V100 = 298.51 img/s (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Extra detail goes to stderr.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 298.51   # ResNet-50 train, batch 32, 1x V100 fp32
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_resnet50_train(batch=32, image=(3, 224, 224), warmup=3, iters=20):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainer
+
+    log("devices:", jax.devices())
+    net = resnet(num_classes=1000, num_layers=50)
+    mesh = make_mesh((1,), axis_names=("dp",))
+    trainer = ShardedTrainer(net, mesh, lr=0.05, momentum=0.9, dp_axis="dp")
+    params, moms, aux = trainer.init((batch,) + image, (batch,))
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(batch, *image).astype(np.float32)
+    label = rng.randint(0, 1000, size=(batch,)).astype(np.float32)
+
+    t0 = time.time()
+    for _ in range(warmup):
+        params, moms, aux, loss = trainer.step(params, moms, aux, data, label)
+    jax.block_until_ready(loss)
+    log("warmup (incl. compile): %.1fs" % (time.time() - t0))
+
+    t0 = time.time()
+    for _ in range(iters):
+        params, moms, aux, loss = trainer.step(params, moms, aux, data, label)
+    jax.block_until_ready((params, loss))
+    dt = time.time() - t0
+    img_s = batch * iters / dt
+    log("resnet50 train: %.2f img/s (%.1f ms/step, batch %d)"
+        % (img_s, 1e3 * dt / iters, batch))
+    return img_s
+
+
+def main():
+    batch = 32
+    img_s = bench_resnet50_train(batch=batch)
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s (batch %d, fp32, 1 chip)" % batch,
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

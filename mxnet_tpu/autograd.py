@@ -379,9 +379,14 @@ def _grad_create_graph(heads, variables, head_grads, single):
     # grad_fn must be a function of EVERY live leaf feeding the heads (not
     # just the requested variables), so a later backward on the result can
     # propagate mixed second derivatives (d²y/dx dw) into other leaves.
+    # Deduplicate variables (grad(y, [x, x]) is legal) and compute w.r.t.
+    # the unique nodes, mapping results back per requested position.
     leaf_map = {}
     for v, n in zip(variables, var_nodes):
-        leaf_map[id(n)] = (n, v)
+        if id(n) not in leaf_map:
+            leaf_map[id(n)] = (n, v)
+    uniq_var_nodes = [n for (n, _a) in leaf_map.values()]
+    n_vars = len(uniq_var_nodes)
     for e in entries:
         for n in e.input_nodes:
             if n is not None and n.is_leaf and id(n) not in leaf_map:
@@ -390,7 +395,7 @@ def _grad_create_graph(heads, variables, head_grads, single):
                     leaf_map[id(n)] = (n, arr)
     leaf_nodes = [n for (n, _a) in leaf_map.values()]
     leaf_arrays = [a for (_n, a) in leaf_map.values()]
-    n_vars = len(var_nodes)
+    var_nodes = uniq_var_nodes
 
     def grad_fn(*leaf_vals, **_attrs):
         env0 = {id(n): val for n, val in zip(leaf_nodes, leaf_vals)}
@@ -415,9 +420,12 @@ def _grad_create_graph(heads, variables, head_grads, single):
         return tuple(grads)
 
     grads = grad_fn(*(a._data for a in leaf_arrays))
-    outs = [NDArray(g, ctx=v.context) for v, g in zip(variables, grads)]
+    uniq_outs = [NDArray(g, ctx=a.context)
+                 for a, g in zip(leaf_arrays[:n_vars], grads)]
     if is_recording():
         from .ops.registry import OpDef
-        op = OpDef("_grad_of_grad", grad_fn, num_outputs=len(outs))
-        record_op(op, {}, list(leaf_arrays), outs, key=None)
+        op = OpDef("_grad_of_grad", grad_fn, num_outputs=len(uniq_outs))
+        record_op(op, {}, list(leaf_arrays), uniq_outs, key=None)
+    grad_of = {id(n): o for n, o in zip(var_nodes, uniq_outs)}
+    outs = [grad_of[id(v._ag_node)] for v in variables]
     return outs[0] if single else outs

@@ -180,6 +180,22 @@ class Executor(object):
                 tgt._set_data(gs[n])
 
     # -- parameter management ---------------------------------------------
+    def alias_args(self, other, names):
+        """Share argument/aux NDArray objects with another executor (the
+        analog of the reference's shared-executor memory reuse,
+        graph_executor.cc InitDataEntryMemory shared_exec path). Both
+        executors then read and update the SAME buffers."""
+        for n in names:
+            if n in other.arg_dict:
+                shared = other.arg_dict[n]
+                idx = self._arg_names.index(n)
+                self.arg_arrays[idx] = shared
+                self.arg_dict[n] = shared
+            elif n in other.aux_dict:
+                idx = self._aux_names.index(n)
+                self.aux_arrays[idx] = other.aux_dict[n]
+                self.aux_dict[n] = other.aux_dict[n]
+
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
         """Reference: executor.py copy_params_from."""

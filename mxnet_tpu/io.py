@@ -214,6 +214,11 @@ class NDArrayIter(DataIter):
             raise StopIteration
         data = self.getdata()
         label = self.getlabel()
+        # roll_over: clear the carried-over cache only after BOTH data and
+        # label consumed it
+        if self.last_batch_handle == "roll_over" and self.cursor < 0:
+            self._cache_data = None
+            self._cache_label = None
         return DataBatch(data=data, label=label, pad=self.getpad(),
                          index=None)
 
@@ -264,11 +269,7 @@ class NDArrayIter(DataIter):
             self._cache_data = self._batchify(self.data)
             self._cache_label = self._batchify(self.label) if self.label else []
             raise StopIteration
-        batch = self._batchify(self.data)
-        if self.cursor < 0:
-            self._cache_data = None
-            self._cache_label = None
-        return batch
+        return self._batchify(self.data)
 
     def getlabel(self):
         if not self.label:

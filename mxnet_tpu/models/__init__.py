@@ -1,0 +1,8 @@
+"""Symbolic model builders (reference: example/image-classification/symbols/).
+
+These mirror the reference's benchmark topologies so `bench.py` measures
+the same workloads as docs/faq/perf.md. The Gluon model zoo
+(`mxnet_tpu.gluon.model_zoo`) is the imperative counterpart.
+"""
+from .resnet import get_symbol as resnet
+from .mlp import get_symbol as mlp

@@ -165,22 +165,18 @@ class BucketingModule(BaseModule):
             module.bind(data_shapes, label_shapes, self.for_training,
                         self.inputs_need_grad, force_rebind=False,
                         shared_module=None, grad_req=self._grad_req)
-            if self.params_initialized:
-                arg_params, aux_params = self.get_params()
-                module.set_params(arg_params, aux_params, allow_missing=True)
-                module.optimizer_initialized = False
+            # alias the default bucket's parameter/aux buffers so every
+            # bucket reads and updates the SAME arrays — no per-switch
+            # copies (the reference shares executor memory the same way)
+            default_mod = self._buckets[self._default_bucket_key]
+            module._exec.alias_args(
+                default_mod._exec,
+                module._param_names + module._aux_names)
+            module.params_initialized = self.params_initialized
             self._buckets[bucket_key] = module
 
-        prev = self._curr_module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
-        if (self.params_initialized and prev is not None
-                and prev is not self._curr_module):
-            # share the live parameter arrays so all buckets update the
-            # same weights (the reference shares executor memory)
-            arg_params, aux_params = prev.get_params()
-            self._curr_module.set_params(arg_params, aux_params,
-                                         allow_missing=True)
         if (self.optimizer_initialized
                 and not self._curr_module.optimizer_initialized):
             self._curr_module.borrow_optimizer(

@@ -156,8 +156,7 @@ class Module(BaseModule):
                 if name in cache:
                     cache_arr = cache[name]
                     if cache_arr is not arr:
-                        cache_arr.copyto(arr) if False else \
-                            arr._set_data(cache_arr._data)
+                        arr._set_data(cache_arr._data)
                 else:
                     if not allow_missing:
                         raise RuntimeError("%s is not presented" % name)

@@ -1,0 +1,11 @@
+"""Parallelism package: device meshes + sharded training steps.
+
+Reference capability rows (SURVEY.md §2.3): data parallel (executor_group
+slicing + kvstore allreduce), manual model parallel (group2ctx), plus the
+TPU-first additions TP/PP/SP. TPU-native design: a `jax.sharding.Mesh`
+with named axes replaces context lists; sharding annotations replace
+explicit comms — XLA GSPMD inserts the all-reduce/all-gather/ppermute
+collectives that the reference's KVStore/NCCL code performs by hand.
+"""
+from .mesh import make_mesh, current_mesh, set_mesh, data_parallel_sharding
+from .trainer import make_train_step, ShardedTrainer

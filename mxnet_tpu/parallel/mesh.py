@@ -1,0 +1,57 @@
+"""Device mesh management.
+
+Reference analog: the context lists passed to Module/-Trainer
+(`ctx=[mx.gpu(0), mx.gpu(1), ...]`, executor_group.py:143) and the KVStore
+device topology (comm_tree.h link solver). On TPU the mesh IS the
+topology: axes map onto ICI rings, so laying out ('dp','tp') over a pod
+slice makes gradient reduction ride ICI without any tree solver.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["make_mesh", "current_mesh", "set_mesh", "data_parallel_sharding",
+           "replicated_sharding"]
+
+_state = threading.local()
+
+
+def make_mesh(shape=None, axis_names=("dp",), devices=None):
+    """Create a Mesh over the visible devices.
+
+    ``shape``: tuple of axis sizes (product must divide the device count),
+    or None to put every device on the first axis."""
+    import jax
+    import numpy as np
+    devs = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    shape = tuple(int(s) for s in shape)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError("mesh shape %s needs %d devices, have %d"
+                         % (shape, n, len(devs)))
+    arr = np.asarray(devs[:n]).reshape(shape)
+    from jax.sharding import Mesh
+    return Mesh(arr, axis_names[:len(shape)])
+
+
+def set_mesh(mesh):
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    return prev
+
+
+def current_mesh():
+    return getattr(_state, "mesh", None)
+
+
+def data_parallel_sharding(mesh, axis="dp", ndim=2):
+    """NamedSharding splitting the leading (batch) dim over ``axis``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())

@@ -70,14 +70,18 @@ def _input_names(op):
 class _Node:
     """One graph node: an op application or a variable (op is None)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "is_aux")
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "in_names")
 
-    def __init__(self, op, name, attrs=None, inputs=(), is_aux=False):
+    def __init__(self, op, name, attrs=None, inputs=(), is_aux=False,
+                 in_names=None):
         self.op = op                    # op name string or None for variables
         self.name = name
         self.attrs = dict(attrs or {})
         self.inputs = list(inputs)      # list of (_Node, out_index)
         self.is_aux = is_aux
+        # names of the op input slots actually wired, aligned with
+        # ``inputs`` (optional inputs like bias may be skipped)
+        self.in_names = in_names
 
     @property
     def is_var(self):
@@ -334,6 +338,8 @@ class Symbol(object):
             })
             if n.is_aux:
                 jnodes[-1]["aux"] = True
+            if n.in_names is not None:
+                jnodes[-1]["in_names"] = list(n.in_names)
         heads = [[nid[id(n)], oi, 0] for (n, oi) in self._entries]
         arg_nodes = [i for i, n in enumerate(nodes) if n.is_var]
         return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
@@ -464,6 +470,7 @@ def _substitute(var_node, sym):
         var_node.attrs = dict(src.attrs)
         var_node.inputs = list(src.inputs)
         var_node.is_aux = False
+        var_node.in_names = src.in_names
 
 
 def _json_attrs(attrs):
@@ -532,7 +539,8 @@ def load_json(json_str):
         if jn["op"] == "null":
             node = _Node(None, jn["name"], attrs, is_aux=jn.get("aux", False))
         else:
-            node = _Node(jn["op"], jn["name"], attrs)
+            node = _Node(jn["op"], jn["name"], attrs,
+                         in_names=jn.get("in_names"))
             node.inputs = [(nodes[i], oi) for (i, oi, _v) in jn["inputs"]]
         nodes.append(node)
     entries = [(nodes[i], oi) for (i, oi, _v) in data["heads"]]
@@ -573,12 +581,14 @@ def _apply_op(op, args, attrs, name):
     aux_names = AUX_STATES.get(op.name, ())
 
     node_inputs = []
+    wired_names = []
     for in_name, has_default in in_names:
         if in_name in inputs:
             sym = inputs[in_name]
             if len(sym._entries) != 1:
                 raise MXNetError("op inputs must be single-output symbols")
             node_inputs.append(sym._entries[0])
+            wired_names.append(in_name)
             continue
         # missing input: auto-create a variable (reference behavior), or
         # skip genuinely-optional inputs (e.g. bias under no_bias)
@@ -589,8 +599,9 @@ def _apply_op(op, args, attrs, name):
         vnode = _Node(None, "%s_%s" % (name, in_name),
                       is_aux=in_name in aux_names)
         node_inputs.append((vnode, 0))
+        wired_names.append(in_name)
 
-    node = _Node(op.name, name, attrs, node_inputs)
+    node = _Node(op.name, name, attrs, node_inputs, in_names=wired_names)
     n_out = op.n_outputs(attrs)
     return Symbol([(node, i) for i in range(n_out)])
 
@@ -659,15 +670,14 @@ def _graph_eval_fn(symbol, is_train):
 
 def _aux_input_positions(op, node):
     aux_names = AUX_STATES[node.op]
-    in_names = [n for n, _d in _input_names(op)]
-    # node.inputs aligns with the subset of in_names actually wired
-    wired = []
-    idx = 0
-    for in_name, has_default in _input_names(op):
-        if idx >= len(node.inputs):
-            break
-        wired.append(in_name)
-        idx += 1
+    wired = node.in_names
+    if wired is None:
+        # graph loaded without slot names: valid only if nothing optional
+        # was skipped before the aux slots
+        wired = [n for n, _d in _input_names(op)][:len(node.inputs)]
+        assert all(a in wired for a in aux_names), \
+            "cannot locate aux inputs of %s; op has skipped optional " \
+            "inputs and the graph carries no slot names" % node.op
     return [wired.index(a) for a in aux_names]
 
 

@@ -259,3 +259,21 @@ def test_perplexity_batch_invariance():
     np.testing.assert_allclose(split, combined, rtol=1e-6)
     np.testing.assert_allclose(combined, np.exp(-(np.log(0.9) + np.log(0.1)) / 2),
                                rtol=1e-6)
+
+
+def test_grad_create_graph_duplicate_variables():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd as ag
+    x = mx.nd.array([2.0])
+    w = mx.nd.array([3.0])
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = x * x * w
+        gs = ag.grad(y, [x, x], create_graph=True)
+    assert len(gs) == 2
+    np.testing.assert_allclose(gs[0].asnumpy(), [12.0], rtol=1e-5)  # 2xw
+    np.testing.assert_allclose(gs[1].asnumpy(), [12.0], rtol=1e-5)
+    gs[0].backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0], rtol=1e-5)

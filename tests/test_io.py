@@ -93,3 +93,21 @@ def test_csv_iter(tmp_path):
     assert len(batches) == 3
     np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
                                rtol=1e-5)
+
+
+def test_ndarrayiter_roll_over_multi_epoch():
+    """roll_over with labels must survive multiple epochs (the cache is
+    consumed by both getdata and getlabel)."""
+    data = np.arange(10).astype(np.float32).reshape(10, 1)
+    label = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=4,
+                        last_batch_handle="roll_over")
+    for _epoch in range(3):
+        total = 0
+        for batch in it:
+            assert batch.data[0].shape == (4, 1)
+            np.testing.assert_allclose(batch.data[0].asnumpy()[:, 0],
+                                       batch.label[0].asnumpy())
+            total += 4
+        it.reset()
+        assert total >= 8
