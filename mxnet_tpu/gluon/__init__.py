@@ -1,0 +1,15 @@
+"""Gluon: the imperative/hybrid neural-network API.
+
+Reference: python/mxnet/gluon/ (~12k LoC). TPU-native: HybridBlock
+compilation lowers to one XLA program via jit tracing (see block.py).
+"""
+from .parameter import Parameter, ParameterDict, Constant, \
+    DeferredInitializationError  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+from . import rnn  # noqa: F401
+from . import data  # noqa: F401
+from . import model_zoo  # noqa: F401

@@ -1,0 +1,656 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: python/mxnet/gluon/block.py (Block :127, HybridBlock :750-787
+building a CachedOp, SymbolBlock :954).
+
+TPU-native design: ``hybridize()`` compiles the block's whole forward into
+ONE XLA executable via jit tracing (the CachedOp analog of
+src/imperative/cached_op.cc:835) instead of capturing an nnvm graph.
+Parameters are passed as arguments to the compiled program (so weight
+updates don't retrigger compilation), train/predict mode is a static
+trace key, the PRNG key is threaded as an input (dropout masks differ per
+call), and aux-state writes (BatchNorm moving stats) are captured during
+tracing and returned as extra outputs, then applied after each call —
+XLA-friendly functional state threading.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .parameter import (Parameter, ParameterDict,
+                        DeferredInitializationError, _ParamTraceScope)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(object):
+    """Name-manager scope for automatic prefixes
+    (reference: gluon/block.py:35 _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    _global_counter = {}
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = "%s%d_" % (hint, count)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block(object):
+    """Base building block (reference: gluon/block.py:127).
+
+    Subclasses implement ``forward(*args)`` operating on NDArrays.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute registration -------------------------------------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise TypeError(
+                    "Changing attribute type for %s from %s to %s is not "
+                    "allowed." % (name, type(existing), type(value)))
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super(Block, self).__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- properties --------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this block and children, optionally filtered
+        by regex (reference: gluon/block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self.params.items()
+                        if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append("  (%s): %s" % (name, child_repr))
+        lines.append(")")
+        return "\n".join(lines)
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    # -- save / load -------------------------------------------------------
+    def save_parameters(self, filename):
+        """Reference: gluon/block.py:315 save_parameters."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray import utils as nd_utils
+        nd_utils.save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        """Reference: gluon/block.py:357 load_parameters."""
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise IOError("Parameter %s missing in %s"
+                                  % (name, filename))
+        for name, arr in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise IOError("Parameter %s in file %s is unexpected"
+                                  % (name, filename))
+                continue
+            p = params[name]
+            if p._data is None:
+                p._set_shape_from(arr.shape)
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx)
+            p.set_data(arr.as_in_context(p.data().context)
+                       if p._data is not None else arr)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary table
+        (reference: gluon/block.py summary)."""
+        rows = []
+
+        def make_hook(name):
+            def hook(block, _in, out):
+                first = out[0] if isinstance(out, (list, tuple)) else out
+                n_params = sum(
+                    _shape_size(p.shape)
+                    for p in block._reg_params.values() if p.shape)
+                rows.append((name, type(block).__name__,
+                             tuple(getattr(first, "shape", ())), n_params))
+            return hook
+
+        handles = []
+        def attach(block, path):
+            h = block.register_forward_hook(make_hook(path or block.name))
+            handles.append((block, h))
+            for cname, child in block._children.items():
+                attach(child, (path + "." if path else "") + cname)
+        attach(self, "")
+        try:
+            self(*inputs)
+        finally:
+            for block, h in handles:
+                block._forward_hooks.remove(h)
+        header = ("%-28s %-20s %-20s %10s" %
+                  ("Layer (path)", "Type", "Output Shape", "Params"))
+        lines = [header, "-" * len(header)]
+        total = 0
+        for name, typ, shape, n in rows:
+            total += n
+            lines.append("%-28s %-20s %-20s %10d" % (name, typ, shape, n))
+        lines.append("-" * len(header))
+        lines.append("Total params: %d" % total)
+        print("\n".join(lines))
+
+
+def _shape_size(shape):
+    n = 1
+    for s in shape:
+        n *= max(s, 0)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# CachedOp: jit-compiled whole-block forward (reference:
+# src/imperative/cached_op.cc:835 + gluon/block.py:750 _build_cache)
+# ---------------------------------------------------------------------------
+
+_cached_op_counter = [0]
+
+
+class CachedOp(object):
+    """Compiles ``block(*inputs)`` into one jitted pure function.
+
+    The pure function signature is ``fn(key, *param_vals, *input_vals)``;
+    outputs are ``(*real_outputs, *aux_writes)``. It is registered in the
+    op registry under a unique name so the autograd tape reuses the same
+    cached-vjp machinery as primitive ops.
+    """
+
+    def __init__(self, block):
+        self._block = block
+        _cached_op_counter[0] += 1
+        self._uid = _cached_op_counter[0]
+        # one op registration per train/predict mode
+        self._modes = {}
+
+    def _params(self):
+        return list(self._block.collect_params().values())
+
+    def _ensure_mode(self, train_mode, params, param_vals, input_vals):
+        """Build + register the pure function for one train/predict mode.
+
+        An abstract discovery pass (jax.eval_shape — zero FLOPs) fixes the
+        output arity and the order of aux-state writes before the real jit
+        trace, so the registered op has a static signature."""
+        import jax
+        from .. import autograd
+        from ..ops import registry as _reg
+
+        mode_key = bool(train_mode)
+        if mode_key in self._modes:
+            return self._modes[mode_key]
+
+        block = self._block
+        n_params = len(params)
+
+        def run_block(key, vals):
+            from .. import random as _random
+            overrides = {id(p): NDArray(v)
+                         for p, v in zip(params, vals[:n_params])}
+            in_nd = [NDArray(v) for v in vals[n_params:]]
+            with autograd._RecordingScope(False, mode_key), \
+                    _ParamTraceScope(overrides) as scope, \
+                    _random.trace_scope(key):
+                out = block.forward(*in_nd)
+            is_list = isinstance(out, (list, tuple))
+            outs = list(out) if is_list else [out]
+            out_vals = tuple(o._data for o in outs)
+            writes = [(pid, pw[1]._data) for pid, pw in scope.writes.items()]
+            return out_vals, writes, is_list
+
+        # discovery pass: abstract trace to fix arity + aux write order
+        box = {}
+
+        def discover(key, *vals):
+            out_vals, writes, is_list = run_block(key, vals)
+            box["aux_ids"] = [pid for pid, _w in writes]
+            box["is_list"] = is_list
+            box["n_real"] = len(out_vals)
+            return out_vals + tuple(w for _pid, w in writes)
+
+        key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        jax.eval_shape(discover, key_aval, *(param_vals + input_vals))
+        aux_ids = box["aux_ids"]
+
+        def pure_fn(key, *vals):
+            out_vals, writes, _is_list = run_block(key, vals)
+            w = dict(writes)
+            return out_vals + tuple(w[pid] for pid in aux_ids)
+
+        name = "_cached_op_%d_%s" % (self._uid,
+                                     "train" if mode_key else "predict")
+        n_total = box["n_real"] + len(aux_ids)
+        # register so autograd._vjp_fn caches a jitted vjp for this op
+        opdef = _reg.OpDef(name, pure_fn, num_outputs=n_total, needs_rng=True)
+        _reg._REGISTRY[name] = opdef
+        info = {"name": name, "opdef": opdef, "aux_ids": aux_ids,
+                "n_real": box["n_real"], "is_list": box["is_list"]}
+        self._modes[mode_key] = info
+        return info
+
+    def __call__(self, *inputs):
+        import jax
+        from .. import autograd, random as _random
+        from ..ops import registry as _reg
+
+        params = self._params()
+        for p in params:
+            p._check_initialized()
+        param_vals = tuple(p._data._data for p in params)
+        input_vals = tuple(x._data for x in inputs)
+        train_mode = autograd.is_training()
+        info = self._ensure_mode(train_mode, params, param_vals, input_vals)
+
+        key = _random.next_key()
+        arrays = (key,) + param_vals + input_vals
+        raw_out = _reg.invoke_raw(info["opdef"], arrays, {})
+
+        ctx = inputs[0].context if inputs else current_context()
+        n_real = info["n_real"]
+        outs = [NDArray(o, ctx=ctx) for o in raw_out[:n_real]]
+
+        # apply captured aux writes (BatchNorm moving stats)
+        id2param = {id(p): p for p in params}
+        for pid, val in zip(info["aux_ids"], raw_out[n_real:]):
+            id2param[pid]._apply_raw(val)
+
+        if autograd.is_recording():
+            nd_inputs = [p._data for p in params] + list(inputs)
+            all_out = outs + [NDArray(o, ctx=ctx) for o in raw_out[n_real:]]
+            autograd.record_op(info["opdef"], {}, nd_inputs, all_out, key=key)
+
+        if info["is_list"]:
+            return outs
+        return outs[0]
+
+
+class HybridBlock(Block):
+    """A Block compilable into one XLA program
+    (reference: gluon/block.py:750 HybridBlock)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridBlock, self).__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cached_op = None
+        super(HybridBlock, self).hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super(HybridBlock, self).cast(dtype)
+
+    def infer_shape(self, *args):
+        """Complete deferred parameter shapes from input shapes by running
+        forward under an abstract (shape-only) trace — no FLOPs. Layers
+        whose parameter shapes depend on inputs (Dense/Conv/BatchNorm/…)
+        override this with a direct shape computation."""
+        import jax
+
+        def probe(*vals):
+            from .. import autograd
+            nd_in = [NDArray(v) for v in vals]
+            with autograd._RecordingScope(False, False), _ShapeProbeScope():
+                out = self.forward(*nd_in)
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out)
+            return out._data
+
+        jax.eval_shape(probe, *[x._data for x in args])
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except DeferredInitializationError:
+            raise
+        except Exception as e:  # pragma: no cover
+            raise ValueError(
+                "Deferred initialization failed because shape inference "
+                "failed: %s. Consider specifying input sizes explicitly."
+                % e)
+
+    def __call__(self, *args):
+        return super(HybridBlock, self).__call__(*args)
+
+    def forward(self, *args):
+        """Gather registered params and dispatch to hybrid_forward; with
+        hybridize() active, route through the CachedOp."""
+        from .. import ndarray as F
+
+        if self._active and not _in_cached_trace() and not _in_shape_probe():
+            if self._cached_op is None:
+                # finish deferred init first (may need a shape pass)
+                try:
+                    for p in self.collect_params().values():
+                        p._check_initialized()
+                except DeferredInitializationError:
+                    self._finish_deferred(args)
+                self._cached_op = CachedOp(self)
+            return self._cached_op(*args)
+
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(args)
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **params)
+
+    def _finish_deferred(self, args):
+        self._deferred_infer_shape(*args)
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export for serving: symbol json + params file
+        (reference: gluon/block.py:870 export). The symbol is rebuilt by
+        tracing hybrid_forward with symbol variables."""
+        sym = self._trace_symbol()
+        sym.save("%s-symbol.json" % path)
+        from ..ndarray import utils as nd_utils
+        arg_dict = {}
+        for name, p in self.collect_params().items():
+            arg_dict[("aux:%s" if p.grad_req == "null" else "arg:%s") % name] \
+                = p.data()
+        nd_utils.save("%s-%04d.params" % (path, epoch), arg_dict)
+        return sym
+
+    def _trace_symbol(self, n_inputs=1):
+        from .. import symbol as sym_mod
+        inputs = [sym_mod.var("data%d" % i if i else "data")
+                  for i in range(n_inputs)]
+        out = self._symbol_forward(*inputs)
+        if isinstance(out, (list, tuple)):
+            return sym_mod.Group(out)
+        return out
+
+    def _symbol_forward(self, *inputs):
+        from .. import symbol as sym_mod
+
+        def walk(block, args):
+            params = {name: p.var() for name, p in block._reg_params.items()}
+            with _SymbolTraceScope():
+                return block.hybrid_forward(sym_mod, *args, **params)
+        return walk(self, inputs)
+
+
+_symbol_trace = threading.local()
+
+
+class _SymbolTraceScope(object):
+    def __enter__(self):
+        _symbol_trace.active = getattr(_symbol_trace, "active", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _symbol_trace.active -= 1
+
+
+def _in_symbol_trace():
+    return getattr(_symbol_trace, "active", 0) > 0
+
+
+_cached_trace = threading.local()
+
+
+def _in_cached_trace():
+    from .parameter import _active_trace
+    return _active_trace() is not None
+
+
+from .parameter import _ShapeProbeScope, _in_shape_probe  # noqa: E402
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a pre-built Symbol as a Block
+    (reference: gluon/block.py:954 SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super(SymbolBlock, self).__init__(prefix="", params=params)
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._outputs_sym = outputs
+        self._input_names = [i.name if hasattr(i, "name") else str(i)
+                             for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in list(arg_names) + sorted(aux_names):
+            if name not in self._input_names:
+                p = self.params.get(
+                    name, allow_deferred_init=True,
+                    grad_req="null" if name in aux_names else "write")
+                self._reg_params[name] = p
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Reference: gluon/block.py SymbolBlock.imports."""
+        from .. import symbol as sym_mod
+        sym = sym_mod.load(symbol_file)
+        if not isinstance(input_names, (list, tuple)):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            from ..ndarray import utils as nd_utils
+            loaded = nd_utils.load(param_file)
+            cleaned = {}
+            for k, v in loaded.items():
+                if k.startswith(("arg:", "aux:")):
+                    k = k[4:]
+                cleaned[k] = v
+            for name, p in block._reg_params.items():
+                if name in cleaned:
+                    if p._data is None:
+                        p._set_shape_from(cleaned[name].shape)
+                        p._deferred_init = (None, ctx, None)
+                        from .. import initializer as init_mod
+                        p._deferred_init = (init_mod.Zero(), ctx,
+                                            init_mod.Zero())
+                        p._finish_deferred_init()
+                    p.set_data(cleaned[name])
+        return block
+
+    def forward(self, *args):
+        from .. import autograd, random as _random
+        if not _in_cached_trace() and not _in_shape_probe():
+            # always route through the CachedOp (a pre-built symbol IS a
+            # graph — run it as one compiled program, with tape support)
+            try:
+                for p in self._reg_params.values():
+                    p._check_initialized()
+            except DeferredInitializationError:
+                self._infer_from_inputs(args)
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self)
+            return self._cached_op(*args)
+
+        # inside the trace: evaluate the symbol graph on tracer values
+        from ..symbol.symbol import _graph_eval_fn
+        env = {}
+        for name, x in zip(self._input_names, args):
+            env[name] = x._data
+        for name, p in self._reg_params.items():
+            env[name] = p.data()._data
+        fn = _graph_eval_fn(self._outputs_sym, is_train=autograd.is_training())
+        outs, new_aux = fn(env, _random.next_key())
+        for name, val in new_aux.items():
+            if name in self._reg_params:
+                self._reg_params[name].set_data(NDArray(val))
+        outs = [NDArray(o) for o in outs]
+        return outs if len(outs) > 1 else outs[0]
+
+    def _infer_from_inputs(self, args):
+        kwargs = {n: x.shape for n, x in zip(self._input_names, args)}
+        arg_shapes, _o, aux_shapes = self._outputs_sym.infer_shape(**kwargs)
+        arg_names = self._outputs_sym.list_arguments()
+        aux_names = self._outputs_sym.list_auxiliary_states()
+        for n, s in list(zip(arg_names, arg_shapes)) + \
+                list(zip(aux_names, aux_shapes)):
+            if n in self._reg_params:
+                p = self._reg_params[n]
+                if p._data is None:
+                    p._set_shape_from(s)
+                    if p._deferred_init is None:
+                        from .. import initializer as init_mod
+                        p._deferred_init = (None, None, init_mod.Uniform())
+                    p._finish_deferred_init()
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise AttributeError("SymbolBlock has no hybrid_forward")

@@ -1,0 +1,149 @@
+"""Gluon DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py:55-112 (multiprocessing
+workers + shared-memory NDArray transport) and src/io/iter_prefetcher.h
+(engine-async double buffering).
+
+TPU-native design: workers batchify into **numpy** (host) arrays; the
+main thread converts to device arrays, so device transfer stays on the
+dispatch thread (PjRt requirement) while decode/augment parallelism comes
+from the worker pool. A prefetch queue of ready batches gives the
+double-buffering the reference gets from PrefetcherIter.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py
+    default_batchify_fn). Produces numpy; the loader converts to device
+    arrays on the main thread."""
+    if isinstance(data[0], NDArray):
+        return _np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    return _np.asarray(data)
+
+
+def _as_device(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_as_device(b) for b in batch]
+    if isinstance(batch, _np.ndarray):
+        return array(batch, dtype=batch.dtype)
+    return batch
+
+
+class _Worker(threading.Thread):
+    """Prefetch worker: pulls index batches, produces numpy batches."""
+
+    def __init__(self, dataset, batchify_fn, in_q, out_q):
+        super().__init__(daemon=True)
+        self._dataset = dataset
+        self._batchify_fn = batchify_fn
+        self._in_q = in_q
+        self._out_q = out_q
+
+    def run(self):
+        while True:
+            item = self._in_q.get()
+            if item is None:
+                break
+            seq, indices = item
+            try:
+                batch = self._batchify_fn(
+                    [self._dataset[i] for i in indices])
+                self._out_q.put((seq, batch, None))
+            except Exception as e:  # propagate to the consumer
+                self._out_q.put((seq, None, e))
+
+
+class DataLoader(object):
+    """Loads batches from a Dataset (reference: dataloader.py DataLoader).
+
+    num_workers>0 uses a thread pool (image decode in numpy releases the
+    GIL for the hot loops; JAX device transfer must stay on one thread —
+    the reference's analogous constraint is engine-thread affinity).
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch must not be "
+                "specified if batch_sampler is")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield _as_device(self._batchify_fn(
+                    [self._dataset[i] for i in indices]))
+            return
+
+        in_q = _queue.Queue()
+        out_q = _queue.Queue()
+        workers = [_Worker(self._dataset, self._batchify_fn, in_q, out_q)
+                   for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        try:
+            it = iter(self._batch_sampler)
+            sent = 0
+            for _ in range(self._prefetch or self._num_workers):
+                try:
+                    in_q.put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    break
+            received = 0
+            buffered = {}
+            while received < sent:
+                while received not in buffered:
+                    seq, batch, err = out_q.get()
+                    buffered[seq] = (batch, err)
+                batch, err = buffered.pop(received)
+                received += 1
+                try:
+                    in_q.put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    pass
+                if err is not None:
+                    raise err
+                yield _as_device(batch)
+        finally:
+            for _ in workers:
+                in_q.put(None)
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,203 @@
+"""Gluon vision datasets.
+
+Reference: python/mxnet/gluon/data/vision/datasets.py (MNIST,
+FashionMNIST, CIFAR10/100, ImageRecordDataset, ImageFolderDataset).
+
+This build has zero network egress: datasets parse the standard on-disk
+formats from ``root`` and raise with staging instructions if absent.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from ....base import MXNetError
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        from ....ndarray.ndarray import array
+        data = array(self._data[idx], dtype=self._data.dtype)
+        if self._transform is not None:
+            return self._transform(data, self._label[idx])
+        return data, self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from the standard IDX files
+    (reference: datasets.py MNIST; format parsed like src/io/iter_mnist.cc)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        img_path = self._find(files[0])
+        lbl_path = self._find(files[1])
+        with self._open(lbl_path) as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            self._label = _np.frombuffer(f.read(), dtype=_np.uint8) \
+                .astype(_np.int32)
+        with self._open(img_path) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            self._data = data.reshape(num, rows, cols, 1)
+
+    def _find(self, name):
+        for cand in (name, name + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise MXNetError(
+            "MNIST file %s not found under %s (no network egress; stage "
+            "the IDX files manually)" % (name, self._root))
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python-pickle batches
+    (reference: datasets.py CIFAR10)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return ["data_batch_%d" % i for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        datas, labels = [], []
+        for name in self._batches():
+            p = os.path.join(base, name)
+            if not os.path.exists(p):
+                raise MXNetError(
+                    "CIFAR batch %s not found under %s (no network "
+                    "egress; stage the dataset manually)" % (name, base))
+            with open(p, "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            datas.append(_np.asarray(batch["data"], dtype=_np.uint8)
+                         .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            labels.append(_np.asarray(
+                batch.get("labels", batch.get("fine_labels")),
+                dtype=_np.int32))
+        self._data = _np.concatenate(datas)
+        self._label = _np.concatenate(labels)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=True,
+                 train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        return ["train"] if self._train else ["test"]
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from a RecordIO pack
+    (reference: datasets.py ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from .... import recordio
+        self._record = None
+        self._filename = filename
+        self._flag = flag
+        self._transform = transform
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        from .... import image, recordio
+        rec = self._record.read_idx(self._record.keys[idx])
+        header, img = recordio.unpack(rec)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record.keys)
+
+
+class ImageFolderDataset(Dataset):
+    """Folder-per-class image dataset
+    (reference: datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png", ".npy")
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith(self._exts):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            from ....ndarray.ndarray import array
+            img = array(_np.load(path))
+        else:
+            with open(path, "rb") as f:
+                img = image.imdecode(f.read(), self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,215 @@
+"""Gluon vision transforms.
+
+Reference: python/mxnet/gluon/data/vision/transforms.py (Compose, Cast,
+ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop, flips,
+color jitter). Image tensors are HWC uint8 in, like the reference.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomColorJitter", "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super(Compose, self).__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super(Cast, self).__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]
+    (reference: transforms.py ToTensor; op src/operator/image/)."""
+
+    def hybrid_forward(self, F, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW input
+    (reference: transforms.py Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super(Normalize, self).__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        from ....ndarray.ndarray import array
+        mean = _np.asarray(self._mean, dtype=_np.float32)
+        std = _np.asarray(self._std, dtype=_np.float32)
+        extra = (1,) * (x.ndim - 3)
+        mean = array(mean.reshape(extra + (-1, 1, 1))
+                     if mean.ndim else mean.reshape(()))
+        std = array(std.reshape(extra + (-1, 1, 1))
+                    if std.ndim else std.reshape(()))
+        return (x - mean) / std
+
+
+class Resize(Block):
+    """Bilinear resize HWC image (reference: transforms.py Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super(Resize, self).__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from .... import image
+        if isinstance(self._size, int):
+            if self._keep:
+                h, w = x.shape[0], x.shape[1]
+                if h < w:
+                    new_h, new_w = self._size, int(w * self._size / h)
+                else:
+                    new_h, new_w = int(h * self._size / w), self._size
+            else:
+                new_h = new_w = self._size
+        else:
+            new_w, new_h = self._size
+        return image.imresize(x, new_w, new_h)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super(CenterCrop, self).__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        from .... import image
+        w, h = self._size
+        return image.center_crop(x, (w, h))[0]
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize
+    (reference: transforms.py RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation=1):
+        super(RandomResizedCrop, self).__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import image
+        w, h = self._size
+        return image.random_size_crop(x, (w, h), self._scale, self._ratio)[0]
+
+
+class _RandomApply(Block):
+    def forward(self, x):
+        raise NotImplementedError
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _pyrandom.random() < 0.5:
+            from .... import ndarray as nd
+            return nd.reverse(x, axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _pyrandom.random() < 0.5:
+            from .... import ndarray as nd
+            return nd.reverse(x, axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super(RandomBrightness, self).__init__()
+        self._brightness = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._brightness, self._brightness)
+        return x * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super(RandomContrast, self).__init__()
+        self._contrast = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._contrast, self._contrast)
+        gray = x.astype("float32").mean()
+        return x * alpha + gray * (1.0 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super(RandomSaturation, self).__init__()
+        self._saturation = saturation
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        alpha = 1.0 + _pyrandom.uniform(-self._saturation, self._saturation)
+        gray = nd.mean(x.astype("float32"), axis=-1, keepdims=True)
+        return x * alpha + gray * (1.0 - alpha)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super(RandomColorJitter, self).__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = list(self._transforms)
+        _pyrandom.shuffle(order)
+        for t in order:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise
+    (reference: transforms.py RandomLighting)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def __init__(self, alpha):
+        super(RandomLighting, self).__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from ....ndarray.ndarray import array
+        alpha = _np.random.normal(0, self._alpha, size=(3,)) \
+            .astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return x + array(rgb)

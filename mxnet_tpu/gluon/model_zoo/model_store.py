@@ -1,0 +1,31 @@
+"""Pretrained-weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+This build runs with zero network egress: pretrained weights resolve only
+from a local directory (``MXNET_HOME/models``)."""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root="~/.mxnet/models"):
+    root = os.path.expanduser(root)
+    path = os.path.join(root, "%s.params" % name)
+    if os.path.exists(path):
+        return path
+    raise MXNetError(
+        "Pretrained model file %s.params is not present under %s and this "
+        "environment has no network egress. Stage the weights manually or "
+        "construct the model with pretrained=False." % (name, root))
+
+
+def purge(root="~/.mxnet/models"):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,462 @@
+"""Gluon basic neural-network layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py (702 LoC: Sequential,
+Dense, Dropout, BatchNorm, Embedding, Flatten, InstanceNorm, LayerNorm,
+Lambda, HybridLambda) + activations.py.
+
+Each layer is a HybridBlock whose ``hybrid_forward`` calls the declarative
+op registry (XLA kernels); hybridizing any enclosing block compiles the
+whole stack into one program.
+"""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+from ... import initializer as init
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+           "BatchNorm", "InstanceNorm", "LayerNorm", "Flatten", "Lambda",
+           "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU", "SELU",
+           "Swish", "GELU"]
+
+
+class Sequential(Block):
+    """Stack of Blocks executed sequentially
+    (reference: basic_layers.py Sequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(Sequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)) and len(x) == 1:
+                x = x[0]
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    """Stack of HybridBlocks, compilable as one program
+    (reference: basic_layers.py HybridSequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridSequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer: ``act(dot(x, w.T) + b)``
+    (reference: basic_layers.py Dense; op: FullyConnected,
+    src/operator/nn/fully_connected.cc)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super(Dense, self).__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=_init(weight_initializer), allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=_init(bias_initializer), allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x, *args):
+        in_units = x.shape[-1] if not self._flatten else \
+            _prod(x.shape[1:])
+        self.weight._set_shape_from((self._units, in_units))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if bias is None:
+            out = F.FullyConnected(x, weight, num_hidden=self._units,
+                                   no_bias=True, flatten=self._flatten)
+        else:
+            out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                                   no_bias=False, flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return "Dense(%s -> %s, %s)" % (
+            shape[1] if shape and len(shape) > 1 else None, shape[0],
+            "linear" if self.act is None else self.act._act_type)
+
+
+class Dropout(HybridBlock):
+    """Dropout regularization (reference: basic_layers.py Dropout;
+    op semantics src/operator/nn/dropout-inl.h — active only in
+    train mode, scaled by 1/(1-p))."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super(Dropout, self).__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        if self._rate <= 0:
+            return x
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return "Dropout(p = %s, axes=%s)" % (self._rate, self._axes)
+
+
+class Embedding(HybridBlock):
+    """Index → dense vector lookup (reference: basic_layers.py Embedding;
+    op src/operator/tensor/indexing_op.cc Embedding)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super(Embedding, self).__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._dtype = dtype
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=_init(weight_initializer),
+                grad_stype="row_sparse" if sparse_grad else "default")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim, dtype=self._dtype)
+
+    def __repr__(self):
+        return "Embedding(%d -> %d, %s)" % (
+            self._input_dim, self._output_dim, self._dtype)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with moving-stat aux states (reference:
+    basic_layers.py BatchNorm; op src/operator/nn/batch_norm.cc). Under a
+    CachedOp the moving-stat updates become extra compiled outputs applied
+    after each step (functional aux threading)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super(BatchNorm, self).__init__(**kwargs)
+        self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats}
+        self._axis = axis
+        self._in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init(beta_initializer),
+                allow_deferred_init=True)
+            self.running_mean = self.params.get(
+                "running_mean", grad_req="null", shape=(in_channels,),
+                init=_init(running_mean_initializer),
+                allow_deferred_init=True, differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", grad_req="null", shape=(in_channels,),
+                init=_init(running_variance_initializer),
+                allow_deferred_init=True, differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p._set_shape_from((c,))
+
+    def cast(self, dtype):
+        if str(dtype) in ("float16", "bfloat16"):
+            dtype = "float32"   # stats stay fp32 (matches reference policy)
+        super(BatchNorm, self).cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ... import autograd
+        if autograd.is_training() and not self._kwargs["use_global_stats"]:
+            # functional moving-stat update (the reference kernel mutates
+            # aux states in place; here the new stats are explicit outputs
+            # captured by set_data — CachedOp threads them out)
+            out, mean, var = F.BatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                output_mean_var=True, **self._kwargs)
+            mom = self._kwargs["momentum"]
+            self.running_mean.set_data(running_mean * mom + mean * (1 - mom))
+            self.running_var.set_data(running_var * mom + var * (1 - mom))
+            return out
+        return F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                           **self._kwargs)
+
+    def __repr__(self):
+        in_channels = self.gamma.shape[0] if self.gamma.shape else None
+        return "BatchNorm(axis=%s, eps=%s, momentum=%s, in_channels=%s)" % (
+            self._kwargs["axis"], self._kwargs["eps"],
+            self._kwargs["momentum"], in_channels)
+
+
+class InstanceNorm(HybridBlock):
+    """Reference: basic_layers.py InstanceNorm
+    (op src/operator/instance_norm.cc)."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super(InstanceNorm, self).__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self._in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init(beta_initializer),
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma._set_shape_from((c,))
+        self.beta._set_shape_from((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        if self._axis == 1:
+            return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+        x = x.swapaxes(1, self._axis)
+        return F.InstanceNorm(x, gamma, beta,
+                              eps=self._epsilon).swapaxes(1, self._axis)
+
+    def __repr__(self):
+        return "InstanceNorm(axis=%s, eps=%s)" % (self._axis, self._epsilon)
+
+
+class LayerNorm(HybridBlock):
+    """Reference: basic_layers.py LayerNorm
+    (op src/operator/nn/layer_norm.cc)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super(LayerNorm, self).__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init(beta_initializer),
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma._set_shape_from((c,))
+        self.beta._set_shape_from((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+    def __repr__(self):
+        return "LayerNorm(axis=%s, eps=%s)" % (self._axis, self._epsilon)
+
+
+class Flatten(HybridBlock):
+    """Collapse all but the batch axis
+    (reference: basic_layers.py Flatten)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    """Wrap an arbitrary function as a Block
+    (reference: basic_layers.py Lambda)."""
+
+    def __init__(self, function, prefix=None):
+        super(Lambda, self).__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+            if not hasattr(nd, function):
+                raise MXNetError("function %r not found in ndarray" % function)
+            self._func_impl = getattr(nd, function)
+            self._func_name = function
+        else:
+            self._func_impl = function
+            self._func_name = getattr(function, "__name__", "custom")
+
+    def forward(self, *args):
+        return self._func_impl(*args)
+
+    def __repr__(self):
+        return "Lambda(%s)" % self._func_name
+
+
+class HybridLambda(HybridBlock):
+    """Reference: basic_layers.py HybridLambda."""
+
+    def __init__(self, function, prefix=None):
+        super(HybridLambda, self).__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func_name = function
+
+            def _f(F, *args):
+                return getattr(F, function)(*args)
+            self._func = _f
+        else:
+            self._func = lambda F, *args: function(F, *args)
+            self._func_name = getattr(function, "__name__", "custom")
+
+    def hybrid_forward(self, F, x, *args):
+        return self._func(F, x, *args)
+
+    def __repr__(self):
+        return "HybridLambda(%s)" % self._func_name
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: python/mxnet/gluon/nn/activations.py)
+# ---------------------------------------------------------------------------
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        self._act_type = activation
+        super(Activation, self).__init__(**kwargs)
+
+    def _alias(self):
+        return self._act_type
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return "Activation(%s)" % self._act_type
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha, **kwargs):
+        super(LeakyReLU, self).__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return "LeakyReLU(%s)" % self._alpha
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, **kwargs):
+        super(PReLU, self).__init__(**kwargs)
+        with self.name_scope():
+            self.alpha = self.params.get(
+                "alpha", shape=(1,),
+                init=_init(alpha_initializer) or init.Constant(0.25))
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super(ELU, self).__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="gelu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super(Swish, self).__init__(**kwargs)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(self._beta * x)
+
+
+def _init(i):
+    """Normalize an initializer argument (str / Initializer / None)."""
+    if i is None or isinstance(i, init.Initializer):
+        return i
+    if isinstance(i, str):
+        return init.create(i.lower())
+    raise TypeError("invalid initializer %r" % (i,))
+
+
+def _prod(shape):
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+from ...base import MXNetError  # noqa: E402  (used by Lambda)

@@ -1,0 +1,298 @@
+"""Gluon convolution & pooling layers.
+
+Reference: python/mxnet/gluon/nn/conv_layers.py (1187 LoC: Conv1D/2D/3D,
+Conv1DTranspose/2D/3D, MaxPool/AvgPool 1/2/3D, GlobalMaxPool/GlobalAvgPool,
+ReflectionPad2D).
+
+All layers use NC{D,H,W} layouts; XLA's layout assignment handles MXU
+tiling so no manual NHWC conversion is exposed.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .basic_layers import Activation, _init
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    assert len(v) == n
+    return v
+
+
+class _Conv(HybridBlock):
+    """Base convolution layer (reference: conv_layers.py _Conv)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, **kwargs):
+        super(_Conv, self).__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        ndim = len(kernel_size)
+        self._op_name = op_name
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "no_bias": not use_bias, "layout": layout}
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        if op_name == "Convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + tuple(kernel_size)
+        else:   # Deconvolution: (in_channels, channels//groups, *kernel)
+            wshape = (in_channels, channels // groups) + tuple(kernel_size)
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=_init(weight_initializer),
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=_init(bias_initializer),
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[1]
+        groups = self._kwargs["num_group"]
+        if self._op_name == "Convolution":
+            self.weight._set_shape_from(
+                (self._channels, c_in // groups) +
+                tuple(self._kwargs["kernel"]))
+        else:
+            self.weight._set_shape_from(
+                (c_in, self._channels // groups) +
+                tuple(self._kwargs["kernel"]))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            out = op(x, weight, **self._kwargs)
+        else:
+            out = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        s = "{name}({mapping}, kernel_size={kernel}, stride={stride}"
+        mapping = "{0} -> {1}".format(
+            self._in_channels if self._in_channels else None, self._channels)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        kernel=self._kwargs["kernel"],
+                        stride=self._kwargs["stride"]) + ")"
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv1D, self).__init__(
+            channels, _tup(kernel_size, 1), _tup(strides, 1), _tup(padding, 1),
+            _tup(dilation, 1), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv2D, self).__init__(
+            channels, _tup(kernel_size, 2), _tup(strides, 2), _tup(padding, 2),
+            _tup(dilation, 2), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super(Conv3D, self).__init__(
+            channels, _tup(kernel_size, 3), _tup(strides, 3), _tup(padding, 3),
+            _tup(dilation, 3), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv1DTranspose, self).__init__(
+            channels, _tup(kernel_size, 1), _tup(strides, 1), _tup(padding, 1),
+            _tup(dilation, 1), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer,
+            op_name="Deconvolution", adj=_tup(output_padding, 1), **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super(Conv2DTranspose, self).__init__(
+            channels, _tup(kernel_size, 2), _tup(strides, 2), _tup(padding, 2),
+            _tup(dilation, 2), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer,
+            op_name="Deconvolution", adj=_tup(output_padding, 2), **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv3DTranspose, self).__init__(
+            channels, _tup(kernel_size, 3), _tup(strides, 3), _tup(padding, 3),
+            _tup(dilation, 3), groups, layout, in_channels, activation,
+            use_bias, weight_initializer, bias_initializer,
+            op_name="Deconvolution", adj=_tup(output_padding, 3), **kwargs)
+
+
+class _Pooling(HybridBlock):
+    """Base pooling layer (reference: conv_layers.py _Pooling)."""
+
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, count_include_pad=None, **kwargs):
+        super(_Pooling, self).__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return "%s(size=%s, stride=%s, padding=%s, ceil_mode=%s)" % (
+            self.__class__.__name__, self._kwargs["kernel"],
+            self._kwargs["stride"], self._kwargs["pad"],
+            self._kwargs["pooling_convention"] == "full")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super(MaxPool1D, self).__init__(
+            _tup(pool_size, 1), strides if strides is None else _tup(strides, 1),
+            _tup(padding, 1), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super(MaxPool2D, self).__init__(
+            _tup(pool_size, 2), strides if strides is None else _tup(strides, 2),
+            _tup(padding, 2), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super(MaxPool3D, self).__init__(
+            _tup(pool_size, 3), strides if strides is None else _tup(strides, 3),
+            _tup(padding, 3), ceil_mode, False, "max", **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super(AvgPool1D, self).__init__(
+            _tup(pool_size, 1), strides if strides is None else _tup(strides, 1),
+            _tup(padding, 1), ceil_mode, False, "avg", count_include_pad,
+            **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super(AvgPool2D, self).__init__(
+            _tup(pool_size, 2), strides if strides is None else _tup(strides, 2),
+            _tup(padding, 2), ceil_mode, False, "avg", count_include_pad,
+            **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super(AvgPool3D, self).__init__(
+            _tup(pool_size, 3), strides if strides is None else _tup(strides, 3),
+            _tup(padding, 3), ceil_mode, False, "avg", count_include_pad,
+            **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super(GlobalMaxPool1D, self).__init__(
+            (1,), None, (0,), True, True, "max", **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super(GlobalMaxPool2D, self).__init__(
+            (1, 1), None, (0, 0), True, True, "max", **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super(GlobalMaxPool3D, self).__init__(
+            (1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super(GlobalAvgPool1D, self).__init__(
+            (1,), None, (0,), True, True, "avg", **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super(GlobalAvgPool2D, self).__init__(
+            (1, 1), None, (0, 0), True, True, "avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super(GlobalAvgPool3D, self).__init__(
+            (1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reference: conv_layers.py ReflectionPad2D (op: Pad reflect mode)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super(ReflectionPad2D, self).__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        return F.Pad(x, mode="reflect", pad_width=self._padding)

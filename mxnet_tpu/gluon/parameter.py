@@ -1,0 +1,478 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (Parameter with deferred shape
+init, ParameterDict with prefix scoping).
+
+TPU-native design: a Parameter owns one NDArray (a jax.Array underneath);
+"contexts" need no per-device replica list because multi-device placement
+is expressed with shardings at the trainer/CachedOp level, not by manual
+copies. Deferred initialization (shape dims of 0 resolved from the first
+batch) is preserved because it is API-visible behavior.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from .. import initializer as init_mod
+from ..ndarray.ndarray import NDArray, zeros
+
+__all__ = ["Parameter", "ParameterDict", "Constant",
+           "DeferredInitializationError", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization
+    (reference: gluon/parameter.py DeferredInitializationError)."""
+
+
+# Trace-time parameter substitution: when a CachedOp traces a block, every
+# Parameter.data() inside the trace must return the trace argument (a
+# tracer-backed NDArray), not the concrete stored value — otherwise weights
+# would be baked into the compiled executable as constants.
+_trace = threading.local()
+
+
+def _trace_stack():
+    if not hasattr(_trace, "stack"):
+        _trace.stack = []
+    return _trace.stack
+
+
+class _ParamTraceScope:
+    """Maps Parameter -> substituted NDArray during a CachedOp trace and
+    records in-place writes (BatchNorm moving stats) as dirty outputs."""
+
+    def __init__(self, overrides):
+        self.overrides = dict(overrides)   # id(param) -> NDArray
+        self.writes = OrderedDict()        # id(param) -> (param, NDArray)
+
+    def __enter__(self):
+        _trace_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _trace_stack().pop()
+
+
+def _active_trace():
+    stack = _trace_stack()
+    return stack[-1] if stack else None
+
+
+class _ShapeProbeScope:
+    """Active while a Block's shapes are inferred under jax.eval_shape.
+    In probe mode parameters are never *materialized* — only their shapes
+    are completed; ``data()`` yields abstract placeholders so no tracer
+    can leak into persistent state."""
+
+    def __enter__(self):
+        _trace.probe = getattr(_trace, "probe", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _trace.probe -= 1
+
+
+def _in_shape_probe():
+    return getattr(_trace, "probe", 0) > 0
+
+
+class Parameter(object):
+    """A Block parameter (reference: gluon/parameter.py:37).
+
+    Holds the value, its gradient buffer, and the metadata needed for
+    (possibly deferred) initialization.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = np_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError("invalid stype %r" % (stype,))
+        self._stype = stype
+        self._data = None
+        self._deferred_init = None   # (init, ctx, default_init)
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
+
+    # -- grad_req ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("grad_req must be write/add/null, got %r" % req)
+        self._grad_req = req
+        if self._data is not None:
+            from .. import autograd
+            if req == "null":
+                self._data.grad = None
+                self._data._ag_node = None
+            else:
+                autograd.mark_variable(self._data, req)
+
+    # -- initialization ----------------------------------------------------
+    def _shape_complete(self):
+        return (self.shape is not None and len(self.shape) > 0
+                and all(s > 0 for s in self.shape))
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize value (+grad) arrays
+        (reference: gluon/parameter.py initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        ctx = ctx or current_context()
+        if not self._shape_complete():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape %s. Specify in_units/in_channels etc. or set "
+                "allow_deferred_init." % (self.name, self.shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        arr = zeros(self.shape, ctx=ctx, dtype=self.dtype)
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        desc = init_mod.InitDesc(self.name, global_init=default_init)
+        initializer(desc, arr)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            from .. import autograd
+            autograd.mark_variable(self._data, self._grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if _in_shape_probe():
+            # probe completes shapes only; materialization happens on the
+            # first real forward
+            return
+        if not self._shape_complete():
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s and shape inference "
+                "did not complete it." % (self.name, self.shape))
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _set_shape_from(self, shape):
+        """Complete deferred dims (0 entries) from an inferred shape."""
+        shape = tuple(int(s) for s in shape)
+        if self.shape is None or len(self.shape) == 0:
+            self.shape = shape
+        else:
+            if len(shape) != len(self.shape):
+                raise ValueError(
+                    "inferred shape %s incompatible with declared %s for %s"
+                    % (shape, self.shape, self.name))
+            merged = []
+            for a, b in zip(self.shape, shape):
+                if a > 0 and b > 0 and a != b:
+                    raise ValueError(
+                        "inferred shape %s incompatible with declared %s "
+                        "for %s" % (shape, self.shape, self.name))
+                merged.append(a if a > 0 else b)
+            self.shape = tuple(merged)
+
+    # -- access ------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because "
+                "initialization was deferred. Run a forward pass first."
+                % self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. You should initialize "
+            "parameters with Block.initialize()." % self.name)
+
+    def data(self, ctx=None):
+        """Return the value NDArray. Inside a CachedOp trace this returns
+        the substituted tracer argument (see _ParamTraceScope)."""
+        scope = _active_trace()
+        if scope is not None:
+            if id(self) in scope.writes:
+                return scope.writes[id(self)][1]
+            sub = scope.overrides.get(id(self))
+            if sub is not None:
+                return sub
+        if _in_shape_probe() and self._data is None:
+            if self._shape_complete():
+                import jax.numpy as jnp
+                return NDArray(jnp.zeros(self.shape, dtype=self.dtype))
+            raise DeferredInitializationError(
+                "Parameter %s shape unknown during shape probe" % self.name)
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad_req == "null":
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            g = self._data.grad
+            g._set_data(zeros(g.shape, ctx=g.context, dtype=g.dtype)._data)
+
+    def set_data(self, data):
+        """Set the value. Inside a CachedOp trace, the write is captured
+        and replayed after the compiled call (aux-state updates)."""
+        scope = _active_trace()
+        if scope is not None and (id(self) in scope.overrides
+                                  or id(self) in scope.writes):
+            if not isinstance(data, NDArray):
+                raise TypeError("set_data expects NDArray")
+            scope.writes[id(self)] = (self, data)
+            return
+        if _in_shape_probe() and self._data is None:
+            self._set_shape_from(data.shape)
+            return
+        if self._data is None:
+            if self._deferred_init is not None:
+                self._set_shape_from(data.shape)
+                self._finish_deferred_init()
+            else:
+                raise RuntimeError(
+                    "Parameter %s has not been initialized" % self.name)
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data._set_data(data)
+
+    def _apply_raw(self, raw):
+        """Internal: swap in a raw jax array (trainer fast path)."""
+        self._data._set_data(raw)
+
+    def reset_ctx(self, ctx):
+        self._check_initialized()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        self._data._set_data(self._data.as_in_context(ctx)._data)
+        self._data._ctx = ctx
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data.grad is not None
+            self._data._set_data(self._data.astype(dtype)._data)
+            if had_grad:
+                from .. import autograd
+                autograd.mark_variable(self._data, self._grad_req)
+
+    def var(self):
+        """Symbol variable for this parameter (reference: parameter.py var)."""
+        from ..symbol import var as sym_var
+        return sym_var(self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def stype(self):
+        return self._stype
+
+
+class Constant(Parameter):
+    """A constant, non-trained parameter
+    (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            from ..ndarray.ndarray import array
+            value = array(_np.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(_self, _name, arr):
+                arr[:] = value
+
+        super(Constant, self).__init__(
+            name, grad_req="null", shape=value.shape, dtype=value.dtype,
+            init=_CInit())
+
+
+class ParameterDict(object):
+    """A prefix-scoped dictionary of Parameters
+    (reference: gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join("  %r" % p for p in self._params.values())
+        return "ParameterDict %r (\n%s\n)" % (self._prefix, s)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve ``prefix+name``, merging attributes
+        (reference: parameter.py get)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    if param.shape is not None:
+                        v = tuple(v)
+                        if len(v) == len(param.shape):
+                            merged = tuple(
+                                a if a > 0 else b
+                                for a, b in zip(param.shape, v))
+                            param.shape = merged
+                    else:
+                        param.shape = tuple(v)
+                elif k == "dtype" and v is not None:
+                    param.dtype = np_dtype(v)
+                elif v is not None and getattr(param, k, None) in (None,):
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("no constant named %s" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(
+                    "Cannot update because parameter %r exists with a "
+                    "different Parameter object" % k)
+            self._params[k] = v
+
+    # -- bulk operations ---------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for p in self._params.values():
+            p.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import utils as nd_utils
+        arg_dict = {}
+        for p in self._params.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = p.data()
+        nd_utils.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise IOError(
+                        "Parameter %s is missing in file %s"
+                        % (name, filename))
+        for name, arr in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError(
+                        "Parameter %s loaded from %s is not present in this "
+                        "ParameterDict" % (name, filename))
+                continue
+            p = self._params[name]
+            if p._data is None:
+                p._set_shape_from(arr.shape)
+                p.dtype = arr.dtype
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx, default_init=init_mod.Zero())
+            p.set_data(arr)

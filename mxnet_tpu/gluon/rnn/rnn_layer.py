@@ -1,0 +1,207 @@
+"""Gluon fused recurrent layers (RNN / LSTM / GRU).
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py — thin wrappers over the
+fused RNN op (src/operator/rnn.cc → here a lax.scan program, ops/nn.py
+``RNN``). Parameters are kept per-layer/per-direction (MXNet naming
+``{l,r}{i}_{i2h,h2h}_{weight,bias}``) and concatenated into the op's flat
+cuDNN-style layout at call time.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.basic_layers import _init
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super(_RNNLayer, self).__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), "Invalid layout %s" % layout
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        hout = projection_size if projection_size else hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(
+                    "%s%d_i2h_weight" % (j, i), (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    "%s%d_h2h_weight" % (j, i), (ng * nh, hout),
+                    h2h_weight_initializer)
+                self._register_param(
+                    "%s%d_i2h_bias" % (j, i), (ng * nh,),
+                    i2h_bias_initializer)
+                self._register_param(
+                    "%s%d_h2h_bias" % (j, i), (ng * nh,),
+                    h2h_bias_initializer)
+                if projection_size:
+                    self._register_param(
+                        "%s%d_h2r_weight" % (j, i), (projection_size, nh),
+                        h2h_weight_initializer)
+            ni = hout * self._dir
+
+    def _register_param(self, name, shape, init_arg):
+        p = self.params.get(name, shape=shape, init=_init(init_arg),
+                            allow_deferred_init=True)
+        self._reg_params[name] = p
+        setattr(self, name, p)
+
+    def __repr__(self):
+        mapping = "%s -> %s" % (self._input_size or None, self._hidden_size)
+        return "%s(%s, %s, layers=%s%s)" % (
+            self.__class__.__name__, mapping, self._layout, self._num_layers,
+            ", bidirectional" if self._dir == 2 else "")
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        hout = self._projection_size or self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = self._reg_params["%s%d_i2h_weight" % (j, i)]
+                p._set_shape_from((self._gates * self._hidden_size, ni))
+            ni = hout * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (reference: rnn_layer.py begin_state)."""
+        from ... import ndarray as nd
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **kwargs))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        flat = self._flatten_params(F, params)
+        rnn_args = [inputs, flat] + list(states)
+        kwargs = dict(state_size=self._hidden_size,
+                      num_layers=self._num_layers,
+                      bidirectional=self._dir == 2, mode=self._mode,
+                      p=self._dropout, state_outputs=True)
+        if self._projection_size:
+            kwargs["projection_size"] = self._projection_size
+        out = F.RNN(*rnn_args, **kwargs)
+        outputs, states_out = out[0], list(out[1:])
+        if self._layout == "NTC":
+            outputs = outputs.swapaxes(0, 1)
+        if skip_states:
+            return outputs
+        return outputs, states_out
+
+    def _flatten_params(self, F, params):
+        """Concat per-layer params into the fused op's flat layout
+        (per layer, per dir: W, R, bW, bR[, P])."""
+        chunks = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                chunks.append(params["%s%d_i2h_weight" % (j, i)].reshape((-1,)))
+                chunks.append(params["%s%d_h2h_weight" % (j, i)].reshape((-1,)))
+                chunks.append(params["%s%d_i2h_bias" % (j, i)])
+                chunks.append(params["%s%d_h2h_bias" % (j, i)])
+                if self._projection_size:
+                    chunks.append(
+                        params["%s%d_h2r_weight" % (j, i)].reshape((-1,)))
+        return F.Concat(*chunks, dim=0)
+
+    def __call__(self, inputs, states=None):
+        return super(_RNNLayer, self).__call__(inputs, states) \
+            if states is not None else super(_RNNLayer, self).__call__(inputs)
+
+    def forward(self, inputs, states=None):
+        from ... import ndarray as F
+        from ..parameter import DeferredInitializationError
+        try:
+            params = {n: p.data() for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(inputs)
+            for p in self.collect_params().values():
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+            params = {n: p.data() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(F, inputs, states, **params)
+
+
+class RNN(_RNNLayer):
+    """Elman RNN layer (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super(RNN, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer,
+            "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super(LSTM, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer, "lstm",
+            projection_size=projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        hout = self._projection_size or self._hidden_size
+        return [{"shape": (self._num_layers * self._dir, batch_size, hout),
+                 "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU layer (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super(GRU, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

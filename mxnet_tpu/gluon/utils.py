@@ -1,0 +1,97 @@
+"""Gluon utilities.
+
+Reference: python/mxnet/gluon/utils.py (split_data, split_and_load,
+clip_global_norm, check_sha1, download).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along an axis into per-device chunks
+    (reference: gluon/utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use even_split=False." %
+            (data.shape, num_slice, batch_axis))
+    step = size // num_slice
+    if not even_split:
+        slices = []
+        for i in range(num_slice):
+            begin = i * step
+            end = size if i == num_slice - 1 else (i + 1) * step
+            slices.append(data.slice_axis(batch_axis, begin, end))
+        return slices
+    return [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+            for i in range(num_slice)]
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place per context (reference: gluon/utils.py
+    split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the total L2 norm <= max_norm
+    (reference: gluon/utils.py clip_global_norm)."""
+    import math
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total_norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Reference: gluon/utils.py check_sha1."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (reference: gluon/utils.py download). This build
+    runs with zero network egress; only pre-staged files resolve."""
+    fname = url.split("/")[-1] if path is None or os.path.isdir(path or ".") \
+        else path
+    if path and os.path.isdir(path):
+        fname = os.path.join(path, fname)
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        "download(%r) unavailable: this environment has no network egress. "
+        "Stage the file at %r manually." % (url, fname))

@@ -49,12 +49,19 @@ def register(klass):
     return klass
 
 
+# reference alias names (python/mxnet/initializer.py registers "zeros",
+# "ones"; Gluon layers pass them as default bias/gamma initializers)
+_ALIASES = {"zeros": "zero", "ones": "one"}
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
-    if name.lower() not in _INIT_REGISTRY:
+    key = name.lower()
+    key = _ALIASES.get(key, key)
+    if key not in _INIT_REGISTRY:
         raise MXNetError("unknown initializer %r" % name)
-    return _INIT_REGISTRY[name.lower()](**kwargs)
+    return _INIT_REGISTRY[key](**kwargs)
 
 
 class Initializer(object):

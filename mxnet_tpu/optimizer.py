@@ -103,6 +103,14 @@ class Optimizer(object):
         else:
             self.update(index, weight, grad, state)
 
+    @property
+    def learning_rate(self):
+        """Current learning rate incl. scheduler (reference:
+        python/mxnet/optimizer.py learning_rate property)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been "

@@ -34,8 +34,38 @@ def current_seed():
 
 
 def next_key():
-    """Return a fresh jax PRNG key; advances the global counter."""
+    """Return a fresh jax PRNG key; advances the global counter.
+
+    Inside a trace scope (CachedOp compilation), keys derive from the
+    scope's key argument instead of the global state, so the compiled
+    program's randomness is an *input* — fresh masks per call, no baked
+    constants."""
     import jax
     _ensure()
+    scopes = getattr(_state, "trace_scopes", None)
+    if scopes:
+        scope = scopes[-1]
+        scope[1] += 1
+        return jax.random.fold_in(scope[0], scope[1])
     _state.counter += 1
     return jax.random.fold_in(jax.random.PRNGKey(_state.seed), _state.counter)
+
+
+class _TraceKeyScope:
+    def __init__(self, key):
+        self._entry = [key, 0]
+
+    def __enter__(self):
+        _ensure()
+        if not hasattr(_state, "trace_scopes"):
+            _state.trace_scopes = []
+        _state.trace_scopes.append(self._entry)
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_scopes.pop()
+
+
+def trace_scope(key):
+    """Scope making ``next_key()`` derive deterministically from ``key``."""
+    return _TraceKeyScope(key)
