@@ -1,0 +1,284 @@
+"""Testing utilities — the framework's de-facto test harness.
+
+Reference: python/mxnet/test_utils.py (1959 LoC), in particular:
+``assert_almost_equal`` (:470, dtype-aware tolerances),
+``check_numeric_gradient`` (:790, central finite differences),
+``check_symbolic_forward``/``check_symbolic_backward`` (:926, :1000),
+``check_consistency`` (:1207, cross-backend/dtype comparison — here the
+"backends" are dtype variants and the float64 interpreter reference),
+``rand_ndarray`` (:339), ``default_context`` (:53).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray, array, invoke_op
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "simple_forward", "DEFAULT_RTOL",
+           "DEFAULT_ATOL"]
+
+# per-dtype default tolerances (reference: test_utils.py:470 table)
+DEFAULT_RTOL = {_np.dtype(_np.float16): 1e-2,
+                _np.dtype("bfloat16") if hasattr(_np, "bfloat16") else
+                _np.dtype(_np.float16): 1e-2,
+                _np.dtype(_np.float32): 1e-4,
+                _np.dtype(_np.float64): 1e-6}
+DEFAULT_ATOL = {_np.dtype(_np.float16): 1e-1,
+                _np.dtype(_np.float32): 1e-5,
+                _np.dtype(_np.float64): 1e-8}
+
+
+def default_context():
+    """Reference: test_utils.py default_context."""
+    return current_context()
+
+
+def set_default_context(ctx):
+    from .context import _ctx_stack
+    _ctx_stack()[0] = ctx
+
+
+def _dtype_tol(dtype, rtol, atol):
+    try:
+        dt = _np.dtype(dtype)
+    except TypeError:
+        dt = _np.dtype(_np.float32)
+    if rtol is None:
+        rtol = DEFAULT_RTOL.get(dt, 1e-4)
+    if atol is None:
+        atol = DEFAULT_ATOL.get(dt, 1e-5)
+    return rtol, atol
+
+
+def _as_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_as_numpy(a), _as_numpy(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_numpy(a), _as_numpy(b)
+    rtol, atol = _dtype_tol(a.dtype, rtol, atol)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Reference: test_utils.py:470 assert_almost_equal. Tolerances
+    default per dtype (fp16 loose, fp64 tight)."""
+    a_np, b_np = _as_numpy(a), _as_numpy(b)
+    dt = a_np.dtype if a_np.dtype.kind == "f" else b_np.dtype
+    rtol, atol = _dtype_tol(dt, rtol, atol)
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            "shape mismatch: %s %s vs %s %s" %
+            (names[0], a_np.shape, names[1], b_np.shape))
+    if _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64),
+                    rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    err = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+    denom = _np.abs(b_np.astype(_np.float64)) + atol
+    rel = err / denom
+    idx = tuple(int(i) for i in _np.unravel_index(_np.argmax(rel),
+                                                  rel.shape))
+    raise AssertionError(
+        "%s and %s differ beyond rtol=%g atol=%g: max rel err %g at %s "
+        "(%r vs %r)" % (names[0], names[1], rtol, atol, rel[idx], idx,
+                        a_np[idx], b_np[idx]))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution="uniform"):
+    """Reference: test_utils.py:339 rand_ndarray (dense subset; sparse
+    stypes go through mxnet_tpu.ndarray.sparse)."""
+    dtype = dtype or _np.float32
+    if distribution == "normal":
+        data = _np.random.normal(size=shape)
+    elif distribution == "powerlaw":
+        data = _np.random.pareto(2.0, size=shape)
+    else:
+        data = _np.random.uniform(size=shape)
+    if stype != "default":
+        from .ndarray import sparse as _sp
+        if density is not None:
+            mask = _np.random.uniform(size=shape) < density
+            data = data * mask
+        return _sp.array(data.astype(dtype), stype=stype)
+    return array(data.astype(dtype), dtype=dtype)
+
+
+def simple_forward(op_name, *inputs, **attrs):
+    """Invoke an op by name on numpy/NDArray inputs, returning numpy."""
+    nd_in = [x if isinstance(x, NDArray) else array(_np.asarray(x))
+             for x in inputs]
+    out = invoke_op(op_name, nd_in, attrs)
+    if isinstance(out, list):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# numeric gradient checking (reference: test_utils.py:790)
+# ---------------------------------------------------------------------------
+
+def check_numeric_gradient(f, inputs, grad_fn=None, eps=1e-4, rtol=1e-2,
+                           atol=1e-4, seed=0):
+    """Compare analytic gradients against central finite differences.
+
+    ``f(*NDArrays) -> NDArray scalar-or-tensor`` built from framework ops;
+    the analytic gradient is taken with autograd, the numeric one by
+    perturbing each input element (reference: test_utils.py:790
+    check_numeric_gradient; numeric grad at :720).
+    """
+    from . import autograd, random as _random
+
+    _random.seed(seed)
+    nd_inputs = []
+    for x in inputs:
+        x_np = _as_numpy(x).astype(_np.float64).astype(_np.float32)
+        nd = array(x_np)
+        nd.attach_grad()
+        nd_inputs.append(nd)
+
+    _random.seed(seed)
+    with autograd.record():
+        out = f(*nd_inputs)
+        total = out.sum()
+    total.backward()
+    analytic = [x.grad.asnumpy() for x in nd_inputs]
+
+    def eval_sum(vals):
+        _random.seed(seed)   # identical randomness across evaluations
+        nds = [array(v) for v in vals]
+        return float(f(*nds).sum().asscalar())
+
+    base_vals = [x.asnumpy().copy() for x in nd_inputs]
+    for i, base in enumerate(base_vals):
+        numeric = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            plus = eval_sum(base_vals)
+            flat[j] = orig - eps
+            minus = eval_sum(base_vals)
+            flat[j] = orig
+            num_flat[j] = (plus - minus) / (2 * eps)
+        assert_almost_equal(analytic[i], numeric, rtol=rtol, atol=atol,
+                            names=("analytic_grad[%d]" % i,
+                                   "numeric_grad[%d]" % i))
+    return analytic
+
+
+# ---------------------------------------------------------------------------
+# symbolic checks (reference: test_utils.py:926, :1000)
+# ---------------------------------------------------------------------------
+
+def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
+                           aux_states=None, ctx=None):
+    """Bind a symbol, run forward, compare each output with ``expected``
+    (reference: test_utils.py:926)."""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    args = {k: (v if isinstance(v, NDArray) else array(_np.asarray(v)))
+            for k, v in location.items()}
+    executor = sym.bind(ctx,
+                        [args[n] for n in arg_names],
+                        aux_states=[
+                            aux_states[n] if isinstance(aux_states, dict)
+                            else aux_states[i]
+                            for i, n in enumerate(
+                                sym.list_auxiliary_states())]
+                        if aux_states is not None else None)
+    outputs = executor.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol,
+                            names=("forward_output", "expected"))
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
+                            atol=None, grad_req="write", ctx=None):
+    """Bind, forward+backward, compare input grads
+    (reference: test_utils.py:1000)."""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    args = {k: (v if isinstance(v, NDArray) else array(_np.asarray(v)))
+            for k, v in location.items()}
+    args_grad = {k: array(_np.zeros(v.shape, dtype=_np.float32))
+                 for k, v in args.items()}
+    executor = sym.bind(ctx, [args[n] for n in arg_names],
+                        args_grad=[args_grad[n] for n in arg_names],
+                        grad_req=grad_req)
+    executor.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [g if isinstance(g, NDArray)
+                     else array(_np.asarray(g)) for g in out_grads]
+    executor.backward(out_grads)
+    for name, exp in expected.items():
+        assert_almost_equal(args_grad[name], exp, rtol=rtol, atol=atol,
+                            names=("grad(%s)" % name, "expected"))
+    return args_grad
+
+
+# ---------------------------------------------------------------------------
+# cross-dtype consistency (reference: test_utils.py:1207)
+# ---------------------------------------------------------------------------
+
+def check_consistency(f, inputs, dtypes=("float64", "float32", "float16"),
+                      tol=None, seed=0):
+    """Run ``f`` on the same inputs cast to each dtype and compare every
+    result against the highest-precision run — the TPU analog of the
+    reference's cpu-vs-gpu check_consistency (test_utils.py:1207), with
+    dtype variants playing the role of backends (the interpreter reference
+    is the float64 run, like the reference's fp64 ground truth)."""
+    from . import random as _random
+    results = []
+    for dt in dtypes:
+        _random.seed(seed)
+        cast_in = [array(_as_numpy(x).astype(dt)) for x in inputs]
+        out = f(*cast_in)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([_as_numpy(o).astype(_np.float64) for o in outs])
+    ref = results[0]
+    for dt, res in zip(dtypes[1:], results[1:]):
+        rtol, atol = _dtype_tol(dt, None, None)
+        for i, (r, o) in enumerate(zip(ref, res)):
+            assert_almost_equal(o, r, rtol=rtol, atol=atol,
+                                names=("out[%d][%s]" % (i, dt),
+                                       "out[%d][%s]" % (i, dtypes[0])))
+    return results

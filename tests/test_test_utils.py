@@ -1,0 +1,131 @@
+"""Tests of the test harness itself + gradient checks across the op set
+(reference strategy: tests/python/unittest via test_utils.py:790,1207)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+from mxnet_tpu import nd
+
+
+def test_assert_almost_equal_dtype_tolerance():
+    a = np.float16([1.0, 2.0])
+    b = np.float16([1.001, 2.002])
+    tu.assert_almost_equal(a, b)           # fp16 tolerance passes
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(np.float64(a), np.float64(b))
+
+
+def test_assert_almost_equal_reports_location():
+    a = np.zeros((3, 3), dtype=np.float32)
+    b = a.copy()
+    b[1, 2] = 1.0
+    with pytest.raises(AssertionError, match=r"\(1, 2\)"):
+        tu.assert_almost_equal(a, b)
+
+
+def test_rand_ndarray():
+    x = tu.rand_ndarray((3, 4), dtype=np.float32)
+    assert x.shape == (3, 4)
+    n = tu.rand_ndarray((100,), distribution="normal")
+    assert abs(float(n.mean().asscalar())) < 1.0
+
+
+@pytest.mark.parametrize("op,attrs,nin,shape", [
+    ("sigmoid", {}, 1, (3, 4)),
+    ("tanh", {}, 1, (3, 4)),
+    ("exp", {}, 1, (3, 4)),
+    ("log", {}, 1, (3, 4)),          # positive inputs handled below
+    ("sqrt", {}, 1, (3, 4)),
+    ("square", {}, 1, (3, 4)),
+    ("broadcast_add", {}, 2, (3, 4)),
+    ("broadcast_mul", {}, 2, (3, 4)),
+    ("broadcast_div", {}, 2, (3, 4)),
+    ("softmax", {"axis": -1}, 1, (3, 4)),
+    ("log_softmax", {"axis": -1}, 1, (3, 4)),
+    ("mean", {"axis": 1}, 1, (3, 4)),
+    ("sum", {"axis": 0}, 1, (3, 4)),
+    ("dot", {}, 2, (3, 3)),
+    ("transpose", {}, 1, (3, 4)),
+    ("relu", {}, 1, (3, 4)),
+])
+def test_numeric_gradient_ops(op, attrs, nin, shape):
+    rng = np.random.RandomState(42)
+    # keep inputs positive + away from kinks (log/sqrt/relu)
+    inputs = [rng.uniform(0.5, 1.5, size=shape).astype(np.float32)
+              for _ in range(nin)]
+
+    def f(*xs):
+        from mxnet_tpu.ndarray.ndarray import invoke_op
+        return invoke_op(op, list(xs), dict(attrs))
+
+    tu.check_numeric_gradient(f, inputs, eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_numeric_gradient_fc():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    def f(x, w, b):
+        return nd.FullyConnected(x, w, b, num_hidden=3)
+
+    tu.check_numeric_gradient(f, [x, w, b], eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_numeric_gradient_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+
+    def f(x, w):
+        return nd.Convolution(x, w, kernel=(3, 3), num_filter=3,
+                              no_bias=True)
+
+    tu.check_numeric_gradient(f, [x, w], eps=1e-2, rtol=8e-2, atol=2e-2)
+
+
+def test_check_consistency_dtype_sweep():
+    def f(x):
+        return nd.softmax(nd.dot(x, x.T))
+    x = np.random.RandomState(1).randn(6, 6).astype(np.float64)
+    tu.check_consistency(f, [x], dtypes=("float64", "float32", "float16"))
+
+
+def test_check_consistency_catches_bug():
+    calls = {"n": 0}
+
+    def f(x):
+        calls["n"] += 1
+        if x.dtype == np.float16:
+            return x * 1.5   # deliberate inconsistency
+        return x * 1.0
+    x = np.ones((4,), dtype=np.float64)
+    with pytest.raises(AssertionError):
+        tu.check_consistency(f, [x])
+
+
+def test_check_symbolic_forward_backward():
+    sym_x = mx.sym.var("x")
+    sym = sym_x * 2.0 + 1.0
+    x = np.array([[1.0, 2.0]], dtype=np.float32)
+    tu.check_symbolic_forward(sym, {"x": x}, [x * 2 + 1])
+    tu.check_symbolic_backward(sym, {"x": x},
+                               [np.ones_like(x)],
+                               {"x": np.full_like(x, 2.0)})
+
+
+def test_numeric_gradient_batchnorm_like_composite():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(0.5, 1.5, (4, 3)).astype(np.float32)
+    g = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = rng.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+
+    def f(x, g, b):
+        mean = x.mean(axis=0, keepdims=True)
+        var = ((x - mean) ** 2).mean(axis=0, keepdims=True)
+        xhat = (x - mean) / (var + 1e-5).sqrt()
+        return xhat * g.reshape((1, -1)) + b.reshape((1, -1))
+
+    tu.check_numeric_gradient(f, [x, g, b], eps=1e-3, rtol=5e-2, atol=1e-2)
