@@ -1,0 +1,464 @@
+"""Image IO + augmentation.
+
+Reference: python/mxnet/image/image.py (imdecode/imread/imresize, crop
+helpers, Augmenter pipeline, ImageIter) and the C++ decode/augment path
+src/io/image_aug_default.cc.
+
+Decoding uses OpenCV (same dependency as the reference); decoded images
+are HWC **RGB** uint8 NDArrays. Augmenters run on host numpy (CPU) —
+the TPU analog of the reference's CPU-side OMP decode workers — and only
+final batches are shipped to the device.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "random_size_crop",
+           "color_normalize", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "LightingAug", "ColorJitterAug", "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return _np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC NDArray
+    (reference: image.py imdecode → cv2.imdecode)."""
+    cv2 = _cv2()
+    if isinstance(buf, (bytes, bytearray)):
+        buf = _np.frombuffer(buf, dtype=_np.uint8)
+    img = cv2.imdecode(buf, cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if flag and to_rgb:
+        img = img[..., ::-1]
+    if not flag:
+        img = img[..., None]
+    return array(_np.ascontiguousarray(img), dtype=_np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Reference: image.py imread."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image to (h, w) (reference: image.py imresize)."""
+    cv2 = _cv2()
+    img = _to_np(src)
+    interp_map = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+                  2: cv2.INTER_CUBIC, 3: cv2.INTER_AREA,
+                  4: cv2.INTER_LANCZOS4}
+    out = cv2.resize(img, (w, h), interpolation=interp_map.get(interp, 1))
+    if out.ndim == 2:
+        out = out[..., None]
+    return array(out, dtype=out.dtype)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals ``size``
+    (reference: image.py resize_short)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a region, optionally resize (reference: image.py fixed_crop)."""
+    img = _to_np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(array(out, dtype=out.dtype), size[0], size[1], interp)
+    return array(_np.ascontiguousarray(out), dtype=out.dtype)
+
+
+def center_crop(src, size, interp=2):
+    """Reference: image.py center_crop. Returns (img, (x0, y0, w, h))."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    """Reference: image.py random_crop."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random area+aspect crop (reference: image.py random_size_crop)."""
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round((target_area * aspect) ** 0.5))
+        new_h = int(round((target_area / aspect) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """Reference: image.py color_normalize."""
+    img = _to_np(src).astype(_np.float32)
+    mean = _np.asarray(_to_np(mean), dtype=_np.float32)
+    img = img - mean
+    if std is not None:
+        img = img / _np.asarray(_to_np(std), dtype=_np.float32)
+    return array(img)
+
+
+# ---------------------------------------------------------------------------
+# augmenter pipeline (reference: image.py Augmenter zoo +
+# src/io/image_aug_default.cc)
+# ---------------------------------------------------------------------------
+
+class Augmenter(object):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return array(_np.ascontiguousarray(_to_np(src)[:, ::-1]),
+                         dtype=src.dtype)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return array(_to_np(src).astype(_np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], dtype=_np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        img = _to_np(src).astype(_np.float32)
+        gray = (img * self._coef).sum() * 3.0 / img.size
+        return array(img * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], dtype=_np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        img = _to_np(src).astype(_np.float32)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return array(img * alpha + gray * (1.0 - alpha))
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, dtype=_np.float32)
+        self.eigvec = _np.asarray(eigvec, dtype=_np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return array(_to_np(src).astype(_np.float32) + rgb)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        _pyrandom.shuffle(augs)
+        for aug in augs:
+            src = aug(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the standard augmenter list
+    (reference: image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3. / 4., 4. / 3.), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(object):
+    """Image data iterator over .rec packs or path lists with augmentation
+    (reference: image.py ImageIter, C++ hot path
+    src/io/iter_image_recordio_2.cc)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None, dtype="float32",
+                 **kwargs):
+        from .io import DataDesc
+        assert path_imgrec or path_imglist or imglist is not None
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            from . import recordio
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = _np.array(parts[1:-1], dtype=_np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = sorted(self.imglist)
+            self.path_root = path_root
+        else:
+            self.imglist = {i: (_np.array(lbl, dtype=_np.float32), p)
+                            for i, (lbl, p) in enumerate(imglist)}
+            self.seq = sorted(self.imglist)
+            self.path_root = path_root
+        self.provide_data = [DataDesc(
+            "data", (batch_size,) + self.data_shape, dtype)]
+        self.provide_label = [DataDesc(
+            "softmax_label", (batch_size, label_width)
+            if label_width > 1 else (batch_size,), dtype)]
+        self.cursor = 0
+        self.reset()
+
+    def reset(self):
+        self.cursor = 0
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+
+    def next_sample(self):
+        from . import recordio as rio
+        if self.seq is not None and self.cursor >= len(self.seq):
+            raise StopIteration
+        if self.imgrec is not None:
+            if self.seq is not None:
+                rec = self.imgrec.read_idx(self.seq[self.cursor])
+            else:
+                rec = self.imgrec.read()
+                if rec is None:
+                    raise StopIteration
+            self.cursor += 1
+            header, img = rio.unpack(rec)
+            return header.label, img
+        label, fname = self.imglist[self.seq[self.cursor]]
+        self.cursor += 1
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        from .io import DataBatch
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((self.batch_size, c, h, w), dtype=self.dtype)
+        batch_label = _np.zeros((self.batch_size, self.label_width),
+                                dtype=_np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s, 1 if c == 3 else 0)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy()
+                if arr.ndim == 3:
+                    arr = arr.transpose(2, 0, 1)
+                batch_data[i] = arr
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        lbl = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return DataBatch(data=[array(batch_data)],
+                         label=[array(lbl)], pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self

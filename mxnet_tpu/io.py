@@ -21,7 +21,7 @@ import numpy as np
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter", "MNISTIter"]
 
 
@@ -545,3 +545,27 @@ class MNISTIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                    **kwargs):
+    """RecordIO image iterator (reference: the C++-registered
+    ImageRecordIter, src/io/iter_image_recordio_2.cc:735). Thin factory
+    over image.ImageIter with the same flat-kwargs CLI surface."""
+    from .image import ImageIter
+    import numpy as _np
+    mean = None
+    std = None
+    if mean_r or mean_g or mean_b:
+        mean = _np.array([mean_r, mean_g, mean_b])
+    if (std_r, std_g, std_b) != (1, 1, 1):
+        std = _np.array([std_r, std_g, std_b])
+    kwargs.pop("preprocess_threads", None)
+    kwargs.pop("num_parts", None)
+    kwargs.pop("part_index", None)
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     path_imgrec=path_imgrec, shuffle=shuffle,
+                     rand_crop=rand_crop, rand_mirror=rand_mirror,
+                     mean=mean, std=std, **kwargs)

@@ -1,0 +1,295 @@
+"""RecordIO: binary record pack format for datasets.
+
+Reference: python/mxnet/recordio.py (488 LoC: MXRecordIO,
+MXIndexedRecordIO, IRHeader pack/unpack/pack_img/unpack_img) and the
+dmlc-core recordio framing used by src/io/iter_image_recordio_2.cc.
+
+The byte format is identical to the reference (magic 0xced7230a,
+cflag<<29|len headers, 4-byte alignment), so .rec files interoperate.
+The hot sequential/indexed read path runs in native C++
+(src/native/recordio.cc) via ctypes, with a pure-Python fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from . import _native
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+
+
+def _pad4(n):
+    return (n + 3) & ~3
+
+
+class MXRecordIO(object):
+    """Sequential record reader/writer
+    (reference: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self._lib = _native.recordio_lib()
+        self._handle = None
+        self._pyfile = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag not in ("r", "w"):
+            raise ValueError("Invalid flag %s" % self.flag)
+        writable = self.flag == "w"
+        if self._lib is not None:
+            self._handle = self._lib.rio_open(
+                self.uri.encode(), 1 if writable else 0)
+            if not self._handle:
+                raise IOError("cannot open %s" % self.uri)
+        else:
+            self._pyfile = open(self.uri, "wb" if writable else "rb")
+        self.writable = writable
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self._handle is not None:
+            self._lib.rio_close(self._handle)
+            self._handle = None
+        if self._pyfile is not None:
+            self._pyfile.close()
+            self._pyfile = None
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Support pickling across DataLoader workers
+        (reference: recordio.py __getstate__)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("_lib"), d.pop("_handle"), d.pop("_pyfile")
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lib = _native.recordio_lib()
+        self._handle = None
+        self._pyfile = None
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    # -- write -------------------------------------------------------------
+    def write(self, buf):
+        """Append one record; returns nothing
+        (reference API). See also _write_with_offset."""
+        self._write_with_offset(buf)
+
+    def _write_with_offset(self, buf):
+        assert self.writable
+        if self._handle is not None:
+            off = self._lib.rio_write(self._handle, buf, len(buf))
+            if off < 0:
+                raise IOError("write failed on %s" % self.uri)
+            return off
+        f = self._pyfile
+        off = f.tell()
+        lrec = len(buf) & _LEN_MASK
+        f.write(struct.pack("<II", _kMagic, lrec))
+        f.write(buf)
+        pad = _pad4(len(buf)) - len(buf)
+        if pad:
+            f.write(b"\x00" * pad)
+        return off
+
+    # -- read --------------------------------------------------------------
+    def read(self):
+        """Next record bytes, or None at EOF (reference: recordio.py
+        read)."""
+        assert not self.writable
+        if self._handle is not None:
+            buf = ctypes.c_char_p()
+            n = ctypes.c_uint64()
+            r = self._lib.rio_read(self._handle, ctypes.byref(buf),
+                                   ctypes.byref(n))
+            if r == 0:
+                return None
+            if r < 0:
+                raise IOError("corrupt recordio file %s" % self.uri)
+            data = ctypes.string_at(buf, n.value)
+            self._lib.rio_free(buf)
+            return data
+        return self._py_read()
+
+    def _py_read(self):
+        f = self._pyfile
+        out = b""
+        first = True
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                if first and len(header) == 0:
+                    return None
+                raise IOError("corrupt recordio file %s" % self.uri)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise IOError("invalid magic in %s" % self.uri)
+            cflag, length = lrec >> 29, lrec & _LEN_MASK
+            data = f.read(length)
+            f.read(_pad4(length) - length)
+            out += data
+            if (first and cflag == 0) or cflag == 3:
+                return out
+            first = False
+
+    def seek(self, offset):
+        assert not self.writable
+        if self._handle is not None:
+            self._lib.rio_seek(self._handle, offset)
+        else:
+            self._pyfile.seek(offset)
+
+    def tell(self):
+        if self._handle is not None:
+            return self._lib.rio_tell(self._handle)
+        return self._pyfile.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed record reader/writer with a sidecar .idx file
+    (reference: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super(MXIndexedRecordIO, self).__init__(uri, flag)
+
+    def open(self):
+        super(MXIndexedRecordIO, self).open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path,
+                         "w" if self.writable else "r")
+        if not self.writable:
+            for line in self.fidx:
+                parts = line.strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                key = self.key_type(parts[0])
+                self.idx[key] = int(parts[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super(MXIndexedRecordIO, self).close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def __getstate__(self):
+        d = super(MXIndexedRecordIO, self).__getstate__()
+        d.pop("fidx")
+        return d
+
+    def __setstate__(self, d):
+        self.fidx = None
+        super(MXIndexedRecordIO, self).__setstate__(d)
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        offset = self._write_with_offset(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), offset))
+        self.idx[key] = offset
+        self.keys.append(key)
+
+
+# ---------------------------------------------------------------------------
+# record payload packing (reference: recordio.py IRHeader/pack/unpack)
+# ---------------------------------------------------------------------------
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + byte payload into one record
+    (reference: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        payload_label = b""
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        header = header._replace(flag=label.size, label=0)
+        payload_label = label.tobytes()
+    return struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                       header.id, header.id2) + payload_label + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload bytes)
+    (reference: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[:header.flag * 4], dtype=_np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record into (IRHeader, decoded image NDArray)
+    (reference: recordio.py unpack_img)."""
+    header, s = unpack(s)
+    from . import image
+    return header, image.imdecode(s, iscolor)
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image (numpy HWC or NDArray) and pack it
+    (reference: recordio.py pack_img; uses OpenCV like the reference)."""
+    import cv2
+    from .ndarray.ndarray import NDArray
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = _np.asarray(img)
+    if img_fmt.lower() in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.lower() == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img[..., ::-1] if img.ndim == 3
+                            else img, encode_params)
+    if not ret:
+        raise MXNetError("failed to encode image")
+    return pack(header, buf.tobytes())

@@ -1,0 +1,141 @@
+"""RecordIO + image pipeline tests
+(reference: tests/python/unittest/test_recordio.py, test_image.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, image
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    records = [b"x" * n for n in (1, 5, 100, 1000)]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for expect in records:
+        assert r.read() == expect
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_native_backend_used():
+    from mxnet_tpu import _native
+    lib = _native.recordio_lib()
+    assert lib is not None, "native recordio library failed to build"
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, b"record%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record7"
+    assert r.read_idx(2) == b"record2"
+    r.close()
+
+
+def test_pack_unpack_label_array():
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.id == 7
+    assert np.allclose(h2.label, [1.0, 2.0, 3.0])
+
+
+def test_pack_unpack_scalar_label():
+    s = recordio.pack((0, 3.0, 1, 0), b"data")
+    h, payload = recordio.unpack(s)
+    assert h.label == 3.0
+    assert payload == b"data"
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img((0, 1.0, 0, 0), img, quality=100, img_fmt=".png")
+    header, decoded = recordio.unpack_img(s)
+    assert header.label == 1.0
+    assert decoded.shape == (32, 32, 3)
+    # png is lossless: exact round trip (RGB order preserved)
+    assert np.array_equal(decoded.asnumpy(), img)
+
+
+def test_image_resize_crop():
+    img = mx.nd.array((np.random.rand(40, 60, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    out = image.imresize(img, 30, 20)
+    assert out.shape == (20, 30, 3)
+    short = image.resize_short(img, 20)
+    assert min(short.shape[:2]) == 20
+    crop, rect = image.center_crop(img, (20, 20))
+    assert crop.shape == (20, 20, 3)
+    rnd, rect = image.random_crop(img, (16, 16))
+    assert rnd.shape == (16, 16, 3)
+
+
+def test_augmenter_list():
+    augs = image.CreateAugmenter((3, 24, 24), resize=26, rand_mirror=True,
+                                 mean=True, std=True)
+    img = mx.nd.array((np.random.rand(40, 60, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == np.float32
+
+
+def test_image_iter_from_rec(tmp_path):
+    # build a small rec pack
+    path = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(8):
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img((0, float(i % 2), i, 0), img))
+    w.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=path, rand_crop=True, rand_mirror=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    assert batch.label[0].shape == (4,)
+    n = 1 + sum(1 for _ in it)
+    assert n == 2
+
+
+def test_dataloader_with_workers():
+    X = np.random.rand(32, 4).astype(np.float32)
+    y = np.arange(32, dtype=np.float32)
+    ds = ArrayDataset(X, y)
+    loader = DataLoader(ds, batch_size=8, shuffle=False, num_workers=2)
+    seen = 0
+    for data, label in loader:
+        assert data.shape == (8, 4)
+        np.testing.assert_allclose(label.asnumpy(),
+                                   y[seen:seen + 8])
+        seen += 8
+    assert seen == 32
+
+
+def test_record_file_dataset(tmp_path):
+    path = str(tmp_path / "ds.rec")
+    idx = str(tmp_path / "ds.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"item%d" % i)
+    w.close()
+    from mxnet_tpu.gluon.data.dataset import RecordFileDataset
+    ds = RecordFileDataset(path)
+    assert len(ds) == 5
+    assert ds[3] == b"item3"

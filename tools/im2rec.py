@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Pack an image folder / list file into RecordIO.
+
+Reference: tools/im2rec.py (+ the C++ tools/im2rec.cc) — same CLI shape:
+  python tools/im2rec.py PREFIX ROOT --list      # generate .lst
+  python tools/im2rec.py PREFIX ROOT             # pack .lst -> .rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_images(root, recursive, exts):
+    i = 0
+    cat = {}
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                label_dir = os.path.relpath(path, root)
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield (i, os.path.relpath(fpath, root), cat[label_dir])
+                i += 1
+        if not recursive:
+            break
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, (idx, fname, label) in enumerate(image_list):
+            fout.write("%d\t%f\t%s\n" % (idx, label, fname))
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]),
+                   [float(x) for x in parts[1:-1]], parts[-1])
+
+
+def make_rec(args, path_lst):
+    from mxnet_tpu import recordio, image
+    prefix = os.path.splitext(path_lst)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, fname in read_list(path_lst):
+        fpath = os.path.join(args.root, fname)
+        with open(fpath, "rb") as f:
+            buf = f.read()
+        label_val = label[0] if len(label) == 1 else label
+        if args.resize or args.quality != 95:
+            img = image.imdecode(buf)
+            if args.resize:
+                img = image.resize_short(img, args.resize)
+            packed = recordio.pack_img(
+                (0, label_val, idx, 0), img.asnumpy(),
+                quality=args.quality, img_fmt=args.encoding)
+        else:
+            packed = recordio.pack((0, label_val, idx, 0), buf)
+        rec.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d records" % count)
+    rec.close()
+    print("wrote %d records to %s.rec" % (count, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list and/or RecordIO pack")
+    parser.add_argument("prefix", help="prefix of output list/rec files")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="generate the .lst file only")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--recursive", action="store_true", default=True)
+    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg")
+    args = parser.parse_args()
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive, set(args.exts)))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        if args.train_ratio < 1.0:
+            sep = int(len(images) * args.train_ratio)
+            write_list(args.prefix + "_train.lst", images[:sep])
+            write_list(args.prefix + "_val.lst", images[sep:])
+        else:
+            write_list(args.prefix + ".lst", images)
+        return
+    path_lst = args.prefix + ".lst"
+    if not os.path.exists(path_lst):
+        raise SystemExit("list file %s not found; run with --list first"
+                         % path_lst)
+    make_rec(args, path_lst)
+
+
+if __name__ == "__main__":
+    main()
