@@ -22,7 +22,7 @@ from .base import MXNetError, canonical_attrs
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "mark_variable", "backward",
-           "grad", "set_recording", "set_training", "record_op"]
+           "grad", "set_recording", "set_training", "record_op", "Function"]
 
 _state = threading.local()
 
@@ -253,15 +253,30 @@ def _run_backward(heads, head_grads=None):
             g = grad_map.get(id(onode))
             if g is None:
                 # zero cotangent for unused outputs
-                import jax
-                shape_dtype = jax.eval_shape(
-                    lambda *a: _normalize(entry.op.fn(*a, **entry.attrs))[i],
-                    *( ((entry.key,) if entry.key is not None else ()) + entry.input_values))
-                g = jnp.zeros(shape_dtype.shape, dtype=shape_dtype.dtype)
+                arr = onode.array_ref() if onode.array_ref else None
+                if arr is not None:
+                    g = jnp.zeros(arr.shape, dtype=arr.dtype)
+                else:
+                    import jax
+                    shape_dtype = jax.eval_shape(
+                        lambda *a: _normalize(entry.op.fn(*a, **entry.attrs))[i],
+                        *(((entry.key,) if entry.key is not None else ())
+                          + entry.input_values))
+                    g = jnp.zeros(shape_dtype.shape, dtype=shape_dtype.dtype)
             else:
                 needed = True
             cts.append(g)
         if not needed:
+            continue
+        custom_bwd = getattr(entry.op, "custom_bwd", None)
+        if custom_bwd is not None:
+            # autograd.Function: user-supplied backward
+            in_grads = custom_bwd(tuple(cts))
+            for node, g in zip(entry.input_nodes, in_grads):
+                if node is None or g is None:
+                    continue
+                from .ndarray.ndarray import NDArray as _ND
+                add_grad(node, g._data if isinstance(g, _ND) else g)
             continue
         with_key = entry.key is not None
         inputs = ((entry.key,) + entry.input_values) if with_key \
@@ -325,6 +340,67 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def _normalize(out):
     return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+class _FunctionOp(object):
+    """Tape-entry op descriptor for autograd.Function (OpDef has
+    __slots__; Function entries carry an explicit backward instead of a
+    differentiable fn)."""
+
+    def __init__(self, name, n_outputs, custom_bwd):
+        self.name = name
+        self.fn = None
+        self.num_outputs = n_outputs
+        self.custom_bwd = custom_bwd
+
+
+class Function(object):
+    """User-defined differentiable function
+    (reference: python/mxnet/autograd.py Function / src/c_api/
+    c_api_function.cc). Subclass and implement ``forward(self, *inputs)``
+    and ``backward(self, *output_grads)``; each instance is used for one
+    call, like the reference."""
+
+    def __init__(self):
+        self._used = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        from .ops.registry import OpDef
+        if self._used:
+            raise MXNetError(
+                "Each Function instance can only be called once "
+                "(reference: autograd.Function semantics)")
+        self._used = True
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn_self = self
+
+            def custom_bwd(cts):
+                with pause():
+                    grads = fn_self.backward(
+                        *[NDArray(c) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                return grads
+
+            op = _FunctionOp("_function_%s" % type(self).__name__,
+                             len(outs), custom_bwd)
+            record_op(op, {}, list(inputs), outs, key=None)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -152,3 +152,47 @@ def test_numeric_gradient_check():
             xm = x_np.copy(); xm[i, j] -= eps
             numeric[i, j] = ((np.tanh(xp) * xp).sum() - (np.tanh(xm) * xm).sum()) / (2 * eps)
     np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
+def test_autograd_function():
+    import numpy as np
+    from mxnet_tpu import autograd, nd
+    import mxnet_tpu as mx
+
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(np.array([0.5, -1.0, 2.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = Sigmoid()(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-6)
+
+
+def test_autograd_function_single_use():
+    import numpy as np
+    import pytest
+    from mxnet_tpu import autograd
+    import mxnet_tpu as mx
+
+    class Ident(autograd.Function):
+        def forward(self, x):
+            return x
+
+        def backward(self, dy):
+            return dy
+
+    f = Ident()
+    x = mx.nd.array(np.ones(2))
+    f(x)
+    with pytest.raises(Exception):
+        f(x)
