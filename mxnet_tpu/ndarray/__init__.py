@@ -5,6 +5,10 @@ from .ndarray import (NDArray, invoke_op, array, zeros, ones, full, empty,
 from .utils import save, load
 from . import random
 from . import _internal
+from . import linalg
+from . import contrib
+from . import image
+from . import sparse
 
 # populate generated op functions (nd.relu, nd.FullyConnected, ...)
 from . import register as _register
@@ -18,5 +22,22 @@ def onehot_encode(indices, out):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    from .sparse import BaseSparseNDArray, dot as _sparse_dot
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        return _sparse_dot(lhs, rhs, transpose_a, transpose_b)
     return invoke_op("dot", [lhs, rhs], {"transpose_a": transpose_a,
                                          "transpose_b": transpose_b})
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference:
+    src/operator/tensor/cast_storage-inl.h). Sparse conversions happen
+    at the NDArray layer (the FComputeEx analog) since XLA programs keep
+    static shapes."""
+    from .sparse import BaseSparseNDArray, array as sparse_array
+    if stype == "default":
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.todense()
+        return arr
+    return sparse_array(arr, stype=stype)

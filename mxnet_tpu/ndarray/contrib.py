@@ -1,0 +1,55 @@
+"""nd.contrib namespace.
+
+Reference: python/mxnet/ndarray/contrib.py (control flow foreach/
+while_loop/cond) + generated _contrib_* op bindings (ROIAlign, box_nms,
+MultiBoxPrior, CTCLoss, quantization, transformer helpers).
+"""
+from __future__ import annotations
+
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from .ndarray import invoke_op
+
+__all__ = ["foreach", "while_loop", "cond", "ROIAlign", "box_iou",
+           "box_nms", "MultiBoxPrior", "CTCLoss", "ctc_loss",
+           "AdaptiveAvgPooling2D", "BilinearResize2D", "div_sqrt_dim",
+           "arange_like", "dot_product_attention", "quantize",
+           "quantize_v2", "dequantize", "requantize",
+           "quantized_fully_connected", "quantized_conv",
+           "quantized_pooling", "quantized_flatten"]
+
+
+def _wrap(op_name, public):
+    from .ndarray import NDArray
+
+    def fn(*args, **kwargs):
+        arrays = [a for a in args if isinstance(a, NDArray)]
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, NDArray)}
+        arrays += [v for v in kwargs.values() if isinstance(v, NDArray)]
+        return invoke_op(op_name, arrays, attrs)
+    fn.__name__ = public
+    return fn
+
+
+ROIAlign = _wrap("_contrib_ROIAlign", "ROIAlign")
+box_iou = _wrap("_contrib_box_iou", "box_iou")
+box_nms = _wrap("_contrib_box_nms", "box_nms")
+MultiBoxPrior = _wrap("_contrib_MultiBoxPrior", "MultiBoxPrior")
+CTCLoss = _wrap("CTCLoss", "CTCLoss")
+ctc_loss = CTCLoss
+AdaptiveAvgPooling2D = _wrap("_contrib_AdaptiveAvgPooling2D",
+                             "AdaptiveAvgPooling2D")
+BilinearResize2D = _wrap("_contrib_BilinearResize2D", "BilinearResize2D")
+div_sqrt_dim = _wrap("_contrib_div_sqrt_dim", "div_sqrt_dim")
+arange_like = _wrap("_contrib_arange_like", "arange_like")
+dot_product_attention = _wrap("_contrib_dot_product_attention",
+                              "dot_product_attention")
+quantize = _wrap("_contrib_quantize", "quantize")
+quantize_v2 = _wrap("_contrib_quantize_v2", "quantize_v2")
+dequantize = _wrap("_contrib_dequantize", "dequantize")
+requantize = _wrap("_contrib_requantize", "requantize")
+quantized_fully_connected = _wrap("_contrib_quantized_fully_connected",
+                                  "quantized_fully_connected")
+quantized_conv = _wrap("_contrib_quantized_conv", "quantized_conv")
+quantized_pooling = _wrap("_contrib_quantized_pooling", "quantized_pooling")
+quantized_flatten = _wrap("_contrib_quantized_flatten", "quantized_flatten")

@@ -1,0 +1,63 @@
+"""nd.image namespace (reference: generated _image_* bindings from
+src/operator/image/image_random-inl.h)."""
+from __future__ import annotations
+
+from .ndarray import invoke_op
+
+__all__ = ["to_tensor", "normalize", "flip_left_right", "flip_top_bottom",
+           "random_flip_left_right", "random_flip_top_bottom", "crop",
+           "random_brightness", "random_contrast", "random_saturation",
+           "resize"]
+
+
+def to_tensor(data):
+    return invoke_op("_image_to_tensor", [data], {})
+
+
+def normalize(data, mean=0.0, std=1.0):
+    mean = tuple(mean) if hasattr(mean, "__len__") else (float(mean),)
+    std = tuple(std) if hasattr(std, "__len__") else (float(std),)
+    return invoke_op("_image_normalize", [data], {"mean": mean, "std": std})
+
+
+def flip_left_right(data):
+    return invoke_op("_image_flip_left_right", [data], {})
+
+
+def flip_top_bottom(data):
+    return invoke_op("_image_flip_top_bottom", [data], {})
+
+
+def random_flip_left_right(data):
+    return invoke_op("_image_random_flip_left_right", [data], {})
+
+
+def random_flip_top_bottom(data):
+    return invoke_op("_image_random_flip_top_bottom", [data], {})
+
+
+def crop(data, x, y, width, height):
+    return invoke_op("_image_crop", [data],
+                     {"x": x, "y": y, "width": width, "height": height})
+
+
+def random_brightness(data, min_factor, max_factor):
+    return invoke_op("_image_random_brightness", [data],
+                     {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def random_contrast(data, min_factor, max_factor):
+    return invoke_op("_image_random_contrast", [data],
+                     {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def random_saturation(data, min_factor, max_factor):
+    return invoke_op("_image_random_saturation", [data],
+                     {"min_factor": min_factor, "max_factor": max_factor})
+
+
+def resize(data, size, keep_ratio=False, interp=1):
+    size = tuple(size) if hasattr(size, "__len__") else (size, size)
+    return invoke_op("_image_resize", [data],
+                     {"size": size, "keep_ratio": keep_ratio,
+                      "interp": interp})

@@ -1,0 +1,38 @@
+"""nd.linalg namespace (reference: python/mxnet/ndarray/linalg.py —
+wrappers over the _linalg_* ops from src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+from .ndarray import invoke_op
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "sumlogdiag",
+           "syrk", "gelqf", "syevd", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def _make(name, n_arrays):
+    def fn(*args, **attrs):
+        arrays = list(args[:n_arrays])
+        return invoke_op("_linalg_" + name, arrays, dict(attrs))
+    fn.__name__ = name
+    fn.__doc__ = ("linalg.%s (reference: src/operator/tensor/la_op.cc "
+                  "linalg_%s)" % (name, name))
+    return fn
+
+
+gemm = _make("gemm", 3)
+gemm2 = _make("gemm2", 2)
+potrf = _make("potrf", 1)
+potri = _make("potri", 1)
+trsm = _make("trsm", 2)
+trmm = _make("trmm", 2)
+sumlogdiag = _make("sumlogdiag", 1)
+syrk = _make("syrk", 1)
+gelqf = _make("gelqf", 1)
+syevd = _make("syevd", 1)
+extractdiag = _make("extractdiag", 1)
+makediag = _make("makediag", 1)
+extracttrian = _make("extracttrian", 1)
+maketrian = _make("maketrian", 1)
+inverse = _make("inverse", 1)
+det = _make("det", 1)
+slogdet = _make("slogdet", 1)

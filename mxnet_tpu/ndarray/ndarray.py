@@ -401,7 +401,12 @@ class NDArray:
     def __getitem__(self, key):
         if isinstance(key, NDArray):
             return invoke_op("take", [self, key], {"axis": 0, "mode": "clip"})
-        jnp = _jnp()
+        from ..ops.matrix import encode_index_key
+        enc = encode_index_key(key)
+        if enc is not None:
+            # basic indexing routes through the op registry so it lands
+            # on the autograd tape (reference records slice ops too)
+            return invoke_op("_getitem", [self], {"key": enc})
         out = self._data[key]
         return NDArray(out, ctx=self._ctx)
 

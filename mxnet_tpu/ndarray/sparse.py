@@ -1,0 +1,264 @@
+"""Sparse NDArray storage types: row_sparse + CSR.
+
+Reference: include/mxnet/ndarray.h:61-65 (kRowSparseStorage,
+kCSRStorage), python/mxnet/ndarray/sparse.py (1635 LoC:
+RowSparseNDArray, CSRNDArray, row_sparse_array, csr_matrix, sparse
+zeros/array, tostype conversions, retain, sparse dot).
+
+TPU-native: component arrays (data/indices/indptr) are jax arrays;
+kernels (ops/sparse_ops.py) use gather/scatter/segment-sum formulations
+because XLA has no native sparse layouts. nnz trimming (a data-dependent
+shape) happens host-side at construction — inside compiled code sparse
+values keep static shapes, the XLA-compatible contract.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ops import sparse_ops as _sk
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "array", "zeros", "empty",
+           "retain", "dot"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class BaseSparseNDArray(object):
+    """Common surface of sparse arrays (reference: sparse.py
+    BaseSparseNDArray)."""
+
+    stype = None
+
+    def __init__(self, shape, dtype, ctx):
+        self.shape = tuple(shape)
+        self.dtype = _np.dtype(dtype)
+        self._ctx = ctx or current_context()
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (self.__class__.__name__,
+                                  "x".join(str(s) for s in self.shape),
+                                  self._ctx)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return array(self.todense(), stype=stype)
+
+    def wait_to_read(self):
+        self.todense().wait_to_read()
+        return self
+
+    def __eq__(self, other):
+        return self is other
+
+    __hash__ = object.__hash__
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: a subset of rows stored densely
+    (reference: sparse.py RowSparseNDArray; storage chunk layout
+    ndarray.h kRowSparseStorage)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None, ctx=None):
+        jnp = _jnp()
+        self.data = jnp.asarray(data)
+        self.indices = jnp.asarray(indices, dtype=jnp.int64)
+        super().__init__(shape, dtype or self.data.dtype, ctx)
+
+    @property
+    def num_rows(self):
+        return int(self.indices.shape[0])
+
+    def todense(self):
+        return NDArray(_sk.rsp_to_dense(self.shape, self.indices,
+                                        self.data), ctx=self._ctx)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices,
+                                self.shape, dtype, self._ctx)
+
+    def retain(self, to_retain):
+        jnp = _jnp()
+        if isinstance(to_retain, NDArray):
+            to_retain = to_retain._data
+        idx, vals = _sk.rsp_retain(self.indices, self.data,
+                                   jnp.asarray(to_retain, jnp.int64))
+        return RowSparseNDArray(vals, idx, self.shape, self.dtype,
+                                self._ctx)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            dense = _sk.rsp_add_rsp(self.shape, self.indices, self.data,
+                                    other.indices, other.data)
+            return NDArray(dense, ctx=self._ctx)
+        if isinstance(other, NDArray):
+            return NDArray(self.todense()._data + other._data,
+                           ctx=self._ctx)
+        raise TypeError(type(other))
+
+    def copyto(self, other):
+        return self
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row (reference: sparse.py CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None, ctx=None):
+        jnp = _jnp()
+        self.data = jnp.asarray(data)
+        self.indices = jnp.asarray(indices, dtype=jnp.int64)
+        self.indptr = jnp.asarray(indptr, dtype=jnp.int64)
+        super().__init__(shape, dtype or self.data.dtype, ctx)
+
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def todense(self):
+        return NDArray(_sk.csr_to_dense(self.shape, self.data,
+                                        self.indices, self.indptr),
+                       ctx=self._ctx)
+
+    def astype(self, dtype):
+        return CSRNDArray(self.data.astype(dtype), self.indices,
+                          self.indptr, self.shape, dtype, self._ctx)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self.shape[0]
+            dense = self.todense()._data[start:stop]
+            return array(_np.asarray(dense), stype="csr")
+        raise MXNetError("CSRNDArray only supports row-slice indexing")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference: sparse.py
+    row_sparse_array): from (data, indices) or a dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(data, dtype=dtype or _np.float32)
+        if shape is None:
+            raise MXNetError("shape is required for (data, indices) form")
+        return RowSparseNDArray(data, _np.asarray(indices), shape,
+                                data.dtype, ctx)
+    return array(arg1, stype="row_sparse", ctx=ctx, dtype=dtype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference: sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(data, dtype=dtype or _np.float32)
+        if shape is None:
+            raise MXNetError("shape required for (data, indices, indptr)")
+        return CSRNDArray(data, _np.asarray(indices),
+                          _np.asarray(indptr), shape, data.dtype, ctx)
+    return array(arg1, stype="csr", ctx=ctx, dtype=dtype)
+
+
+def array(source, stype="default", ctx=None, dtype=None):
+    """Dense/numpy/NDArray -> sparse array of the requested stype
+    (host-side nnz trimming, reference: cast_storage semantics)."""
+    if isinstance(source, BaseSparseNDArray):
+        source = source.asnumpy()
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    src = _np.asarray(source, dtype=dtype or _np.float32)
+    if stype == "default":
+        return _dense_array(src, ctx=ctx, dtype=src.dtype)
+    if stype == "row_sparse":
+        keep = _np.where(_np.any(src.reshape(src.shape[0], -1) != 0,
+                                 axis=1))[0]
+        return RowSparseNDArray(src[keep], keep, src.shape, src.dtype, ctx)
+    if stype == "csr":
+        if src.ndim != 2:
+            raise MXNetError("csr requires 2-D data")
+        import numpy as np
+        rows, cols = _np.nonzero(src)
+        data = src[rows, cols]
+        indptr = _np.zeros(src.shape[0] + 1, dtype=_np.int64)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return CSRNDArray(data, cols, indptr, src.shape, src.dtype, ctx)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """Reference: sparse.py zeros."""
+    dtype = dtype or _np.float32
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:]), dtype),
+                                _np.zeros((0,), _np.int64), shape, dtype,
+                                ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype), _np.zeros((0,), _np.int64),
+                          _np.zeros(shape[0] + 1, _np.int64), shape, dtype,
+                          ctx)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+empty = zeros
+
+
+def retain(data, indices):
+    """Reference: sparse_retain op."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return data.retain(indices)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference: src/operator/tensor/dot-inl.h sparse
+    paths): csr x dense and dense x dense fallbacks."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for csr dot")
+        out = _sk.csr_dot_dense(lhs.shape, lhs.data, lhs.indices,
+                                lhs.indptr, rhs._data,
+                                transpose_lhs=transpose_a)
+        return NDArray(out, ctx=rhs.context)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from . import dot as _dense_dot
+        return _dense_dot(lhs, rhs, transpose_a, transpose_b)
+    raise MXNetError("unsupported sparse dot combination: %s x %s"
+                     % (type(lhs).__name__, type(rhs).__name__))

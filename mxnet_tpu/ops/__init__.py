@@ -9,6 +9,12 @@ from . import nn           # noqa: F401
 from . import creation     # noqa: F401
 from . import random_ops   # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import linalg       # noqa: F401
+from . import control_flow  # noqa: F401
+from . import image_ops    # noqa: F401
+from . import contrib_ops  # noqa: F401
+from . import quantization_ops  # noqa: F401
+from . import sparse_ops   # noqa: F401
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "invoke_raw",
            "alias"]

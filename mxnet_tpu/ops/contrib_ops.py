@@ -1,0 +1,357 @@
+"""Contrib operators: vision/detection + CTC + transformer helpers.
+
+Reference: src/operator/contrib/ (ROIPooling roi_pooling.cc, ROIAlign
+roi_align.cc, bounding_box.cc box_nms/box_iou, multibox_prior.cc,
+ctc_loss.cc, transformer-inl.h). All TPU-native: vmapped gather/interp
+formulations instead of per-ROI CUDA kernels; CTC is a lax.scan
+forward algorithm in log space.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, alias
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (reference: src/operator/roi_pooling.cc,
+# src/operator/contrib/roi_align.cc)
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", attr_defaults={"pooled_size": (), "spatial_scale": 1.0})
+def _roi_pooling(data, rois, pooled_size=(), spatial_scale=1.0, **_ig):
+    """Max-pool each ROI to a fixed grid (reference: roi_pooling.cc).
+    rois: (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[b]     # (C, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def pool_cell(py, px):
+            hstart = y1 + (py * roi_h) // ph
+            hend = y1 + -(-((py + 1) * roi_h) // ph)
+            wstart = x1 + (px * roi_w) // pw
+            wend = x1 + -(-((px + 1) * roi_w) // pw)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            vals = jnp.where(mask[None], img, _NEG_INF)
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.any(mask), m, 0.0)
+
+        grid = jax.vmap(lambda py: jax.vmap(
+            lambda px: pool_cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        return jnp.transpose(grid, (2, 0, 1))   # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", attr_defaults={"pooled_size": (),
+                                              "spatial_scale": 1.0,
+                                              "sample_ratio": 2,
+                                              "position_sensitive": False})
+def _roi_align(data, rois, pooled_size=(), spatial_scale=1.0,
+               sample_ratio=2, position_sensitive=False, **_ig):
+    """Bilinear ROI align (reference: contrib/roi_align.cc)."""
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+    sr = max(int(sample_ratio), 1)
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = y - y0
+        wx = x - x0
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        roi_h = jnp.maximum(y2 - y1, 1.0)
+        roi_w = jnp.maximum(x2 - x1, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        img = data[b]
+
+        def cell(py, px):
+            acc = jnp.zeros((C,), dtype=data.dtype)
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + py * bin_h + (iy + 0.5) * bin_h / sr
+                    x = x1 + px * bin_w + (ix + 0.5) * bin_w / sr
+                    acc = acc + bilinear(img, y, x)
+            return acc / (sr * sr)
+
+        grid = jax.vmap(lambda py: jax.vmap(
+            lambda px: cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# bounding boxes (reference: src/operator/contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b, fmt="corner"):
+    if fmt == "center":
+        ax1, ay1 = a[..., 0] - a[..., 2] / 2, a[..., 1] - a[..., 3] / 2
+        ax2, ay2 = a[..., 0] + a[..., 2] / 2, a[..., 1] + a[..., 3] / 2
+        bx1, by1 = b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2
+        bx2, by2 = b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2
+    else:
+        ax1, ay1, ax2, ay2 = (a[..., i] for i in range(4))
+        bx1, by1, bx2, by2 = (b[..., i] for i in range(4))
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", attr_defaults={"format": "corner"})
+def _box_iou(lhs, rhs, format="corner", **_ig):
+    """Pairwise IoU (reference: bounding_box.cc box_iou)."""
+    return _iou_matrix(lhs, rhs, format)
+
+
+@register("_contrib_box_nms", attr_defaults={
+    "overlap_thresh": 0.5, "valid_thresh": 0, "topk": -1, "coord_start": 2,
+    "score_index": 1, "id_index": -1, "force_suppress": False,
+    "in_format": "corner", "out_format": "corner", "background_id": -1})
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             force_suppress=False, in_format="corner", out_format="corner",
+             background_id=-1, **_ig):
+    """Non-maximum suppression (reference: bounding_box.cc box_nms).
+    Suppressed entries are set to -1, preserving shape (same contract)."""
+    orig_shape = data.shape
+    x = data.reshape((-1,) + orig_shape[-2:]) if data.ndim > 2 \
+        else data[None]
+
+    def one_batch(boxes):
+        n = boxes.shape[0]
+        scores = boxes[:, score_index]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        sorted_boxes = boxes[order]
+        coords = sorted_boxes[:, coord_start:coord_start + 4]
+        iou = _iou_matrix(coords, coords, in_format)
+        same_class = jnp.ones((n, n), dtype=bool)
+        if id_index >= 0 and not force_suppress:
+            ids = sorted_boxes[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & same_class[i] & keep[i]
+            sup = sup & (jnp.arange(n) > i)
+            return jnp.where(sup, False, keep)
+
+        keep0 = valid[order]
+        if topk > 0:
+            keep0 = keep0 & (jnp.arange(n) < topk)
+        keep = lax.fori_loop(0, n, body, keep0)
+        kept_sorted = jnp.where(keep[:, None], sorted_boxes, -1.0)
+        # scatter back to the original positions (reference keeps order)
+        out = jnp.full_like(boxes, -1.0)
+        out = out.at[order].set(kept_sorted)
+        return out
+
+    out = jax.vmap(one_batch)(x)
+    return out.reshape(orig_shape)
+
+
+@register("_contrib_MultiBoxPrior", attr_defaults={
+    "sizes": (1.0,), "ratios": (1.0,), "clip": False,
+    "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)})
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **_ig):
+    """Anchor box generation (reference: contrib/multibox_prior.cc)."""
+    H, W = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    num = len(sizes) + len(ratios) - 1
+    ws, hs = [], []
+    for i in range(num):
+        if i < len(sizes):
+            s = sizes[i]
+            w = s * jnp.sqrt(jnp.asarray(ratios[0]))
+            h = s / jnp.sqrt(jnp.asarray(ratios[0]))
+        else:
+            r = ratios[i - len(sizes) + 1]
+            w = sizes[0] * jnp.sqrt(jnp.asarray(r))
+            h = sizes[0] / jnp.sqrt(jnp.asarray(r))
+        ws.append(w)
+        hs.append(h)
+    ws = jnp.stack(ws)
+    hs = jnp.stack(hs)
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxg, cyg], axis=-1)[:, :, None, :]
+    wh = jnp.stack([ws, hs], axis=-1)[None, None, :, :]
+    x1y1 = centers - wh / 2
+    x2y2 = centers + wh / 2
+    anchors = jnp.concatenate([x1y1, x2y2], axis=-1).reshape(-1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors[None]
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/contrib/ctc_loss.cc; vendored
+# warp-ctc replaced by a lax.scan forward algorithm in log space)
+# ---------------------------------------------------------------------------
+
+def _ctc_forward(log_probs, labels, input_len, label_len):
+    """Negative log likelihood for one sequence. log_probs: (T, A) with
+    blank=0; labels: (L,) 1-based class ids."""
+    T, A = log_probs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((S,), dtype=jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    s_idx = jnp.arange(S)
+    valid_s = s_idx < (2 * label_len + 1)
+
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.zeros(2, jnp.int32), ext[:-2]])
+    can_skip = (s_idx % 2 == 1) & (ext != ext_prev2) & (s_idx >= 2)
+
+    alpha0 = jnp.full((S,), _NEG_INF)
+    alpha0 = alpha0.at[0].set(log_probs[0, 0])
+    alpha0 = jnp.where((s_idx == 1) & (label_len > 0),
+                       log_probs[0, ext[1]], alpha0)
+
+    def logaddexp3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m)
+                           + jnp.exp(c - m))
+
+    def step(alpha, t):
+        lp = log_probs[t]
+        prev1 = jnp.concatenate([jnp.full((1,), _NEG_INF), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), _NEG_INF), alpha[:-2]])
+        prev2 = jnp.where(can_skip, prev2, _NEG_INF)
+        a = logaddexp3(alpha, prev1, prev2) + lp[ext]
+        a = jnp.where(valid_s, a, _NEG_INF)
+        a = jnp.where(t < input_len, a, alpha)
+        return a, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha[2 * label_len]          # last blank
+    end2 = jnp.where(label_len > 0,
+                     alpha[jnp.maximum(2 * label_len - 1, 0)], _NEG_INF)
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    return -ll
+
+
+@register("CTCLoss", attr_defaults={"use_data_lengths": False,
+                                    "use_label_lengths": False,
+                                    "blank_label": "first"})
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **_ig):
+    """CTC loss (reference: contrib/ctc_loss.cc). data: (T, N, A) raw
+    activations (softmax applied internally like the reference), label:
+    (N, L) with padding (0 when blank is 'last', -1/0 padding when
+    'first' uses 1-based relabeling like warp-ctc)."""
+    T, N, A = data.shape
+    log_probs = jax.nn.log_softmax(data, axis=-1)
+    if blank_label == "last":
+        # move blank from A-1 to 0; labels already 0-based classes
+        perm = jnp.concatenate([jnp.asarray([A - 1]), jnp.arange(A - 1)])
+        log_probs = log_probs[..., perm]
+        labels = label.astype(jnp.int32) + 1
+    else:
+        labels = label.astype(jnp.int32)   # classes are 1..A-1, 0=blank pad
+
+    if use_data_lengths and data_lengths is not None:
+        in_lens = data_lengths.astype(jnp.int32)
+    else:
+        in_lens = jnp.full((N,), T, dtype=jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        lab_lens = label_lengths.astype(jnp.int32)
+    else:
+        lab_lens = jnp.sum((labels > 0).astype(jnp.int32), axis=-1)
+
+    return jax.vmap(_ctc_forward, in_axes=(1, 0, 0, 0))(
+        log_probs, labels, in_lens, lab_lens)
+
+
+alias("_contrib_CTCLoss", "CTCLoss")
+alias("ctc_loss", "CTCLoss")
+
+
+# ---------------------------------------------------------------------------
+# transformer helpers (reference: src/operator/contrib/transformer-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], dtype=data.dtype))
+
+
+@register("_contrib_dot_product_attention", attr_defaults={"dropout": 0.0,
+                                                           "masked": False},
+          needs_rng=True)
+def _dot_product_attention(key, q, k, v, mask=None, dropout=0.0,
+                           masked=False, **_ig):
+    """Scaled dot-product attention: softmax(QK^T/sqrt(d))V — single
+    fused op (reference capability: transformer-inl.h; XLA fuses the
+    chain; see also parallel.ring_attention for the sharded version)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(
+        jnp.asarray(d, dtype=q.dtype))
+    if masked and mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0:
+        keep = 1.0 - dropout
+        w = w * jax.random.bernoulli(key, keep, w.shape) / keep
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+@register("_contrib_arange_like", attr_defaults={"start": 0.0, "step": 1.0,
+                                                 "repeat": 1, "axis": None})
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_ig):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=data.dtype)
+        return out.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)

@@ -1,0 +1,162 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc:63 (_foreach / _while_loop /
+_cond executing nnvm subgraphs with state threading) + the Python façade
+python/mxnet/symbol/contrib.py:215 and ndarray/contrib.py.
+
+TPU-native design: the loop *body is a Python function over NDArrays*
+(like the Gluon-facing contrib API). ``foreach`` lowers to ``lax.scan``
+— one compiled step reused across iterations, the XLA-idiomatic
+replacement for the reference's per-iteration subgraph execution.
+``while_loop`` lowers to ``lax.while_loop`` when not recording (XLA
+cannot reverse-differentiate a dynamic loop) and falls back to an eager,
+tape-recorded Python loop under autograd — matching the reference's
+differentiable while semantics. ``cond`` evaluates the predicate eagerly
+(PjRt async makes this cheap) and runs one branch on the tape.
+
+These are exposed as ``mx.nd.contrib.foreach`` etc. (see
+ndarray/contrib.py)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over axis 0 of ``data``
+    (reference: control_flow.cc _foreach; contrib.py:215 foreach).
+
+    body(data_t, states) -> (outputs_t, new_states)
+    """
+    import jax
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+
+    data_l, data_is_list = _as_list(data)
+    states_l, states_is_list = _as_list(init_states)
+
+    if autograd.is_recording():
+        # eager unroll: every op lands on the tape -> differentiable
+        outputs = []
+        states = list(states_l)
+        length = data_l[0].shape[0]
+        for t in range(length):
+            slice_t = [d[t] for d in data_l]
+            out_t, states = body(slice_t if data_is_list else slice_t[0],
+                                 states if states_is_list else states[0])
+            states, _ = _as_list(states)
+            out_t, _ = _as_list(out_t)
+            outputs.append(out_t)
+        from ..ndarray.ndarray import invoke_op
+        stacked = [invoke_op("stack", [o[i] for o in outputs], {"axis": 0})
+                   for i in range(len(outputs[0]))]
+        out = stacked if len(stacked) > 1 else stacked[0]
+        sts = states if states_is_list else states[0]
+        return out, sts
+
+    def step(carry, xs):
+        state_nd = [NDArray(c) for c in carry]
+        x_nd = [NDArray(x) for x in xs]
+        out, new_states = body(x_nd if data_is_list else x_nd[0],
+                               state_nd if states_is_list else state_nd[0])
+        new_states, _ = _as_list(new_states)
+        out, _ = _as_list(out)
+        return tuple(s._data for s in new_states), \
+            tuple(o._data for o in out)
+
+    carry0 = tuple(s._data for s in states_l)
+    xs = tuple(d._data for d in data_l)
+    final_carry, ys = jax.lax.scan(step, carry0, xs)
+    outs = [NDArray(y) for y in ys]
+    sts = [NDArray(c) for c in final_carry]
+    return (outs if len(outs) > 1 else outs[0]), \
+        (sts if states_is_list else sts[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference: control_flow.cc _while_loop; contrib.py while_loop.
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars). Returns (outputs, final_loop_vars);
+    outputs are stacked to ``max_iterations`` with valid length equal to
+    the actual iteration count (reference semantics).
+    """
+    import jax
+    import jax.numpy as jnp
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray, invoke_op
+
+    if max_iterations is None:
+        raise MXNetError("max_iterations is required "
+                         "(reference: contrib.while_loop)")
+    loop_vars, _vars_is_list = _as_list(loop_vars)
+
+    if autograd.is_recording():
+        # differentiable path: eager Python loop on the tape
+        outputs = []
+        steps = 0
+        cur = list(loop_vars)
+        while steps < max_iterations and bool(cond(*cur).asscalar()):
+            out, cur = func(*cur)
+            cur, _ = _as_list(cur)
+            out, _ = _as_list(out)
+            outputs.append(out)
+            steps += 1
+        if not outputs:
+            raise MXNetError("while_loop ran zero iterations; cannot "
+                             "infer output shapes (reference behavior)")
+        n_out = len(outputs[0])
+        stacked = []
+        for i in range(n_out):
+            rows = [o[i] for o in outputs]
+            s = invoke_op("stack", rows, {"axis": 0})
+            if steps < max_iterations:
+                pad_shape = (max_iterations - steps,) + rows[0].shape
+                from ..ndarray.ndarray import zeros
+                s = invoke_op("Concat",
+                              [s, zeros(pad_shape, dtype=s.dtype)],
+                              {"dim": 0})
+            stacked.append(s)
+        return (stacked if n_out > 1 else stacked[0]), \
+            (cur if len(cur) > 1 else cur[0])
+
+    # compiled path: fixed-trip scan with a "still running" mask (XLA
+    # needs static shapes; this is the standard masked-while lowering)
+    def step(carry, _):
+        vals, active, count = carry
+        nd_vals = [NDArray(v) for v in vals]
+        pred = cond(*nd_vals)._data.astype(bool).reshape(())
+        run = jnp.logical_and(active, pred)
+        out, new_vals = func(*nd_vals)
+        new_vals, _ = _as_list(new_vals)
+        out, _ = _as_list(out)
+        sel_vals = tuple(
+            jnp.where(run, nv._data, v) for nv, v in zip(new_vals, vals))
+        outs = tuple(jnp.where(run, o._data,
+                               jnp.zeros_like(o._data)) for o in out)
+        return (sel_vals, run, count + run.astype(jnp.int32)), outs
+
+    vals0 = tuple(v._data for v in loop_vars)
+    (final_vals, _act, count), ys = jax.lax.scan(
+        step, (vals0, jnp.asarray(True), jnp.asarray(0)), None,
+        length=max_iterations)
+    outs = [NDArray(y) for y in ys]
+    finals = [NDArray(v) for v in final_vals]
+    return (outs if len(outs) > 1 else outs[0]), \
+        (finals if len(finals) > 1 else finals[0])
+
+
+def cond(pred, then_func, else_func):
+    """Reference: control_flow.cc _cond; contrib.py cond. Predicate is
+    evaluated eagerly; the taken branch runs on the tape (differentiable).
+    """
+    take_then = bool(pred.asscalar()) if hasattr(pred, "asscalar") \
+        else bool(pred)
+    return then_func() if take_then else else_func()

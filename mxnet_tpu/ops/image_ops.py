@@ -1,0 +1,113 @@
+"""Image operators (device-side).
+
+Reference: src/operator/image/image_random-inl.h (_image_to_tensor,
+_image_normalize, flips, crops, color jitter ops powering Gluon
+transforms). Random ops thread the runtime PRNG key like every other
+RNG op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+
+
+@register("_image_to_tensor")
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float [0,1]
+    (reference: image_random-inl.h ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", attr_defaults={"mean": (0.0,), "std": (1.0,)})
+def _normalize(data, mean=(0.0,), std=(1.0,), **_ig):
+    mean = jnp.asarray(mean, dtype=data.dtype)
+    std = jnp.asarray(std, dtype=data.dtype)
+    shape = (-1,) + (1,) * (data.ndim - 1 - (1 if data.ndim == 4 else 0))
+    if data.ndim == 4:
+        mean = mean.reshape((1, -1, 1, 1))
+        std = std.reshape((1, -1, 1, 1))
+    else:
+        mean = mean.reshape((-1, 1, 1))
+        std = std.reshape((-1, 1, 1))
+    return (data - mean) / std
+
+
+@register("_image_flip_left_right")
+def _flip_lr(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_flip_top_bottom")
+def _flip_tb(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register("_image_random_flip_left_right", needs_rng=True)
+def _random_flip_lr(key, data):
+    coin = jax.random.bernoulli(key)
+    return jnp.where(coin, jnp.flip(data, axis=-2), data)
+
+
+@register("_image_random_flip_top_bottom", needs_rng=True)
+def _random_flip_tb(key, data):
+    coin = jax.random.bernoulli(key)
+    return jnp.where(coin, jnp.flip(data, axis=-3), data)
+
+
+@register("_image_crop", attr_defaults={"x": 0, "y": 0, "width": 0,
+                                        "height": 0})
+def _crop(data, x=0, y=0, width=0, height=0, **_ig):
+    """Fixed crop on HWC (reference: crop op in image/crop.h)."""
+    return jax.lax.dynamic_slice(
+        data, (y, x, 0), (height, width, data.shape[-1]))
+
+
+@register("_image_random_brightness", needs_rng=True,
+          attr_defaults={"min_factor": 0.0, "max_factor": 1.0})
+def _random_brightness(key, data, min_factor=0.0, max_factor=1.0, **_ig):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data.astype(jnp.float32) * alpha
+
+
+@register("_image_random_contrast", needs_rng=True,
+          attr_defaults={"min_factor": 0.0, "max_factor": 1.0})
+def _random_contrast(key, data, min_factor=0.0, max_factor=1.0, **_ig):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], dtype=jnp.float32)
+    x = data.astype(jnp.float32)
+    gray = jnp.mean(x * coef, axis=(-3, -2, -1), keepdims=True) * 3.0
+    return x * alpha + gray * (1.0 - alpha)
+
+
+@register("_image_random_saturation", needs_rng=True,
+          attr_defaults={"min_factor": 0.0, "max_factor": 1.0})
+def _random_saturation(key, data, min_factor=0.0, max_factor=1.0, **_ig):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], dtype=jnp.float32)
+    x = data.astype(jnp.float32)
+    gray = jnp.sum(x * coef, axis=-1, keepdims=True)
+    return x * alpha + gray * (1.0 - alpha)
+
+
+@register("_image_resize", attr_defaults={"size": (), "keep_ratio": False,
+                                          "interp": 1})
+def _resize(data, size=(), keep_ratio=False, interp=1, **_ig):
+    """Bilinear/nearest resize on HWC or NHWC
+    (reference: image/resize.h; device-side analog of cv2 path)."""
+    if isinstance(size, int):
+        size = (size, size)
+    if not size:
+        raise MXNetError("_image_resize requires size")
+    method = "nearest" if interp == 0 else "linear"
+    if data.ndim == 3:
+        out_shape = (size[1], size[0], data.shape[-1])
+    else:
+        out_shape = (data.shape[0], size[1], size[0], data.shape[-1])
+    return jax.image.resize(data.astype(jnp.float32), out_shape,
+                            method=method).astype(data.dtype)

@@ -433,3 +433,48 @@ def _depth_to_space(x, block_size=1):
     x = x.reshape(n, b, b, c // (b * b), h, w)
     x = x.transpose(0, 3, 4, 1, 5, 2)
     return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# basic indexing as a differentiable op (the reference records slice ops
+# for basic __getitem__, python/mxnet/ndarray/ndarray.py _get_nd_basic_indexing)
+# ---------------------------------------------------------------------------
+
+def encode_index_key(key):
+    """Encode an int/slice/Ellipsis/None tuple key into a hashable attr."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    out = []
+    for k in key:
+        if isinstance(k, (int,)) or hasattr(k, "__index__"):
+            out.append(("i", int(k)))
+        elif isinstance(k, slice):
+            out.append(("s", k.start, k.stop, k.step))
+        elif k is Ellipsis:
+            out.append(("e",))
+        elif k is None:
+            out.append(("n",))
+        else:
+            return None   # advanced indexing: caller falls back
+    return tuple(out)
+
+
+def decode_index_key(enc):
+    out = []
+    for item in enc:
+        tag = item[0]
+        if tag == "i":
+            out.append(item[1])
+        elif tag == "s":
+            out.append(slice(item[1], item[2], item[3]))
+        elif tag == "e":
+            out.append(Ellipsis)
+        else:
+            out.append(None)
+    return tuple(out)
+
+
+@register("_getitem", attr_defaults={"key": ()})
+def _getitem(data, key=()):
+    """Basic indexing (differentiable; vjp is the scatter of the slice)."""
+    return data[decode_index_key(key)]

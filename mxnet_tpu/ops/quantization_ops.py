@@ -1,0 +1,179 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (quantize.cc, dequantize.cc,
+requantize.cc, quantized_conv.cc, quantized_fully_connected.cc,
+quantized_pooling.cc). TPU-native: int8 arithmetic feeds the MXU via
+XLA's integer dot/conv; min/max calibration ranges ride along as extra
+outputs exactly like the reference's (out, min, max) triples.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, get_op
+
+_INT8_MIN, _INT8_MAX = -127.0, 127.0
+
+
+def _range_scale(min_r, max_r):
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(amax > 0, _INT8_MAX / amax, 1.0)
+
+
+@register("_contrib_quantize", num_outputs=3,
+          attr_defaults={"out_type": "int8"})
+def _quantize(data, min_range, max_range, out_type="int8", **_ig):
+    """fp32 -> int8 with explicit range (reference: quantize.cc).
+    Returns (q, min, max)."""
+    scale = _range_scale(min_range, max_range)
+    q = jnp.clip(jnp.round(data * scale), _INT8_MIN, _INT8_MAX) \
+        .astype(jnp.int8)
+    return q, min_range.reshape(()), max_range.reshape(())
+
+
+@register("_contrib_quantize_v2", num_outputs=3,
+          attr_defaults={"out_type": "int8", "min_calib_range": None,
+                         "max_calib_range": None})
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None, **_ig):
+    """fp32 -> int8, range from calibration or the data itself
+    (reference: quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, dtype=jnp.float32)
+        mx = jnp.asarray(max_calib_range, dtype=jnp.float32)
+    else:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(data * scale), _INT8_MIN, _INT8_MAX) \
+        .astype(jnp.int8)
+    return q, mn.reshape(()), mx.reshape(())
+
+
+@register("_contrib_dequantize", attr_defaults={"out_type": "float32"})
+def _dequantize(data, min_range, max_range, out_type="float32", **_ig):
+    """int8 -> fp32 (reference: dequantize.cc)."""
+    scale = _range_scale(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register("_contrib_requantize", num_outputs=3,
+          attr_defaults={"min_calib_range": None, "max_calib_range": None})
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, **_ig):
+    """int32 accumulators -> int8 (reference: requantize.cc)."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        / (2.0 ** 31 - 1))
+    if min_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(real * scale), _INT8_MIN, _INT8_MAX) \
+        .astype(jnp.int8)
+    return q, mn.reshape(()), mx.reshape(())
+
+
+def _q_range_out(x_int32, min_a, max_a, min_b, max_b):
+    """Range of an int32 accumulation of int8*int8 products."""
+    scale_a = _range_scale(min_a, max_a)
+    scale_b = _range_scale(min_b, max_b)
+    real = x_int32.astype(jnp.float32) / (scale_a * scale_b)
+    return real
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          attr_defaults={"num_hidden": 0, "no_bias": False, "flatten": True})
+def _quantized_fc(*arrays, num_hidden=0, no_bias=False, flatten=True,
+                  **_ig):
+    """INT8 FC with int32 accumulation on the MXU
+    (reference: quantized_fully_connected.cc). Returns fp32-equivalent
+    int32 outputs + ranges; chain with requantize.
+
+    Inputs (reference order): data, weight[, bias], min_data, max_data,
+    min_weight, max_weight[, min_bias, max_bias]."""
+    if no_bias or len(arrays) == 6:
+        data, weight, min_data, max_data, min_weight, max_weight = arrays
+        bias = min_bias = max_bias = None
+        no_bias = True
+    else:
+        (data, weight, bias, min_data, max_data, min_weight, max_weight,
+         min_bias, max_bias) = arrays
+    x = data.astype(jnp.int32)
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    out = lax.dot_general(
+        x, weight.astype(jnp.int32),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    real = _q_range_out(out, min_data, max_data, min_weight, max_weight)
+    if not no_bias and bias is not None:
+        scale_b = _range_scale(min_bias, max_bias)
+        real = real + bias.astype(jnp.float32) / scale_b
+    mn = jnp.min(real)
+    mx = jnp.max(real)
+    scale = jnp.where((2.0 ** 31 - 1) > 0,
+                      (2.0 ** 31 - 1) / jnp.maximum(jnp.abs(mn),
+                                                    jnp.abs(mx)), 1.0)
+    q32 = jnp.round(real * scale).astype(jnp.int32)
+    return q32, mn.reshape(()), mx.reshape(())
+
+
+@register("_contrib_quantized_conv", num_outputs=3,
+          attr_defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                         "num_filter": 0, "num_group": 1, "no_bias": True,
+                         "layout": None})
+def _quantized_conv(data, weight, min_data, max_data, min_weight,
+                    max_weight, kernel=(), stride=(), dilate=(), pad=(),
+                    num_filter=0, num_group=1, no_bias=True, layout=None,
+                    **_ig):
+    """INT8 convolution (reference: quantized_conv.cc)."""
+    nd = len(kernel)
+    stride = tuple(stride) or (1,) * nd
+    dilate = tuple(dilate) or (1,) * nd
+    pad = tuple(pad) or (0,) * nd
+    dims = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dims)
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    real = _q_range_out(out, min_data, max_data, min_weight, max_weight)
+    mn = jnp.min(real)
+    mx = jnp.max(real)
+    scale = (2.0 ** 31 - 1) / jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    q32 = jnp.round(real * scale).astype(jnp.int32)
+    return q32, mn.reshape(()), mx.reshape(())
+
+
+@register("_contrib_quantized_pooling", num_outputs=3,
+          attr_defaults={"kernel": (), "pool_type": "max",
+                         "global_pool": False, "stride": (), "pad": (),
+                         "pooling_convention": "valid"})
+def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                       global_pool=False, stride=(), pad=(),
+                       pooling_convention="valid", **_ig):
+    """INT8 pooling (reference: quantized_pooling.cc): pool in int8,
+    ranges pass through."""
+    pool = get_op("Pooling")
+    out = pool.fn(data.astype(jnp.float32), kernel=kernel,
+                  pool_type=pool_type, global_pool=global_pool,
+                  stride=stride, pad=pad,
+                  pooling_convention=pooling_convention)
+    return out.astype(data.dtype), min_data.reshape(()), \
+        max_data.reshape(())
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape((data.shape[0], -1)), min_data.reshape(()), \
+        max_data.reshape(())

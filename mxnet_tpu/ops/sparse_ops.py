@@ -1,0 +1,101 @@
+"""Sparse kernels (row-sparse + CSR) on raw jax arrays.
+
+Reference: src/operator/tensor/cast_storage-inl.h, dot-inl.h (sparse
+dot), sparse_retain-inl.h, and the FComputeEx sparse dispatch
+(include/mxnet/op_attr_types.h FComputeEx).
+
+TPU-native: XLA has no native sparse formats, so kernels use
+gather/scatter/segment-sum formulations over the component arrays —
+dense MXU-friendly compute on the nonzero blocks. The user-visible
+storage classes live in ndarray/sparse.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dense_to_rsp", "rsp_to_dense", "dense_to_csr", "csr_to_dense",
+           "csr_dot_dense", "rsp_retain", "rsp_add_rsp", "dot_dense_t_dense_rsp"]
+
+
+def dense_to_rsp(dense):
+    """Dense -> (indices, values) keeping rows with any nonzero
+    (reference: cast_storage-inl.h CastStorageDnsRspImpl). Static-shape
+    variant: keeps ALL rows (nnz == #rows) — the compiled-path analog;
+    the NDArray layer trims on host when exact nnz is wanted."""
+    n = dense.shape[0]
+    indices = jnp.arange(n, dtype=jnp.int64)
+    return indices, dense
+
+
+def rsp_to_dense(shape, indices, values):
+    out = jnp.zeros(shape, dtype=values.dtype)
+    return out.at[indices].set(values)
+
+
+def dense_to_csr(dense):
+    """Dense -> (data, indices, indptr) with static nnz = size (padded);
+    host-side trimming happens in the NDArray layer."""
+    m, n = dense.shape
+    mask = dense != 0
+    # count per row
+    counts = mask.sum(axis=1)
+    indptr = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                              jnp.cumsum(counts)]).astype(jnp.int64)
+    # order: row-major scan of nonzeros; use argsort on (~mask) to bring
+    # nonzeros of each row forward, then gather
+    cols = jnp.broadcast_to(jnp.arange(n), (m, n))
+    order = jnp.argsort(~mask, axis=1, stable=True)
+    sorted_vals = jnp.take_along_axis(dense, order, axis=1)
+    sorted_cols = jnp.take_along_axis(cols, order, axis=1)
+    return sorted_vals, sorted_cols, indptr, counts
+
+
+def csr_to_dense(shape, data, indices, indptr):
+    m, n = shape
+    out = jnp.zeros(shape, dtype=data.dtype)
+    # row id per nnz via searchsorted on indptr
+    nnz = data.shape[0]
+    rows = jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                            side="right") - 1
+    return out.at[rows, indices].add(data)
+
+
+def csr_dot_dense(shape, data, indices, indptr, rhs, transpose_lhs=False):
+    """dot(csr, dense) (reference: dot-inl.h DotCsrDnsDns...). rows
+    derived with searchsorted; products accumulated with segment_sum —
+    the gather/scatter formulation XLA vectorizes well."""
+    m, n = shape
+    nnz = data.shape[0]
+    rows = jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                            side="right") - 1
+    gathered = rhs[indices] * data[:, None]          # (nnz, k)
+    if transpose_lhs:
+        # out[n, k] = sum over nnz with col index as target
+        out = jnp.zeros((n, rhs.shape[1]), dtype=rhs.dtype)
+        return out.at[indices].add(rhs[rows] * data[:, None])
+    out = jax.ops.segment_sum(gathered, rows, num_segments=m)
+    return out
+
+
+def rsp_retain(indices, values, to_retain):
+    """sparse_retain (reference: sparse_retain-inl.h): keep listed rows."""
+    # membership test via searchsorted on the stored indices
+    pos = jnp.searchsorted(indices, to_retain)
+    pos = jnp.clip(pos, 0, indices.shape[0] - 1)
+    hit = indices[pos] == to_retain
+    vals = jnp.where(hit[(...,) + (None,) * (values.ndim - 1)],
+                     values[pos], 0)
+    return to_retain, vals
+
+
+def rsp_add_rsp(shape, ia, va, ib, vb):
+    """row_sparse + row_sparse -> dense-backed row result."""
+    dense = rsp_to_dense(shape, ia, va) + rsp_to_dense(shape, ib, vb)
+    return dense
+
+
+def dot_dense_t_dense_rsp(lhs, rhs):
+    """dot(dense^T, dense) producing row_sparse gradient layout
+    (embedding-gradient pattern, reference: dot-inl.h)."""
+    return jnp.matmul(lhs.T, rhs)
