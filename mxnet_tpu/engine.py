@@ -1,0 +1,67 @@
+"""Execution-engine façade.
+
+Reference: src/engine/ (ThreadedEnginePerDevice default, NaiveEngine
+serial debug mode selected by MXNET_ENGINE_TYPE, bulk-size API
+mxnet.engine.bulk / set_bulk_size).
+
+TPU-native: scheduling IS PjRt async dispatch + XLA program order, so
+there are no worker pools to manage. What this module preserves:
+* ``MXNET_ENGINE_TYPE=NaiveEngine`` — serialize after every op
+  (block_until_ready), the degrade-to-serial debug mode the reference
+  documents for race hunting (docs/faq/env_var.md:77);
+* bulking API — a no-op knob (XLA fusion already bulks; the reference's
+  MXNET_EXEC_BULK_* exists to amortize per-op overhead that the jit
+  cache removes), kept for API parity;
+* exception semantics: deferred device errors surface at sync points
+  (wait_to_read/asnumpy/waitall), like engine exception propagation to
+  WaitForVar (threaded_engine.cc:474-476).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["is_naive", "set_bulk_size", "bulk", "profiling_imperative"]
+
+_local = threading.local()
+_engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+_bulk_size = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+
+
+def is_naive():
+    """True when running in serial debug mode."""
+    return _engine_type == "NaiveEngine"
+
+
+def set_engine_type(name):
+    global _engine_type
+    _engine_type = name
+
+
+def profiling_imperative():
+    from . import profiler
+    return profiler.is_running()
+
+
+def set_bulk_size(size):
+    """Reference: mxnet.engine.set_bulk_size — returns the previous
+    value. Bulking is subsumed by XLA fusion; the knob is preserved."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+class bulk(object):
+    """Scope form (reference: mxnet.engine.bulk)."""
+
+    def __init__(self, size):
+        self._size = size
+        self._old = None
+
+    def __enter__(self):
+        self._old = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._old)

@@ -1,0 +1,85 @@
+"""Monitor: spy on tensor statistics during execution.
+
+Reference: python/mxnet/monitor.py (Monitor installed via executor
+monitor callback, GraphExecutor::ExecuteMonCallback
+src/executor/graph_executor.cc:1295). Here the hook rides the Block
+forward hooks / Executor output capture.
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.norm() / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Attach to an Executor (reference: monitor.py install)."""
+        exe._monitor = self
+        self.exes.append(exe)
+
+    def install_block(self, block):
+        """Attach to a Gluon block tree via forward hooks."""
+        def hook(blk, _in, out):
+            if not self.activated:
+                return
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                if isinstance(o, NDArray) and \
+                        self.re_prog.match(blk.name):
+                    self.queue.append((self.step, "%s_output%d"
+                                       % (blk.name, i),
+                                       self.stat_func(o)))
+        block.apply(lambda b: b.register_forward_hook(hook))
+
+    def tic(self):
+        """Start collecting for this step (reference: monitor.py tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Collect stats (reference: monitor.py toc)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, arr in getattr(exe, "output_dict", {}).items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(arr)))
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ",".join("%f" % float(v.asnumpy().reshape(-1)[0])
+                         if isinstance(v, NDArray) else str(v)
+                         for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: %7d %30s %s" % (n, k, v))

@@ -475,7 +475,21 @@ def invoke_op(name, inputs, attrs, out=None):
     if ctx is None:
         ctx = current_context()
 
-    raw_out = _reg.invoke_raw(op, arrays, attrs)
+    from .. import engine as _engine
+    if _engine.profiling_imperative():
+        from .. import profiler as _prof
+        with _prof.scope(name, "operator"):
+            raw_out = _reg.invoke_raw(op, arrays, attrs)
+            if _engine.is_naive():
+                for o in raw_out:
+                    o.block_until_ready()
+    else:
+        raw_out = _reg.invoke_raw(op, arrays, attrs)
+        if _engine.is_naive():
+            # NaiveEngine debug mode: serialize every op (reference:
+            # src/engine/naive_engine.cc, MXNET_ENGINE_TYPE)
+            for o in raw_out:
+                o.block_until_ready()
     if not any(isinstance(x, NDArray) for x in inputs):
         # creation ops: honor the claimed context's device (the reference
         # allocates on ctx; JAX would otherwise use the default device)

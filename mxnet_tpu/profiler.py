@@ -1,0 +1,264 @@
+"""Profiler: op-level tracing + chrome://tracing dump + XLA profiler.
+
+Reference: src/profiler/profiler.h:256-304 (modes kSymbolic/kImperative/
+kAPI/kMemory, chrome-trace JSON via DumpProfile, aggregate tables
+aggregate_stats.cc) and python/mxnet/profiler.py:473 (set_config /
+start / stop / dump(s), Task/Frame/Counter/Marker user objects).
+
+TPU-native: two layers —
+1. a host-side event recorder hooked into ``invoke_op`` (per-op begin/
+   end, like the reference's OprBlock::opr_profile hook on engine
+   workers) emitting chrome://tracing JSON;
+2. the XLA/PjRt device profiler (``jax.profiler``) for on-device traces
+   viewable in TensorBoard/XProf — the analog of cuda events, toggled by
+   the same start/stop calls when ``profile_device=True``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "Task", "Frame", "Counter", "Marker",
+           "Domain", "scope"]
+
+_state = threading.local()
+_config = {"filename": "profile.json", "profile_imperative": True,
+           "profile_symbolic": True, "profile_api": True,
+           "profile_memory": False, "profile_device": False,
+           "aggregate_stats": False, "xla_logdir": None}
+_events = []
+_events_lock = threading.Lock()
+_running = False
+_paused = False
+_xla_active = False
+_t0 = None
+
+
+def set_config(**kwargs):
+    """Reference: profiler.py set_config."""
+    for k, v in kwargs.items():
+        if k in ("filename", "profile_all", "profile_imperative",
+                 "profile_symbolic", "profile_api", "profile_memory",
+                 "profile_device", "aggregate_stats", "xla_logdir",
+                 "continuous_dump", "profile_process"):
+            if k == "profile_all" and v:
+                _config.update(profile_imperative=True,
+                               profile_symbolic=True, profile_api=True,
+                               profile_memory=True, profile_device=True)
+            elif k in _config:
+                _config[k] = v
+        else:
+            raise MXNetError("unknown profiler config %r" % k)
+
+
+profiler_set_config = set_config
+
+
+def start():
+    """Begin collecting (reference: profiler.py set_state('run'))."""
+    global _running, _t0, _xla_active
+    _running = True
+    if _t0 is None:
+        _t0 = time.perf_counter()
+    if _config["profile_device"]:
+        import jax
+        logdir = _config["xla_logdir"] or os.path.splitext(
+            _config["filename"])[0] + "_xla"
+        try:
+            jax.profiler.start_trace(logdir)
+            _xla_active = True
+        except Exception:
+            _xla_active = False
+
+
+def stop():
+    global _running, _xla_active
+    _running = False
+    if _xla_active:
+        import jax
+        jax.profiler.stop_trace()
+        _xla_active = False
+
+
+def pause():
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def set_state(state="stop"):
+    """Reference: profiler.py set_state."""
+    if state in ("run", "start"):
+        start()
+    elif state == "stop":
+        stop()
+    else:
+        raise MXNetError("invalid profiler state %r" % state)
+
+
+profiler_set_state = set_state
+
+
+def is_running():
+    return _running and not _paused
+
+
+def record_event(name, category, t_start, t_end, args=None):
+    """Append one complete event (us timestamps relative to profiler
+    start) — the analog of ProfileOperator entries."""
+    with _events_lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": t_start * 1e6, "dur": (t_end - t_start) * 1e6,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000,
+                        "args": args or {}})
+
+
+def record_instant(name, category, args=None):
+    with _events_lock:
+        _events.append({"name": name, "cat": category, "ph": "i",
+                        "ts": (time.perf_counter() - (_t0 or 0)) * 1e6,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000,
+                        "s": "p", "args": args or {}})
+
+
+def record_counter(name, value):
+    with _events_lock:
+        _events.append({"name": name, "ph": "C",
+                        "ts": (time.perf_counter() - (_t0 or 0)) * 1e6,
+                        "pid": os.getpid(),
+                        "args": {"value": value}})
+
+
+class _OpScope(object):
+    """Context manager timing one op dispatch; used by invoke_op."""
+
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter() - _t0
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, "operator", self.t0,
+                     time.perf_counter() - _t0)
+
+
+def scope(name, category="operator"):
+    class _S:
+        def __enter__(self):
+            self.t0 = time.perf_counter() - (_t0 or time.perf_counter())
+            return self
+
+        def __exit__(self, *exc):
+            record_event(name, category, self.t0,
+                         time.perf_counter() - (_t0 or 0))
+    return _S()
+
+
+def dumps(reset=False):
+    """Aggregate per-op stats table as a string
+    (reference: profiler.py dumps / aggregate_stats.cc)."""
+    with _events_lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    stats = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        s = stats.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+        s[0] += 1
+        s[1] += e["dur"]
+        s[2] = min(s[2], e["dur"])
+        s[3] = max(s[3], e["dur"])
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(us)", "Avg(us)", "Min(us)", "Max(us)")]
+    for name, (n, tot, mn, mx) in sorted(stats.items(),
+                                         key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" %
+                     (name[:40], n, tot, tot / n, mn, mx))
+    return "\n".join(lines)
+
+
+def dump(finished=True, filename=None):
+    """Write chrome://tracing JSON (reference: Profiler::DumpProfile,
+    profiler.h:304). Open in chrome://tracing or Perfetto."""
+    path = filename or _config["filename"]
+    with _events_lock:
+        events = list(_events)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+# -- user-defined profiling objects (reference: profiler.py:300-473) --------
+
+class Domain(object):
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return "Domain(%s)" % self.name
+
+
+class Task(object):
+    """Named duration (reference: profiler.py Task)."""
+
+    def __init__(self, domain, name):
+        self.name = name
+        self.domain = domain
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter() - (_t0 or time.perf_counter())
+
+    def stop(self):
+        record_event(self.name, "task", self._t0,
+                     time.perf_counter() - (_t0 or 0))
+
+
+class Frame(Task):
+    pass
+
+
+class Counter(object):
+    """Numeric counter (reference: profiler.py Counter)."""
+
+    def __init__(self, domain, name, value=0):
+        self.name = name
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        record_counter(self.name, value)
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+
+class Marker(object):
+    """Instant event (reference: profiler.py Marker)."""
+
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        record_instant(self.name, "marker")

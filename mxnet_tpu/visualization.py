@@ -1,0 +1,100 @@
+"""Network visualization.
+
+Reference: python/mxnet/visualization.py (print_summary, plot_network
+via graphviz). plot_network degrades gracefully when graphviz is not
+installed (this image has no graphviz); print_summary is pure text.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol.symbol import Symbol, _topo
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-by-layer text summary (reference: visualization.py
+    print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    shape_dict = {}
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shape_dict[name] = s
+        internals = symbol.get_internals()
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(row, positions):
+        line = ""
+        for i, field in enumerate(row):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    nodes = _topo(symbol._entries)
+    for node in nodes:
+        if node.is_var:
+            continue
+        n_params = 0
+        pre = []
+        for (src, _i) in node.inputs:
+            if src.is_var and src.name in shape_dict:
+                cnt = 1
+                for d in shape_dict[src.name]:
+                    cnt *= d
+                if not src.name.endswith(("data", "label")):
+                    n_params += cnt
+            if not src.is_var:
+                pre.append(src.name)
+        total_params[0] += n_params
+        print_row(["%s (%s)" % (node.name, node.op), "", n_params,
+                   ",".join(pre)], positions)
+    print("=" * line_length)
+    print("Total params: %d" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering (reference: visualization.py plot_network).
+    Requires the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError(
+            "plot_network requires the graphviz python package, which is "
+            "not installed in this environment; use print_summary instead")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title)
+    nodes = _topo(symbol._entries)
+    for node in nodes:
+        if node.is_var:
+            if hide_weights and not node.name.endswith(("data", "label")):
+                continue
+            dot.node(node.name, label=node.name, shape="oval")
+        else:
+            dot.node(node.name, label="%s\n%s" % (node.op, node.name),
+                     **node_attr)
+    for node in nodes:
+        if node.is_var:
+            continue
+        for (src, _i) in node.inputs:
+            if src.is_var and hide_weights and \
+                    not src.name.endswith(("data", "label")):
+                continue
+            dot.edge(src.name, node.name)
+    return dot

@@ -1,0 +1,90 @@
+"""Profiler / engine / monitor / visualization tests
+(reference: tests/python/unittest/test_profiler.py, test_engine.py)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, engine, nd
+
+
+def test_profiler_collects_op_events(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.set_config(filename=fname, profile_imperative=True)
+    profiler.start()
+    x = mx.nd.array(np.random.rand(8, 8))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    profiler.stop()
+    path = profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "dot" in names
+    table = profiler.dumps()
+    assert "dot" in table
+
+
+def test_profiler_task_counter_marker(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.start()
+    domain = profiler.Domain("custom")
+    task = profiler.Task(domain, "mytask")
+    task.start()
+    task.stop()
+    c = profiler.Counter(domain, "cnt", 0)
+    c.increment(5)
+    m = profiler.Marker(domain, "mark")
+    m.mark()
+    profiler.stop()
+    path = profiler.dump(filename=str(tmp_path / "t.json"))
+    trace = json.load(open(path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"mytask", "cnt", "mark"} <= names
+
+
+def test_engine_bulk_api():
+    prev = engine.set_bulk_size(30)
+    assert engine.set_bulk_size(prev) == 30
+    with engine.bulk(8):
+        x = mx.nd.ones((2, 2)) + 1
+    assert float(x.sum().asscalar()) == 8
+
+
+def test_naive_engine_mode():
+    engine.set_engine_type("NaiveEngine")
+    try:
+        x = mx.nd.ones((4,)) * 3
+        assert float(x.sum().asscalar()) == 12
+    finally:
+        engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_monitor_on_block():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.monitor import Monitor
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    mon = Monitor(1, pattern=".*")
+    mon.install_block(net)
+    mon.tic()
+    net(mx.nd.array(np.random.rand(2, 3)))
+    rows = mon.toc()
+    assert len(rows) >= 1
+
+
+def test_print_summary(capsys):
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    from mxnet_tpu.symbol import _internal  # noqa: F401
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=4, name="fc")
+    from mxnet_tpu.visualization import print_summary
+    print_summary(out, shape={"data": (2, 8)})
+    captured = capsys.readouterr().out
+    assert "fc" in captured
+    assert "Total params: 36" in captured
