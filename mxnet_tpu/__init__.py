@@ -42,6 +42,11 @@ def __getattr__(name):
         globals()["kvstore"] = mod
         globals()["kv"] = mod
         return mod
+    if name == "viz":
+        mod = importlib.import_module(".visualization", __name__)
+        globals()["visualization"] = mod
+        globals()["viz"] = mod
+        return mod
     if name in _LAZY:
         try:
             mod = importlib.import_module("." + name, __name__)

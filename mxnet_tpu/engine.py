@@ -40,7 +40,8 @@ def set_engine_type(name):
 
 def profiling_imperative():
     from . import profiler
-    return profiler.is_running()
+    return (profiler.is_running()
+            and profiler._config.get("profile_imperative", True))
 
 
 def set_bulk_size(size):

@@ -29,8 +29,13 @@ class Monitor(object):
         self.sort = sort
 
     def install(self, exe):
-        """Attach to an Executor (reference: monitor.py install)."""
-        exe._monitor = self
+        """Attach to an Executor (reference: monitor.py install) — wires
+        the per-op monitor callback so intermediate outputs are spied,
+        like GraphExecutor::ExecuteMonCallback."""
+        def callback(name, arr):
+            if self.activated and self.re_prog.match(name):
+                self.queue.append((self.step, name, self.stat_func(arr)))
+        exe.set_monitor_callback(callback)
         self.exes.append(exe)
 
     def install_block(self, block):

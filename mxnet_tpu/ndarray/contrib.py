@@ -12,7 +12,7 @@ from .ndarray import invoke_op
 __all__ = ["foreach", "while_loop", "cond", "ROIAlign", "box_iou",
            "box_nms", "MultiBoxPrior", "CTCLoss", "ctc_loss",
            "AdaptiveAvgPooling2D", "BilinearResize2D", "div_sqrt_dim",
-           "arange_like", "dot_product_attention", "quantize",
+           "arange_like", "dot_product_attention", "flash_attention", "quantize",
            "quantize_v2", "dequantize", "requantize",
            "quantized_fully_connected", "quantized_conv",
            "quantized_pooling", "quantized_flatten"]
@@ -44,6 +44,14 @@ div_sqrt_dim = _wrap("_contrib_div_sqrt_dim", "div_sqrt_dim")
 arange_like = _wrap("_contrib_arange_like", "arange_like")
 dot_product_attention = _wrap("_contrib_dot_product_attention",
                               "dot_product_attention")
+def flash_attention(q, k, v, **kwargs):
+    """Pallas flash attention (ops/pallas/flash_attention.py). The
+    interpret flag is resolved here from the data's actual device —
+    inside the op jit only tracers are visible."""
+    if "interpret" not in kwargs:
+        from ..ops.pallas.flash_attention import _interpret_default
+        kwargs["interpret"] = _interpret_default(q._data)
+    return invoke_op("_contrib_flash_attention", [q, k, v], kwargs)
 quantize = _wrap("_contrib_quantize", "quantize")
 quantize_v2 = _wrap("_contrib_quantize_v2", "quantize_v2")
 dequantize = _wrap("_contrib_dequantize", "dequantize")

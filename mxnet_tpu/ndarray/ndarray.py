@@ -475,15 +475,14 @@ def invoke_op(name, inputs, attrs, out=None):
     if ctx is None:
         ctx = current_context()
 
+    import contextlib
     from .. import engine as _engine
     if _engine.profiling_imperative():
         from .. import profiler as _prof
-        with _prof.scope(name, "operator"):
-            raw_out = _reg.invoke_raw(op, arrays, attrs)
-            if _engine.is_naive():
-                for o in raw_out:
-                    o.block_until_ready()
+        prof_scope = _prof.scope(name, "operator")
     else:
+        prof_scope = contextlib.nullcontext()
+    with prof_scope:
         raw_out = _reg.invoke_raw(op, arrays, attrs)
         if _engine.is_naive():
             # NaiveEngine debug mode: serialize every op (reference:

@@ -355,3 +355,19 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_ig):
         return out.reshape(data.shape)
     n = data.shape[axis]
     return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("_contrib_flash_attention", attr_defaults={"causal": False,
+                                                     "sm_scale": None,
+                                                     "block_q": 128,
+                                                     "block_k": 128,
+                                                     "interpret": None})
+def _flash_attention_op(q, k, v, causal=False, sm_scale=None,
+                        block_q=128, block_k=128, interpret=None, **_ig):
+    """Pallas flash attention over (batch, heads, seq, head_dim)
+    (TPU-native replacement for the reference's fused attention,
+    src/operator/contrib/transformer-inl.h; kernel in ops/pallas)."""
+    from .pallas import flash_attention
+    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)

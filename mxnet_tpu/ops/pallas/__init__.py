@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+TPU-native analog of the reference's hand-written CUDA kernels
+(src/operator/contrib/transformer-inl.h, src/common/rtc.cc): where XLA's
+automatic fusion is not enough (attention over long sequences), we drop
+to Pallas for explicit VMEM tiling and online-softmax accumulation.
+
+Kernels degrade gracefully off-TPU: on CPU test meshes they run in
+pallas interpreter mode, so the same code path is exercised everywhere.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

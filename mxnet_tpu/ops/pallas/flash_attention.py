@@ -1,0 +1,256 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Capability analog of the reference's fused transformer attention ops
+(reference: src/operator/contrib/transformer-inl.h) redesigned for TPU:
+instead of materialising the (S, S) score matrix in HBM, the kernel
+streams K/V blocks through VMEM with an online-softmax accumulator, so
+memory is O(S * d) and the matmuls stay on the MXU.
+
+Forward  = Pallas kernel over grid (batch*heads, q_blocks, k_blocks);
+           scratch accumulators (m, l, acc) persist across the k grid
+           dimension (TPU grids iterate the trailing dim sequentially).
+Backward = blockwise lax.scan recomputation from the saved per-row
+           log-sum-exp (flash-attention-2 style: p = exp(qk - lse)),
+           memory O(block * S), fully fused by XLA.
+
+Layout: (batch, heads, seq, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _interpret_default(x):
+    """Interpret (emulate) the kernel unless the data actually lives on
+    TPU: compiled Mosaic kernels only lower for the TPU backend, and jit
+    follows committed input devices (a cpu(0)-context NDArray must not
+    hit the TPU lowering, and vice versa)."""
+    try:
+        return any(d.platform != "tpu" for d in x.devices())
+    except Exception:  # tracer inside an outer jit: no device info
+        return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k,
+                seq_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, bk)
+
+        # mask out-of-range keys (padding) and the causal triangle
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos < seq_len
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kpos <= qpos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                                # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)           # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                      # (bq, 1)
+        p = jnp.exp(s - m_new)                               # (bq, bk)
+
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)                     # (bk, d)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, d)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip K blocks entirely above the causal diagonal
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = (m_scr[:, :1] + jnp.log(l_safe))               # (bq, 1)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:]).astype(
+            lse_ref.dtype)
+
+
+def _pad_to(x, mult, axis):
+    rem = x.shape[axis] % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+
+    qf = _pad_to(qf, block_q, 1)
+    kf = _pad_to(kf, block_k, 1)
+    vf = _pad_to(vf, block_k, 1)
+    sp_q, sp_k = qf.shape[1], kf.shape[1]
+    grid = (b * h, sp_q // block_q, sp_k // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=s_k)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sp_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sp_q, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    o = o[:, :s_q].reshape(b, h, s_q, d)
+    lse = lse[:, :s_q, 0].reshape(b, h, s_q)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: blockwise recomputation from saved lse (XLA, scan over k blocks)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    del block_q, interpret
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    g = g.astype(jnp.float32)
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # delta_i = sum_d o_i * do_i  (rowwise), standard flash-bwd shortcut
+    delta = jnp.sum(o.astype(jnp.float32) * g, axis=-1)          # (b,h,sq)
+
+    nk = max(1, -(-s_k // block_k))
+    pad_k = nk * block_k - s_k
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    kpos = jnp.arange(nk * block_k)
+    qpos = jnp.arange(s_q)
+
+    def kblock(carry, kb):
+        dq_acc = carry
+        ks = kb * block_k
+        kblk = jax.lax.dynamic_slice_in_dim(kf, ks, block_k, axis=2)
+        vblk = jax.lax.dynamic_slice_in_dim(vf, ks, block_k, axis=2)
+        kp = jax.lax.dynamic_slice_in_dim(kpos, ks, block_k)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk)              # (b,h,sq,bk)
+        mask = (kp[None, None, None, :] < s_k)
+        if causal:
+            mask = jnp.logical_and(
+                mask, kp[None, None, None, :] <= qpos[None, None, :, None])
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                          # (b,h,sq,bk)
+        p = jnp.where(mask, p, 0.0)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g, vblk)
+        ds = p * (dp - delta[..., None])                         # (b,h,sq,bk)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)               # scaled q
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk)
+        return dq_acc, (dk, dv)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        kblock, jnp.zeros((b, h, s_q, d), jnp.float32), jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, nk * block_k, d)[:, :, :s_k]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, nk * block_k, d)[:, :, :s_k]
+    dq = dq * sm_scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Memory-efficient attention: ``softmax(Q K^T * scale [+ mask]) V``.
+
+    Parameters
+    ----------
+    q, k, v : arrays of shape (batch, heads, seq, head_dim).
+    causal : apply a lower-triangular mask.
+    sm_scale : score scale; default ``1/sqrt(head_dim)``.
+    block_q, block_k : VMEM tile sizes (multiples of 128 on TPU).
+    interpret : force pallas interpreter mode (defaults to True off-TPU).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default(q)
+    block_q = min(block_q, max(8, q.shape[2]))
+    block_k = min(block_k, max(8, k.shape[2]))
+    return _flash(q, k, v, bool(causal), float(sm_scale),
+                  int(block_q), int(block_k), bool(interpret))

@@ -37,7 +37,10 @@ _events_lock = threading.Lock()
 _running = False
 _paused = False
 _xla_active = False
-_t0 = None
+# Single monotonic epoch fixed at import: every timestamp (ops, Tasks,
+# markers, counters) is relative to it, so objects used before start()
+# still produce consistent trace times.
+_t0 = time.perf_counter()
 
 
 def set_config(**kwargs):
@@ -62,10 +65,8 @@ profiler_set_config = set_config
 
 def start():
     """Begin collecting (reference: profiler.py set_state('run'))."""
-    global _running, _t0, _xla_active
+    global _running, _xla_active
     _running = True
-    if _t0 is None:
-        _t0 = time.perf_counter()
     if _config["profile_device"]:
         import jax
         logdir = _config["xla_logdir"] or os.path.splitext(
@@ -78,8 +79,9 @@ def start():
 
 
 def stop():
-    global _running, _xla_active
+    global _running, _paused, _xla_active
     _running = False
+    _paused = False
     if _xla_active:
         import jax
         jax.profiler.stop_trace()
@@ -127,7 +129,7 @@ def record_event(name, category, t_start, t_end, args=None):
 def record_instant(name, category, args=None):
     with _events_lock:
         _events.append({"name": name, "cat": category, "ph": "i",
-                        "ts": (time.perf_counter() - (_t0 or 0)) * 1e6,
+                        "ts": (time.perf_counter() - _t0) * 1e6,
                         "pid": os.getpid(),
                         "tid": threading.get_ident() % 100000,
                         "s": "p", "args": args or {}})
@@ -136,38 +138,33 @@ def record_instant(name, category, args=None):
 def record_counter(name, value):
     with _events_lock:
         _events.append({"name": name, "ph": "C",
-                        "ts": (time.perf_counter() - (_t0 or 0)) * 1e6,
+                        "ts": (time.perf_counter() - _t0) * 1e6,
                         "pid": os.getpid(),
                         "args": {"value": value}})
 
 
 class _OpScope(object):
-    """Context manager timing one op dispatch; used by invoke_op."""
+    """Context manager timing one op dispatch; used by invoke_op.
+    Kept allocation-light (__slots__, no per-call class creation) since
+    it sits on the hot dispatch path it is measuring."""
 
-    __slots__ = ("name", "t0")
+    __slots__ = ("name", "category", "t0")
 
-    def __init__(self, name):
+    def __init__(self, name, category="operator"):
         self.name = name
+        self.category = category
 
     def __enter__(self):
         self.t0 = time.perf_counter() - _t0
         return self
 
     def __exit__(self, *exc):
-        record_event(self.name, "operator", self.t0,
+        record_event(self.name, self.category, self.t0,
                      time.perf_counter() - _t0)
 
 
 def scope(name, category="operator"):
-    class _S:
-        def __enter__(self):
-            self.t0 = time.perf_counter() - (_t0 or time.perf_counter())
-            return self
-
-        def __exit__(self, *exc):
-            record_event(name, category, self.t0,
-                         time.perf_counter() - (_t0 or 0))
-    return _S()
+    return _OpScope(name, category)
 
 
 def dumps(reset=False):
@@ -225,11 +222,14 @@ class Task(object):
         self._t0 = None
 
     def start(self):
-        self._t0 = time.perf_counter() - (_t0 or time.perf_counter())
+        self._t0 = time.perf_counter() - _t0
 
     def stop(self):
+        if self._t0 is None:
+            raise MXNetError("Task.stop() before start()")
         record_event(self.name, "task", self._t0,
-                     time.perf_counter() - (_t0 or 0))
+                     time.perf_counter() - _t0)
+        self._t0 = None
 
 
 class Frame(Task):

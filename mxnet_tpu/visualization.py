@@ -19,11 +19,15 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
         raise TypeError("symbol must be a Symbol")
     positions = positions or [0.44, 0.64, 0.74, 1.0]
     shape_dict = {}
+    out_shape_dict = {}
     if shape is not None:
-        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
         for name, s in zip(symbol.list_arguments(), arg_shapes):
             shape_dict[name] = s
         internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape(**shape)
+        for name, s in zip(internals.list_outputs(), int_shapes):
+            out_shape_dict[name] = s
     positions = [int(line_length * p) for p in positions]
     fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
@@ -56,7 +60,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
             if not src.is_var:
                 pre.append(src.name)
         total_params[0] += n_params
-        print_row(["%s (%s)" % (node.name, node.op), "", n_params,
+        oshape = (out_shape_dict.get(node.name + "_output")
+                  or out_shape_dict.get(node.name + "_output0") or "")
+        print_row(["%s (%s)" % (node.name, node.op), str(oshape), n_params,
                    ",".join(pre)], positions)
     print("=" * line_length)
     print("Total params: %d" % total_params[0])
