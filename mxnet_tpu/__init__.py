@@ -7,6 +7,16 @@ Usage mirrors the reference's ``import mxnet as mx``::
     import mxnet_tpu as mx
     x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
 """
+import os as _os
+
+if _os.environ.get("MXNET_TPU_PLATFORM"):
+    # Force the JAX platform before any backend initializes (part of the
+    # MXNET_* env-var config tier, reference: docs/faq/env_var.md). The
+    # env var JAX_PLATFORMS alone is not reliable when a site hook has
+    # already imported jax; the config update is.
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["MXNET_TPU_PLATFORM"])
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

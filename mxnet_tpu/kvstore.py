@@ -42,12 +42,51 @@ class KVStore(object):
     python/mxnet/kvstore.py:105)."""
 
     def __init__(self, kv_type="local"):
+        import os
         self._type = kv_type
         self._store = {}
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._compressor = None
         self._barrier_count = 0
+        self._sock = None
+        self._sock_lock = None
+        if kv_type.startswith("dist") and os.environ.get("MXNET_TPU_PS_URI"):
+            self._connect_ps()
+
+    # -- parameter-server transport (DCN tier) -----------------------------
+    def _connect_ps(self):
+        """Connect to the host-side PS (kvstore_server.py) — the analog of
+        ps-lite ZPush/ZPull over DCN (src/kvstore/kvstore_dist.h:50).
+        Used for dist_async / cross-pod coordination; the synchronous
+        intra-pod path stays on XLA allreduce."""
+        import os
+        import socket
+        import threading
+        host = os.environ["MXNET_TPU_PS_URI"]
+        port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.connect((host, port))
+        self._sock_lock = threading.Lock()
+        self._env_rank = int(os.environ.get("MXNET_TPU_RANK", "0"))
+        self._env_nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
+        token = os.environ.get("MXNET_TPU_PS_TOKEN", "")
+        if token:
+            from .kvstore_server import send_msg, recv_msg
+            send_msg(self._sock, ("AUTH", None, token))
+            status, payload = recv_msg(self._sock)
+            if status != "OK":
+                raise MXNetError("kvstore server auth failed: %s" % payload)
+
+    def _ps_call(self, op, key=None, value=None):
+        from .kvstore_server import send_msg, recv_msg
+        with self._sock_lock:
+            send_msg(self._sock, (op, key, value))
+            status, payload = recv_msg(self._sock)
+        if status != "OK":
+            raise MXNetError("kvstore server error: %s" % payload)
+        return payload
 
     # -- identity ----------------------------------------------------------
     @property
@@ -56,13 +95,17 @@ class KVStore(object):
 
     @property
     def rank(self):
-        """This worker's rank (reference: kvstore.py rank). Multi-host JAX
-        maps rank to ``jax.process_index()``."""
+        """This worker's rank (reference: kvstore.py rank). PS mode reads
+        MXNET_TPU_RANK; multi-host JAX maps to ``jax.process_index()``."""
+        if self._sock is not None:
+            return self._env_rank
         import jax
         return jax.process_index()
 
     @property
     def num_workers(self):
+        if self._sock is not None:
+            return self._env_nw
         import jax
         return jax.process_count()
 
@@ -76,6 +119,8 @@ class KVStore(object):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
             self._store[k] = vlist[0].copy()
+            if self._sock is not None:
+                self._ps_call("INIT", k, vlist[0].asnumpy())
 
     def push(self, key, value, priority=0):
         """Aggregate values; if an optimizer is installed, run the update
@@ -85,7 +130,16 @@ class KVStore(object):
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("please init key %r before push" % (k,))
-            agg = self._aggregate(vlist)
+            agg = self._aggregate(k, vlist)
+            if self._sock is not None:
+                # PS hop: local reduce -> (compress) -> ZPush analog
+                # (kvstore_dist.h:349-371); server aggregates / updates.
+                g = agg.asnumpy()
+                if self._compressor is not None:
+                    self._ps_call("PUSH", k, self._compressor.compress(k, g))
+                else:
+                    self._ps_call("PUSH", k, g)
+                continue
             if self._updater is not None:
                 # updater mutates the stored weight in place
                 self._updater(self._key_index(k), agg, self._store[k])
@@ -100,6 +154,10 @@ class KVStore(object):
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("please init key %r before pull" % (k,))
+            if self._sock is not None:
+                import jax.numpy as jnp
+                fresh = jnp.asarray(self._ps_call("PULL", k))
+                self._store[k]._set_data(fresh)
             src = self._store[k]
             for o in olist:
                 o._set_data(src._data)
@@ -119,23 +177,43 @@ class KVStore(object):
         keys, outs = _ctype_key_value(key, out)
         rids, _ = _ctype_key_value(row_ids, row_ids)
         for k, olist in zip(keys, outs):
+            rows = row_ids if isinstance(row_ids, NDArray) else row_ids[0]
+            if self._sock is not None:
+                # server-side row gather: only the requested embedding rows
+                # cross the wire (reference: kvstore_dist.h
+                # PullRowSparse over ps-lite)
+                import jax.numpy as jnp
+                sub = self._ps_call("PULL_ROWS", k,
+                                    rows.asnumpy().astype("int64"))
+                for o in olist:
+                    o._set_data(jnp.asarray(sub))
+                continue
             src = self._store[k]
             for o in olist:
-                rows = row_ids if isinstance(row_ids, NDArray) else row_ids[0]
                 o._set_data(src._data[rows._data.astype("int32")])
 
     # -- aggregation -------------------------------------------------------
-    def _aggregate(self, vlist):
+    def _aggregate(self, key, vlist):
         """Sum per-device contributions. Single values pass through; the
         multi-host ``dist_tpu_sync`` path additionally allreduces across
-        processes (ICI/DCN via XLA psum)."""
+        processes (ICI/DCN via XLA psum). With gradient compression on,
+        each contribution goes through the codec (quantize + error
+        feedback) before the reduce — the reference applies compression
+        on exactly this hop (gradient_compression.h wiring in
+        kvstore_dist.h:586)."""
+        if self._compressor is not None and self._sock is None:
+            import jax.numpy as jnp
+            vlist = [NDArray(jnp.asarray(self._compressor.roundtrip(
+                (key, i), v.asnumpy())), ctx=v.context)
+                for i, v in enumerate(vlist)]
         agg = vlist[0]
         if len(vlist) > 1:
             total = vlist[0]._data
             for v in vlist[1:]:
                 total = total + v._data
             agg = NDArray(total, ctx=vlist[0].context)
-        if self._type.startswith("dist") and self.num_workers > 1:
+        if self._type.startswith("dist") and self._sock is None \
+                and self.num_workers > 1:
             agg = self._cross_process_allreduce(agg)
         return agg
 
@@ -163,22 +241,38 @@ class KVStore(object):
         sync mode)."""
         from .optimizer import get_updater
         self._optimizer = optimizer
+        if self._sock is not None:
+            # ship the optimizer to the server, which then runs updates
+            # store-side (reference: kvstore.py set_optimizer pickling to
+            # servers via _send_command_to_servers)
+            if self.rank == 0:
+                self._ps_call("SET_OPTIMIZER", None, pickle.dumps(optimizer))
+            return
         self._updater = get_updater(optimizer)
 
     def _set_updater(self, updater):
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        """Record 2-bit/int8 compression config (reference:
-        gradient_compression.h:38). On TPU the equivalent lever is reduced-
-        precision collectives; the config is honored by the parallel
-        trainer's allreduce dtype."""
+        """Enable 2-bit/int8 gradient compression with error feedback
+        (reference: gradient_compression.h:38; see
+        mxnet_tpu/gradient_compression.py). Applies on the communication
+        hop: worker→server in PS mode, per-contribution quantization in
+        local/allreduce mode."""
+        from .gradient_compression import create_compressor
         self._compression_params = dict(compression_params)
+        self._compressor = create_compressor(self._compression_params)
+        if self._sock is not None:
+            self._ps_call("SET_COMPRESSION", None, self._compression_params)
 
     # -- sync --------------------------------------------------------------
     def barrier(self):
         """Global barrier (reference: kvstore.py _barrier → ps
         Postoffice::Barrier)."""
+        if self._sock is not None:
+            self._ps_call("BARRIER")
+            self._barrier_count += 1
+            return
         import jax
         if self.num_workers > 1:
             from jax.experimental import multihost_utils

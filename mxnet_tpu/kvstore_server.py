@@ -1,0 +1,253 @@
+"""Parameter-server process for the distributed KVStore (DCN path).
+
+Reference: src/kvstore/kvstore_dist_server.h:155 (request handlers at
+:331-337, sync aggregation + ApplyUpdates at :346) and
+python/mxnet/kvstore_server.py:65-73 (worker-side bootstrap).
+
+TPU-native split of responsibilities: *synchronous* data-parallel
+gradient exchange rides XLA allreduce over ICI (see kvstore.py /
+parallel.trainer) — no server round-trip. What still needs a host-side
+parameter server is the DCN tier: asynchronous updates, sparse
+embedding pulls, and cross-pod coordination. This server provides that
+tier as a threaded TCP service speaking a length-prefixed pickle
+protocol:
+
+  INIT / PUSH / PULL / BARRIER / SET_OPTIMIZER / SET_COMPRESSION / STOP
+
+Sync mode (``dist_tpu_sync``): pushes are aggregated per key; the
+round completes when all workers contributed, then the server applies
+the updater (or stores the summed gradient when no optimizer is
+installed — the reference's DataHandleDefault behavior used by its
+dist tests). Async mode (``dist_async``): every push updates
+immediately — stragglers never block (kvstore.cc:55-57 semantics).
+
+Roles resolve from env like the reference's DMLC_ROLE:
+``MXNET_TPU_ROLE`` in {server, worker, scheduler},
+``MXNET_TPU_PS_URI``/``MXNET_TPU_PS_PORT``, ``MXNET_TPU_NUM_WORKERS``,
+``MXNET_TPU_RANK`` (set by tools/launch.py).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["KVStoreServer", "send_msg", "recv_msg", "serve_forever"]
+
+_LEN = struct.Struct("!Q")
+
+
+def send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class KVStoreServer(object):
+    """Threaded PS: one handler thread per worker connection."""
+
+    def __init__(self, port=0, num_workers=1, sync_mode=True,
+                 bind_addr=None, token=None):
+        self._store = {}
+        self._pending = {}          # key -> {"sum": arr, "count": int}
+        self._versions = {}
+        self._updater = None
+        self._compressor = None
+        self._num_workers = num_workers
+        self._sync = sync_mode
+        # The wire format is pickle: auth is a mandatory shared token for
+        # any non-loopback bind (the transport itself must still be a
+        # trusted network, like the reference's ps-lite/zmq).
+        self._token = token if token is not None else \
+            os.environ.get("MXNET_TPU_PS_TOKEN", "")
+        bind_addr = bind_addr if bind_addr is not None else \
+            os.environ.get("MXNET_TPU_PS_BIND", "127.0.0.1")
+        if bind_addr != "127.0.0.1" and not self._token:
+            raise ValueError("non-loopback PS bind requires "
+                             "MXNET_TPU_PS_TOKEN to be set")
+        self._lock = threading.Lock()
+        self._round_done = threading.Condition(self._lock)
+        self._barrier_waiting = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind_addr, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+
+    # -- request handlers --------------------------------------------------
+    def _decompress(self, value):
+        if self._compressor is not None and isinstance(value, tuple):
+            payload, shape = value
+            return self._compressor.decompress(payload, shape)
+        return value
+
+    def _handle(self, op, key=None, value=None):
+        if op == "INIT":
+            with self._lock:
+                # rank-0 init wins; later INITs for the key are ignored
+                # (reference: kvstore_dist.h rank-0 init + broadcast).
+                # dtype is preserved: fp16/bf16 weights stay what the
+                # worker declared.
+                if key not in self._store:
+                    self._store[key] = np.array(value)
+                    self._versions[key] = 0
+            return ("OK", None)
+        if op == "PUSH":
+            grad = self._decompress(value)
+            with self._lock:
+                if self._sync:
+                    slot = self._pending.setdefault(
+                        key, {"sum": np.zeros_like(self._store[key]),
+                              "count": 0})
+                    slot["sum"] = slot["sum"] + grad
+                    slot["count"] += 1
+                    if slot["count"] == self._num_workers:
+                        self._apply(key, slot["sum"])
+                        del self._pending[key]
+                        self._versions[key] += 1
+                        self._round_done.notify_all()
+                    else:
+                        v = self._versions[key]
+                        while self._versions[key] == v and \
+                                not self._stop.is_set():
+                            self._round_done.wait(timeout=30.0)
+                else:
+                    self._apply(key, grad)
+                    self._versions[key] += 1
+            return ("OK", None)
+        if op == "PULL":
+            with self._lock:
+                return ("OK", self._store[key].copy())
+        if op == "PULL_ROWS":
+            with self._lock:
+                rows = np.asarray(value, np.int64)
+                return ("OK", self._store[key][rows].copy())
+        if op == "BARRIER":
+            with self._lock:
+                gen = self._barrier_gen
+                self._barrier_waiting += 1
+                if self._barrier_waiting == self._num_workers:
+                    self._barrier_waiting = 0
+                    self._barrier_gen += 1
+                    self._round_done.notify_all()
+                else:
+                    while self._barrier_gen == gen and \
+                            not self._stop.is_set():
+                        self._round_done.wait(timeout=30.0)
+            return ("OK", None)
+        if op == "SET_OPTIMIZER":
+            from .optimizer import get_updater
+            opt = pickle.loads(value)
+            with self._lock:
+                self._updater = get_updater(opt)
+            return ("OK", None)
+        if op == "SET_COMPRESSION":
+            from .gradient_compression import create_compressor
+            with self._lock:
+                self._compressor = create_compressor(value)
+            return ("OK", None)
+        if op == "STOP":
+            self._stop.set()
+            with self._lock:
+                self._round_done.notify_all()
+            return ("OK", None)
+        return ("ERR", "unknown op %r" % op)
+
+    def _apply(self, key, agg):
+        """ApplyUpdates (kvstore_dist_server.h:346): updater if present,
+        else store the aggregate (reference test semantics)."""
+        if self._updater is not None:
+            from .ndarray.ndarray import NDArray, array
+            w = array(self._store[key])
+            self._updater(key, array(agg), w)
+            self._store[key] = w.asnumpy()
+        else:
+            self._store[key] = np.asarray(agg, self._store[key].dtype)
+
+    # -- socket loop -------------------------------------------------------
+    def _client_loop(self, conn):
+        try:
+            if self._token:
+                # first message must be the shared token (AUTH, None, tok)
+                msg = recv_msg(conn)
+                if msg[0] != "AUTH" or msg[2] != self._token:
+                    send_msg(conn, ("ERR", "auth failed"))
+                    return
+                send_msg(conn, ("OK", None))
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                try:
+                    resp = self._handle(*msg)
+                except Exception:
+                    # surface handler failures to the worker instead of
+                    # dropping the connection (the reference propagates
+                    # server errors back through ps-lite responses)
+                    import traceback
+                    resp = ("ERR", traceback.format_exc())
+                send_msg(conn, resp)
+                if msg[0] == "STOP":
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def serve_forever(self):
+        self._sock.settimeout(1.0)
+        threads = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        self._sock.close()
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+
+
+def serve_forever():
+    """Entry point for a server-role process (reference:
+    kvstore_server.py _init_kvstore_server_module)."""
+    port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
+    nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
+    sync = os.environ.get("MXNET_TPU_PS_MODE", "sync") == "sync"
+    server = KVStoreServer(port=port, num_workers=nw, sync_mode=sync)
+    print("kvstore server listening on %d (workers=%d sync=%s)"
+          % (server.port, nw, sync), flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    serve_forever()

@@ -14,9 +14,12 @@ functional equivalent of the engine's version-counter protocol
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import numpy as _np
 
 from ..base import MXNetError, np_dtype, numeric_types
+
+_NULL_SCOPE = _contextlib.nullcontext()
 from ..context import Context, current_context
 from .. import random as _random
 from ..ops import registry as _reg
@@ -475,13 +478,12 @@ def invoke_op(name, inputs, attrs, out=None):
     if ctx is None:
         ctx = current_context()
 
-    import contextlib
     from .. import engine as _engine
     if _engine.profiling_imperative():
         from .. import profiler as _prof
         prof_scope = _prof.scope(name, "operator")
     else:
-        prof_scope = contextlib.nullcontext()
+        prof_scope = _NULL_SCOPE   # singleton: keep the hot path light
     with prof_scope:
         raw_out = _reg.invoke_raw(op, arrays, attrs)
         if _engine.is_naive():

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+The reference has no MoE / expert parallelism (SURVEY.md §2.3 marks the
+row absent); this is the TPU-first addition. Design follows the
+GShard/Switch recipe adapted to XLA's strengths: routing is expressed
+entirely as dense one-hot einsums (no gather/scatter, so dispatch and
+combine both run on the MXU), experts are stacked on a leading axis
+sharded over ``ep``, and the token→expert exchange is a psum over the
+expert axis — XLA lowers the pattern to all-to-all/all-reduce on ICI.
+
+Pieces:
+* :func:`top_k_gating` — top-1/top-2 routing with per-expert capacity,
+  position-in-expert via cumsum, and the GShard load-balancing aux loss;
+* :func:`moe_apply` — dispatch → per-device expert FFN (vmapped over
+  local experts) → combine, inside ``shard_map``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+__all__ = ["top_k_gating", "moe_apply", "stack_expert_params"]
+
+
+def stack_expert_params(params_list):
+    """Stack per-expert pytrees on a leading ``num_experts`` axis
+    (shard it P('ep'))."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def _one_hot(idx, n, dtype=jnp.float32):
+    return (idx[..., None] == jnp.arange(n)).astype(dtype)
+
+
+def top_k_gating(gate_logits, num_experts, capacity, k=2):
+    """Compute dense dispatch/combine tensors for top-k routing.
+
+    gate_logits : (tokens, num_experts).
+    Returns (dispatch (n,E,C) in {0,1}, combine (n,E,C) float, aux_loss).
+    """
+    n = gate_logits.shape[0]
+    gates = jax.nn.softmax(gate_logits, axis=-1)              # (n, E)
+
+    idx1 = jnp.argmax(gates, axis=-1)                          # (n,)
+    mask1 = _one_hot(idx1, num_experts)                        # (n, E)
+    g1 = jnp.sum(gates * mask1, axis=-1)                       # (n,)
+
+    # GShard load-balancing loss: E * sum_e mean(gates_e) * mean(tokens_e)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux_loss = num_experts * jnp.sum(density * density_proxy)
+
+    # position of each token within its expert-1 queue
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1           # (n, E)
+    pos1_tok = jnp.sum(pos1, axis=-1)                          # (n,)
+    kept1 = pos1_tok < capacity
+    disp1 = (mask1 * kept1[:, None])[:, :, None] * \
+        _one_hot(pos1_tok, capacity)[:, None, :]               # (n, E, C)
+
+    if k >= 2:
+        gates2 = gates * (1.0 - mask1)
+        idx2 = jnp.argmax(gates2, axis=-1)
+        mask2 = _one_hot(idx2, num_experts)
+        g2 = jnp.sum(gates * mask2, axis=-1)
+        # expert-2 queue continues after all expert-1 assignments
+        pos2 = (jnp.cumsum(mask2, axis=0) - mask2
+                + jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+        pos2_tok = jnp.sum(pos2, axis=-1)
+        kept2 = pos2_tok < capacity
+        disp2 = (mask2 * kept2[:, None])[:, :, None] * \
+            _one_hot(pos2_tok, capacity)[:, None, :]
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        w1, w2 = g1 / denom, g2 / denom
+        dispatch = disp1 + disp2
+        combine = w1[:, None, None] * disp1 + w2[:, None, None] * disp2
+    else:
+        dispatch = disp1
+        combine = g1[:, None, None] * disp1
+    return dispatch, combine, aux_loss
+
+
+def _moe_local(expert_params, dispatch, combine, x, *, expert_fn, axis):
+    """Per-device body: compute the local expert slice over ALL tokens.
+    expert_params: (E_local, ...); dispatch/combine: (n, E_local, C);
+    x: (n, d) replicated."""
+    exp_in = jnp.einsum("nec,nd->ecd", dispatch, x)            # (El, C, d)
+    exp_out = jax.vmap(expert_fn)(expert_params, exp_in)       # (El, C, d')
+    partial = jnp.einsum("nec,ecd->nd", combine, exp_out)      # (n, d')
+    return jax.lax.psum(partial, axis)
+
+
+def moe_apply(x, gate_w, expert_params, expert_fn, mesh=None, axis="ep",
+              k=2, capacity_factor=2.0):
+    """Apply a sharded MoE layer to tokens ``x`` (tokens, d_model).
+
+    gate_w : (d_model, num_experts) router weights.
+    expert_params : pytree stacked on a leading num_experts axis
+        (see :func:`stack_expert_params`); sharded P(axis).
+    expert_fn : ``expert_fn(one_expert_params, (C, d)) -> (C, d_out)``.
+
+    Returns (out (tokens, d_out), aux_loss).
+    """
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("moe_apply needs a Mesh (parallel.make_mesh)")
+    n, _ = x.shape
+    num_experts = gate_w.shape[-1]
+    if num_experts % mesh.shape[axis]:
+        raise ValueError("num_experts %d not divisible by mesh axis %r=%d"
+                         % (num_experts, axis, mesh.shape[axis]))
+    capacity = max(1, int(capacity_factor * n * min(k, 2) / num_experts))
+
+    logits = x @ gate_w
+    dispatch, combine, aux = top_k_gating(logits, num_experts, capacity, k=k)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), expert_params)
+    fn = shard_map(
+        functools.partial(_moe_local, expert_fn=expert_fn, axis=axis),
+        mesh=mesh,
+        in_specs=(pspec, P(None, axis, None), P(None, axis, None), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    out = fn(expert_params, dispatch.astype(x.dtype),
+             combine.astype(x.dtype), x)
+    return out, aux

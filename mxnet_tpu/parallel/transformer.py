@@ -1,0 +1,388 @@
+"""Transformer LM with fully-composed 5D parallelism (dp/sp/tp/pp/ep).
+
+The reference's long-sequence story is bucketing + fused RNNs and its
+only parallelism is data-parallel KVStore + manual group2ctx placement
+(SURVEY.md §2.3/§5). This module is the TPU-first replacement: ONE
+``shard_map`` over a 5-axis ``Mesh`` runs a GPT-style decoder with
+
+* **dp** — batch sharding; gradient psum over ICI;
+* **sp** — sequence sharding with ring attention (``lax.ppermute``
+  K/V rotation, online softmax — see parallel/ring_attention.py);
+* **tp** — Megatron-style tensor parallelism: Q/K/V/FFN-up sharded on
+  the output dim (heads split), out-proj/FFN-down sharded on the input
+  dim, one psum per residual branch;
+* **pp** — GPipe microbatch pipeline between stage-sharded layer
+  stacks (``lax.scan`` schedule + ppermute handoff);
+* **ep** — optional MoE FFN with experts sharded over ``ep`` and
+  MXU-friendly one-hot dispatch/combine (parallel/moe.py math).
+
+Everything is manual-collective SPMD: the whole train step (forward,
+backward, SGD update, all reductions) compiles to a single XLA program
+per device. Size-1 axes degrade to identity collectives, so the same
+code runs any slice of the 5D configuration.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from .ring_attention import _ring_attention_local
+from .moe import top_k_gating
+
+__all__ = ["TransformerConfig", "init_transformer_params",
+           "make_transformer_train_step", "transformer_forward_single"]
+
+AXES = ("dp", "sp", "tp", "pp", "ep")
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 256
+    max_len: int = 512
+    num_experts: int = 0          # 0 = dense FFN; >0 = MoE FFN
+    moe_top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: object = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+def _param_specs(cfg, pp):
+    """PartitionSpecs per parameter (layer stacks lead with a pp axis)."""
+    lyr = {
+        "ln1_g": P("pp", None, None), "ln1_b": P("pp", None, None),
+        "ln2_g": P("pp", None, None), "ln2_b": P("pp", None, None),
+        "wq": P("pp", None, None, "tp"), "wk": P("pp", None, None, "tp"),
+        "wv": P("pp", None, None, "tp"), "wo": P("pp", None, "tp", None),
+    }
+    if cfg.num_experts:
+        lyr.update({
+            "gate": P("pp", None, None, None),
+            "we1": P("pp", None, "ep", None, None),
+            "we2": P("pp", None, "ep", None, None),
+        })
+    else:
+        lyr.update({"w1": P("pp", None, None, "tp"),
+                    "w2": P("pp", None, "tp", None)})
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "lnf_g": P(None,), "lnf_b": P(None,),
+        "layers": lyr,
+    }
+
+
+def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
+    """Initialize params laid out for the mesh; returns (params, specs).
+
+    Layer stacks have shape (pp, layers_per_stage, ...) so the leading
+    axis shards over pipeline stages.
+    """
+    pp = mesh.shape.get("pp", 1)
+    assert cfg.n_layers % pp == 0, "n_layers must divide pp"
+    lps = cfg.n_layers // pp
+    rng = np.random.RandomState(seed)
+    d, f, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    s = 0.02
+
+    def rand(*shape):
+        return jnp.asarray(rng.randn(*shape) * s, cfg.dtype)
+
+    layers = {
+        "ln1_g": jnp.ones((pp, lps, d), cfg.dtype),
+        "ln1_b": jnp.zeros((pp, lps, d), cfg.dtype),
+        "ln2_g": jnp.ones((pp, lps, d), cfg.dtype),
+        "ln2_b": jnp.zeros((pp, lps, d), cfg.dtype),
+        "wq": rand(pp, lps, d, d), "wk": rand(pp, lps, d, d),
+        "wv": rand(pp, lps, d, d), "wo": rand(pp, lps, d, d),
+    }
+    if cfg.num_experts:
+        layers["gate"] = rand(pp, lps, d, cfg.num_experts)
+        layers["we1"] = rand(pp, lps, cfg.num_experts, d, f)
+        layers["we2"] = rand(pp, lps, cfg.num_experts, f, d)
+    else:
+        layers["w1"] = rand(pp, lps, d, f)
+        layers["w2"] = rand(pp, lps, f, d)
+    params = {
+        "embed": rand(V, d),
+        "pos": rand(cfg.max_len, d),
+        "lnf_g": jnp.ones((d,), cfg.dtype),
+        "lnf_b": jnp.zeros((d,), cfg.dtype),
+        "layers": layers,
+    }
+    specs = _param_specs(cfg, pp)
+    shard = {k: (jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp),
+                                        specs[k])
+                 if isinstance(specs[k], dict) else
+                 NamedSharding(mesh, specs[k])) for k in specs}
+    params = jax.tree_util.tree_map(
+        lambda x, sh: jax.device_put(x, sh), params, shard)
+    return params, specs
+
+
+# ---------------------------------------------------------------------------
+# local (per-device) model
+# ---------------------------------------------------------------------------
+
+def _pvary(x, axes):
+    """pcast to varying only over axes x is not already varying on
+    (pcast rejects varying->varying)."""
+    cur = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in cur)
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _attention_local(lp, x, cfg, heads_local):
+    """x: (B_l, S_l, d) -> (B_l, S_l, d) partial over tp (pre-psum)."""
+    b, s, d = x.shape
+    hd = d // cfg.n_heads
+    q = x @ lp["wq"]                                      # (b, s, d_tp)
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+
+    def split(t):
+        return t.reshape(b, s, heads_local, hd).transpose(0, 2, 1, 3)
+
+    o = _ring_attention_local(split(q), split(k), split(v), "sp",
+                              causal=True, sm_scale=1.0 / np.sqrt(hd))
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, heads_local * hd)
+    return o @ lp["wo"]                                   # partial (b, s, d)
+
+
+def _dense_ffn_local(lp, x):
+    u = jax.nn.gelu(x @ lp["w1"])                         # (b, s, f_tp)
+    return u @ lp["w2"]                                   # partial (b, s, d)
+
+
+def _moe_ffn_local(lp, x, cfg, ep_size):
+    """Local-token MoE: route this shard's tokens over the global expert
+    set. Expert weights arrive ALREADY ep-sharded by shard_map in_specs
+    ((E/ep, d, f) locally); dispatch/combine are computed over the full
+    expert set and sliced to the local experts, outputs psum over ep."""
+    b, s, d = x.shape
+    tok = x.reshape(b * s, d)
+    logits = tok @ lp["gate"]
+    cap = max(1, int(cfg.capacity_factor * tok.shape[0]
+                     * min(cfg.moe_top_k, 2) / cfg.num_experts))
+    disp, comb, aux = top_k_gating(logits, cfg.num_experts, cap,
+                                   k=cfg.moe_top_k)
+    e_loc = cfg.num_experts // ep_size
+    ei = jax.lax.axis_index("ep")
+    d_loc = jax.lax.dynamic_slice_in_dim(disp, ei * e_loc, e_loc, axis=1)
+    c_loc = jax.lax.dynamic_slice_in_dim(comb, ei * e_loc, e_loc, axis=1)
+    exp_in = jnp.einsum("nec,nd->ecd", d_loc.astype(x.dtype), tok)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", exp_in, lp["we1"]))
+    exp_out = jnp.einsum("ecf,efd->ecd", h, lp["we2"])
+    out = jnp.einsum("nec,ecd->nd", c_loc.astype(x.dtype), exp_out)
+    out = jax.lax.psum(out, "ep")
+    return out.reshape(b, s, d), aux
+
+
+def _block_local(lp, x, cfg, heads_local, ep_size):
+    """One transformer block on local shards. Returns (x, aux_loss)."""
+    a = _attention_local(lp, _ln(x, lp["ln1_g"], lp["ln1_b"]),
+                         cfg, heads_local)
+    x = x + jax.lax.psum(a, "tp")
+    h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+    if cfg.num_experts:
+        f, aux = _moe_ffn_local(lp, h, cfg, ep_size)
+        # MoE experts are ep-sharded (not tp); both branches leave x
+        # replicated over tp.
+        return x + f, aux
+    f = _dense_ffn_local(lp, h)
+    return x + jax.lax.psum(f, "tp"), jnp.zeros((), x.dtype)
+
+
+def _stage_local(stage_params, x, cfg, heads_local, ep_size):
+    """Apply this pipeline stage's layers_per_stage blocks (scan over the
+    layer axis). stage_params leaves: (lps, ...).
+
+    The carry is pcast to varying over pp/ep up front: stage params are
+    pp-sharded (and experts ep-sharded), so the scan output is varying
+    over those axes — VMA requires the carry types to match."""
+    x = _pvary(x, ("pp",))
+    aux0 = _pvary(jnp.zeros((), x.dtype), ("dp", "sp", "pp"))
+
+    def body(carry, lp):
+        x, aux = carry
+        x, a = _block_local(lp, x, cfg, heads_local, ep_size)
+        return (x, aux + a), None
+
+    (x, aux), _ = jax.lax.scan(body, (x, aux0), stage_params)
+    return x, aux
+
+
+def _pipeline_stages_local(layers, x, cfg, heads_local, pp_size, ep_size,
+                           num_microbatches):
+    """GPipe schedule across the pp axis (see parallel/pipeline.py for
+    the standalone version). x: (B_l, S_l, d). Activation shapes are
+    constant across stages so the handoff is a single ppermute."""
+    if pp_size == 1:
+        x, aux = _stage_local(
+            jax.tree_util.tree_map(lambda p: p[0], layers),
+            x, cfg, heads_local, ep_size)
+        # size-1 psum: numerically identity, collapses the pp-varying
+        # type back to invariant so the loss can be replicated.
+        return jax.lax.psum(x, "pp"), jax.lax.psum(aux, "pp")
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0, "local batch %d vs microbatches %d" % (B, M)
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+    stage = jax.tree_util.tree_map(lambda p: p[0], layers)
+    idx = jax.lax.axis_index("pp")
+    S = pp_size
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    is_first, is_last = idx == 0, idx == S - 1
+
+    def tick(carry, t):
+        state, out_buf, aux = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        inp = jnp.where(is_first, feed, state)
+        out, a = _stage_local(stage, inp, cfg, heads_local, ep_size)
+        mb_done = t - (S - 1)
+        valid = jnp.logical_and(is_last, mb_done >= 0)
+        onehot = (jnp.arange(M) == mb_done).astype(out.dtype)
+        upd = onehot.reshape((M, 1, 1, 1)) * out[None]
+        out_buf = out_buf + jnp.where(valid, upd, jnp.zeros_like(upd))
+        # this stage holds real data only for ticks in [idx, idx + M):
+        # bubble ticks must not pollute the MoE aux loss
+        live = jnp.logical_and(t >= idx, t < idx + M).astype(a.dtype)
+        state = jax.lax.ppermute(out, "pp", perm)
+        return (state, out_buf, aux + a * live), None
+
+    st0 = _pvary(jnp.zeros_like(x_mb[0]), ("pp",))
+    buf0 = _pvary(jnp.zeros_like(x_mb), ("pp",))
+    aux0 = _pvary(jnp.zeros((), x.dtype), ("dp", "sp", "pp"))
+    (_, out_buf, aux), _ = jax.lax.scan(
+        tick, (st0, buf0, aux0), jnp.arange(T))
+    out = jax.lax.psum(out_buf, "pp")           # only last stage non-zero
+    aux = jax.lax.psum(aux, "pp")               # sum stage contributions
+    return out.reshape((B,) + x.shape[1:]), aux
+
+
+def _lm_local_loss(params, tokens, targets, cfg, mesh_shape,
+                   num_microbatches):
+    """Per-device loss over local (dp, sp) shards of tokens/targets."""
+    tp, pp, ep = mesh_shape["tp"], mesh_shape["pp"], mesh_shape["ep"]
+    heads_local = cfg.n_heads // tp
+    b, s_loc = tokens.shape
+    sp_i = jax.lax.axis_index("sp")
+    pos0 = sp_i * s_loc
+
+    x = params["embed"][tokens]                       # (b, s_loc, d)
+    x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos0, s_loc, 0)
+
+    # tp shard the head/ffn dims of the layer stacks locally: shard_map
+    # already sliced them via in_specs; layers leaves arrive local.
+    x, aux = _pipeline_stages_local(params["layers"], x, cfg, heads_local,
+                                    pp, ep, num_microbatches)
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = x @ params["embed"].T                    # (b, s_loc, V)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(nll)
+    total = jax.lax.psum(local_sum, ("dp", "sp"))
+    count = jax.lax.psum(jnp.asarray(nll.size, jnp.float32), ("dp", "sp"))
+    return total / count + 0.01 * jax.lax.psum(aux, ("dp", "sp")) / (
+        mesh_shape["dp"] * mesh_shape["sp"])
+
+
+def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                lr=0.1, num_microbatches=None):
+    """Build ``step(params, tokens, targets) -> (params, loss)`` — one
+    compiled SPMD program doing forward, backward, psum, SGD.
+
+    The shard_map wraps the LOSS only, with replication checking ON, so
+    JAX's manual-SPMD AD inserts the correct psum/pbroadcast transposes
+    for every mix of sharded (tp/pp/ep) and replicated parameters —
+    gradients need no hand reductions. value_and_grad + the SGD update
+    sit outside and fuse into the same XLA program under jit.
+
+    mesh must carry all of ``("dp","sp","tp","pp","ep")`` (size 1 ok).
+    tokens/targets: (batch, seq) int32, sharded (dp, sp).
+    """
+    for ax in AXES:
+        if ax not in mesh.axis_names:
+            raise ValueError("mesh is missing axis %r" % ax)
+    mesh_shape = {a: mesh.shape[a] for a in AXES}
+    M = num_microbatches or max(1, mesh_shape["pp"])
+    specs = _param_specs(cfg, mesh_shape["pp"])
+
+    pspec = {k: (v if not isinstance(v, dict) else dict(v))
+             for k, v in specs.items()}
+    data_spec = P("dp", "sp")
+    loss_fn = shard_map(
+        functools.partial(_lm_local_loss, cfg=cfg, mesh_shape=mesh_shape,
+                          num_microbatches=M),
+        mesh=mesh, in_specs=(pspec, data_spec, data_spec), out_specs=P())
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+
+def transformer_forward_single(params, tokens, cfg: TransformerConfig):
+    """Single-device reference forward (used by tests to validate the
+    sharded step; also the flagship single-chip inference path)."""
+    x = params["embed"][tokens]
+    x = x + params["pos"][: tokens.shape[1]]
+    layers = params["layers"]
+    pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
+    hd = cfg.d_model // cfg.n_heads
+    for st in range(pp):
+        for li in range(lps):
+            lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
+            h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+            b, s, d = h.shape
+            q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
+            k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, hd)
+            v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, hd)
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            sc = jnp.where(mask, sc, -1e30)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
+            x = x + o.reshape(b, s, d) @ lp["wo"]
+            h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
+            if cfg.num_experts:
+                tok = h2.reshape(b * s, d)
+                logits = tok @ lp["gate"]
+                cap = max(1, int(cfg.capacity_factor * tok.shape[0]
+                                 * min(cfg.moe_top_k, 2) / cfg.num_experts))
+                disp, comb, _ = top_k_gating(logits, cfg.num_experts, cap,
+                                             k=cfg.moe_top_k)
+                exp_in = jnp.einsum("nec,nd->ecd", disp.astype(x.dtype), tok)
+                hh = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", exp_in,
+                                            lp["we1"]))
+                eo = jnp.einsum("ecf,efd->ecd", hh, lp["we2"])
+                f = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype),
+                               eo).reshape(b, s, d)
+            else:
+                f = jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + f
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["embed"].T

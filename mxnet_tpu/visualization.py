@@ -21,11 +21,12 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     shape_dict = {}
     out_shape_dict = {}
     if shape is not None:
-        arg_shapes, _, _ = symbol.infer_shape(**shape)
-        for name, s in zip(symbol.list_arguments(), arg_shapes):
-            shape_dict[name] = s
+        # one inference pass over the internals yields both the argument
+        # shapes and every intermediate output shape
         internals = symbol.get_internals()
-        _, int_shapes, _ = internals.infer_shape(**shape)
+        arg_shapes, int_shapes, _ = internals.infer_shape(**shape)
+        for name, s in zip(internals.list_arguments(), arg_shapes):
+            shape_dict[name] = s
         for name, s in zip(internals.list_outputs(), int_shapes):
             out_shape_dict[name] = s
     positions = [int(line_length * p) for p in positions]

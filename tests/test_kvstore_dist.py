@@ -1,0 +1,222 @@
+"""Distributed KVStore: PS server, gradient compression, launcher.
+
+Mirrors the reference's dist tests (tests/nightly/dist_sync_kvstore.py:
+consistency of dense/compressed push-pull across ranks, launched via
+tools/launch.py --launcher local) scaled down for CI.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gradient_compression import TwoBitCompressor, Int8Compressor
+from mxnet_tpu.kvstore_server import KVStoreServer
+
+
+# ---------------------------------------------------------------------------
+# compression codecs
+# ---------------------------------------------------------------------------
+
+def test_2bit_quantization_values():
+    c = TwoBitCompressor(threshold=0.5)
+    x = np.array([0.7, -0.9, 0.1, -0.2, 0.5, 0.49], np.float32)
+    y = c.roundtrip("k", x)
+    np.testing.assert_allclose(y, [0.5, -0.5, 0, 0, 0.5, 0], atol=0)
+
+
+def test_2bit_error_feedback_accumulates():
+    c = TwoBitCompressor(threshold=0.5)
+    x = np.full((8,), 0.3, np.float32)
+    y1 = c.roundtrip("k", x)          # 0.3 < t -> 0, residual 0.3
+    y2 = c.roundtrip("k", x)          # 0.6 >= t -> +t
+    assert np.all(y1 == 0.0)
+    assert np.all(y2 == 0.5)
+    # long-run mean approaches the true value (unbiased-ish via feedback)
+    total = y1 + y2
+    for _ in range(18):
+        total += c.roundtrip("k", x)
+    assert abs(total.mean() / 20 - 0.3) < 0.05
+
+
+def test_2bit_packing_density():
+    c = TwoBitCompressor(threshold=1.0)
+    x = np.random.RandomState(0).randn(1024).astype(np.float32)
+    packed, shape = c.compress("k", x)
+    assert packed.nbytes == 1024 // 4          # 2 bits per value
+    assert c.decompress(packed, shape).shape == (1024,)
+
+
+def test_int8_compressor_close():
+    c = Int8Compressor()
+    x = np.random.RandomState(1).randn(256).astype(np.float32)
+    y = c.roundtrip("k", x)
+    assert np.max(np.abs(x - y)) < np.max(np.abs(x)) / 100
+
+
+def test_kvstore_local_compression_applies():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    out = mx.nd.zeros((4,))
+    kv.push("w", mx.nd.array(np.array([0.7, 0.1, -0.9, 0.0], np.float32)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0, -0.5, 0])
+
+
+# ---------------------------------------------------------------------------
+# PS server (threads in-process)
+# ---------------------------------------------------------------------------
+
+def _worker(port, rank, nw, results, mode="sync"):
+    env = {"MXNET_TPU_PS_URI": "127.0.0.1", "MXNET_TPU_PS_PORT": str(port),
+           "MXNET_TPU_RANK": str(rank), "MXNET_TPU_NUM_WORKERS": str(nw)}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        kv = mx.kv.create("dist_async" if mode == "async" else
+                          "dist_tpu_sync")
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.barrier()
+        kv.push("w", mx.nd.array(
+            np.full((4,), float(rank + 1), np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        results[rank] = out.asnumpy()
+        kv.barrier()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_ps_sync_aggregate_then_update():
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    server.start_background()
+    results = {}
+    ts = [threading.Thread(target=_worker,
+                           args=(server.port, r, 2, results))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    server.stop()
+    # no optimizer on server -> store holds the aggregated sum 1+2=3
+    np.testing.assert_allclose(results[0], np.full((4,), 3.0))
+    np.testing.assert_allclose(results[1], np.full((4,), 3.0))
+
+
+def test_ps_async_immediate_update():
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=False)
+    server.start_background()
+    results = {}
+    _worker(server.port, 0, 1, results, mode="async")
+    server.stop()
+    np.testing.assert_allclose(results[0], np.full((4,), 1.0))
+
+
+def test_ps_server_side_optimizer():
+    import pickle
+    from mxnet_tpu.kvstore_server import send_msg, recv_msg
+    import socket
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    s = socket.socket()
+    s.connect(("127.0.0.1", server.port))
+
+    def call(op, key=None, value=None):
+        send_msg(s, (op, key, value))
+        return recv_msg(s)
+
+    opt = mx.optimizer.SGD(learning_rate=0.5)
+    assert call("SET_OPTIMIZER", None, pickle.dumps(opt))[0] == "OK"
+    assert call("INIT", "w", np.ones((3,), np.float32))[0] == "OK"
+    assert call("PUSH", "w", np.full((3,), 2.0, np.float32))[0] == "OK"
+    st, w = call("PULL", "w")
+    server.stop()
+    # w = 1 - 0.5 * 2 = 0 (sgd on the server, ApplyUpdates analog)
+    np.testing.assert_allclose(w, np.zeros((3,)), atol=1e-6)
+
+
+def test_ps_row_sparse_pull():
+    from mxnet_tpu.kvstore_server import send_msg, recv_msg
+    import socket
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    s = socket.socket()
+    s.connect(("127.0.0.1", server.port))
+    send_msg(s, ("INIT", "emb", np.arange(12, dtype=np.float32).reshape(4, 3)))
+    recv_msg(s)
+    send_msg(s, ("PULL_ROWS", "emb", np.array([2, 0], np.int64)))
+    st, sub = recv_msg(s)
+    server.stop()
+    np.testing.assert_allclose(sub, [[6, 7, 8], [0, 1, 2]])
+
+
+def test_ps_compressed_push():
+    from mxnet_tpu.kvstore_server import send_msg, recv_msg
+    from mxnet_tpu.gradient_compression import TwoBitCompressor
+    import socket
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    s = socket.socket()
+    s.connect(("127.0.0.1", server.port))
+    send_msg(s, ("SET_COMPRESSION", None, {"type": "2bit",
+                                           "threshold": 0.5}))
+    recv_msg(s)
+    send_msg(s, ("INIT", "w", np.zeros((4,), np.float32)))
+    recv_msg(s)
+    c = TwoBitCompressor(threshold=0.5)
+    payload = c.compress("w", np.array([0.7, 0.1, -0.9, 0.0], np.float32))
+    send_msg(s, ("PUSH", "w", payload))
+    st, err = recv_msg(s)
+    assert st == "OK", err
+    send_msg(s, ("PULL", "w"))
+    st, w = recv_msg(s)
+    server.stop()
+    assert st == "OK", w
+    np.testing.assert_allclose(w, [0.5, 0, -0.5, 0])
+
+
+# ---------------------------------------------------------------------------
+# launcher end-to-end (real processes)
+# ---------------------------------------------------------------------------
+
+_WORKER_SCRIPT = r"""
+import os
+import numpy as np
+import mxnet_tpu as mx
+rank = int(os.environ["MXNET_TPU_RANK"])
+kv = mx.kv.create("dist_tpu_sync")
+kv.init("x", mx.nd.zeros((2,)))
+kv.barrier()
+kv.push("x", mx.nd.array(np.full((2,), float(rank + 1), np.float32)))
+out = mx.nd.zeros((2,))
+kv.pull("x", out=out)
+assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+print("worker %d ok" % rank)
+"""
+
+
+@pytest.mark.slow
+def test_launch_local_two_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SCRIPT)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TPU_PLATFORM"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "worker 0 ok" in proc.stdout
+    assert "worker 1 ok" in proc.stdout

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Local cluster launcher for distributed KVStore jobs.
+
+Capability analog of the reference's tools/launch.py (dmlc tracker:
+spawns scheduler + servers + workers with DMLC_ROLE env, supporting
+ssh/mpi/yarn/local launchers). TPU deployments get multi-host process
+bootstrap from jax.distributed / the cluster scheduler, so this tool
+covers the remaining case the reference's dist tests rely on: forking
+a parameter server + N workers on ONE host to exercise dist kvstore
+semantics end-to-end (tests/nightly/dist_sync_kvstore.py pattern).
+
+Usage:
+    python tools/launch.py -n 2 [--sync-mode sync|async] \
+        python my_training_script.py --kv-store dist_async
+
+Env exported to children (reference: DMLC_ROLE / DMLC_PS_ROOT_URI):
+    MXNET_TPU_ROLE, MXNET_TPU_PS_URI, MXNET_TPU_PS_PORT,
+    MXNET_TPU_NUM_WORKERS, MXNET_TPU_RANK, MXNET_TPU_PS_MODE
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("--sync-mode", default="sync",
+                    choices=["sync", "async"])
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for children")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    port = _free_port()
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+    import uuid
+    base_env.update({
+        "MXNET_TPU_PS_URI": "127.0.0.1",
+        "MXNET_TPU_PS_PORT": str(port),
+        "MXNET_TPU_NUM_WORKERS": str(args.num_workers),
+        "MXNET_TPU_PS_MODE": args.sync_mode,
+        # shared secret for the pickle wire protocol (server rejects
+        # unauthenticated peers)
+        "MXNET_TPU_PS_TOKEN": uuid.uuid4().hex,
+    })
+
+    server_env = dict(base_env, MXNET_TPU_ROLE="server")
+    server = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=server_env)
+    time.sleep(1.0)  # listener up
+
+    workers = []
+    for rank in range(args.num_workers):
+        wenv = dict(base_env, MXNET_TPU_ROLE="worker",
+                    MXNET_TPU_RANK=str(rank))
+        workers.append(subprocess.Popen(args.command, env=wenv))
+
+    rc = 0
+    try:
+        for w in workers:
+            rc |= w.wait()
+    finally:
+        server.send_signal(signal.SIGTERM)
+        try:
+            server.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            server.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
