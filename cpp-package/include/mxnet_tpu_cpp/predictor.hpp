@@ -1,0 +1,106 @@
+// C++ wrapper over the mxnet_tpu C predict ABI.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/ (header-only C++
+// frontend over the C ABI). This header wraps the predict surface
+// (src/native/c_predict_api.cc) in an RAII class; link against
+// build/native/libmxtpu_predict.so.
+
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef void* PredictorHandle;
+const char* MXGetLastError();
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         uint32_t* shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle h);
+}
+
+namespace mxnet_tpu_cpp {
+
+class Predictor {
+ public:
+  // dev_type: 1 = cpu, 2 = tpu (reference: c_predict_api.h dev codes).
+  Predictor(const std::string& symbol_json, const std::string& param_blob,
+            const std::map<std::string, std::vector<uint32_t>>& input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> data;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (uint32_t d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<uint32_t>(data.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                     static_cast<int>(param_blob.size()), dev_type, dev_id,
+                     static_cast<uint32_t>(keys.size()), keys.data(),
+                     indptr.data(), data.data(), &handle_) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+
+  void SetInput(const std::string& key, const std::vector<float>& v) {
+    if (MXPredSetInput(handle_, key.c_str(), v.data(),
+                       static_cast<uint32_t>(v.size())) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  std::vector<uint32_t> GetOutputShape(uint32_t index) {
+    uint32_t ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, nullptr, &ndim) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    std::vector<uint32_t> shape(ndim);
+    MXPredGetOutputShape(handle_, index, shape.data(), &ndim);
+    return shape;
+  }
+
+  std::vector<float> GetOutput(uint32_t index) {
+    auto shape = GetOutputShape(index);
+    uint32_t size = 1;
+    for (uint32_t d : shape) size *= d;
+    std::vector<float> out(size);
+    if (MXPredGetOutput(handle_, index, out.data(), size) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

@@ -22,6 +22,10 @@ from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray
 from . import ndarray as nd
+from . import operator
+# nd.Custom uses the eager Function-based bridge; sym.Custom / hybridized
+# graphs pick up the "Custom" OpDef (pure_callback) operator.py registers.
+nd.Custom = operator.custom_ndarray
 from . import autograd
 from . import random
 from .random import seed
@@ -32,7 +36,8 @@ __version__ = "0.1.0"
 _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "initializer", "init", "kvstore", "kv", "callback", "lr_scheduler",
          "profiler", "parallel", "test_utils", "image", "recordio", "engine",
-         "executor", "model", "monitor", "visualization")
+         "executor", "model", "monitor", "visualization", "rtc", "contrib",
+         "checkpoint", "gradient_compression", "kvstore_server")
 
 
 def __getattr__(name):

@@ -1,0 +1,113 @@
+"""Shard-aware checkpoint / resume.
+
+Reference capability (SURVEY.md §5 "Checkpoint / resume"): NDArray
+binary save/load (src/ndarray/ndarray.cc:1565), Module
+save_checkpoint/load_checkpoint (python/mxnet/model.py:383,413), Gluon
+save/load_parameters — all host-resident, single-process.
+
+TPU-native addition the reference lacks: checkpoints of SHARDED
+training state. A params pytree laid out over a Mesh (ShardedTrainer,
+parallel.transformer) saves without gathering to one host and restores
+with its shardings intact — backed by Orbax (the JAX ecosystem's
+checkpoint layer over tensorstore), the same machinery that scales to
+multi-pod. Single-host NDArray dict save/load stays in
+ndarray/utils.py (mx.nd.save/load); this module covers training-state
+checkpointing + resume.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["ShardedCheckpointManager", "save_sharded", "restore_sharded"]
+
+
+class ShardedCheckpointManager(object):
+    """Step-indexed checkpoint manager (reference analog: callback
+    do_checkpoint + Module save_checkpoint, made shard-aware).
+
+    Example::
+
+        ckpt = ShardedCheckpointManager(dir, max_to_keep=3)
+        ckpt.save(step, {"params": params, "moms": moms})
+        state = ckpt.restore(ckpt.latest_step(), like=abstract_state)
+    """
+
+    def __init__(self, directory, max_to_keep=None):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                            create=True)
+        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+        self._ocp = ocp
+
+    def save(self, step, state, wait=True):
+        """Save a pytree of (possibly sharded) jax arrays at ``step``."""
+        state = _unwrap(state)
+        self._mgr.save(int(step), args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step=None, like=None):
+        """Restore; ``like`` is a pytree of arrays or ShapeDtypeStruct
+        with shardings — restored arrays come back with those shardings
+        (pass the freshly-initialized state to resume in place)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise MXNetError("no checkpoint found in %s" % self._dir)
+        if like is not None:
+            import jax
+            like = _unwrap(like)
+            abstract = jax.tree_util.tree_map(_abstractify, like)
+            args = self._ocp.args.StandardRestore(abstract)
+        else:
+            args = self._ocp.args.StandardRestore()
+        return self._mgr.restore(int(step), args=args)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+
+def _abstractify(x):
+    import jax
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                sharding=getattr(x, "sharding", None))
+
+
+def _unwrap(state):
+    """NDArrays -> raw jax arrays (checkpoint stores the data plane)."""
+    import jax
+    from .ndarray.ndarray import NDArray
+
+    def leaf(x):
+        return x._data if isinstance(x, NDArray) else x
+    return jax.tree_util.tree_map(leaf, state,
+                                  is_leaf=lambda x: isinstance(x, NDArray))
+
+
+def save_sharded(directory, step, state):
+    """One-shot save (convenience wrapper)."""
+    mgr = ShardedCheckpointManager(directory)
+    try:
+        mgr.save(step, state)
+    finally:
+        mgr.close()
+
+
+def restore_sharded(directory, step=None, like=None):
+    mgr = ShardedCheckpointManager(directory)
+    try:
+        return mgr.restore(step, like=like)
+    finally:
+        mgr.close()

@@ -1,0 +1,12 @@
+"""contrib: experimental / auxiliary packages.
+
+Reference: python/mxnet/contrib/ (quantization driver, ONNX
+import/export, text embeddings, SVRG optimization, tensorboard logger,
+legacy autograd alias).
+"""
+from . import quantization            # noqa: F401
+from . import text                    # noqa: F401
+from . import svrg_optimization      # noqa: F401
+from . import tensorboard             # noqa: F401
+from . import onnx                    # noqa: F401
+from .quantization import quantize_model  # noqa: F401

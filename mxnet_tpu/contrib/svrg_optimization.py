@@ -1,0 +1,112 @@
+"""SVRG optimization (stochastic variance-reduced gradient).
+
+Reference: python/mxnet/contrib/svrg_optimization/ (SVRGModule wrapping
+Module: a full-batch gradient snapshot (mu) refreshed every
+``update_freq`` epochs, and per-batch updates using
+``g(w) - g(w_snap) + mu``).
+
+TPU-native: the variance-reduced step is plain array math; the snapshot
+pass reuses the Module executor (one compiled program, swapped weights).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(object):
+    """Module wrapper implementing SVRG (reference: svrg_module.py)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, ctx=None):
+        from ..module import Module
+        self._mod = Module(symbol, data_names=list(data_names),
+                           label_names=list(label_names), context=ctx)
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = int(update_freq)
+        self._snapshot_params = None     # w_snap
+        self._mu = None                  # full-batch grad at w_snap
+
+    # -- delegated Module surface -----------------------------------------
+    def bind(self, *a, **k):
+        return self._mod.bind(*a, **k)
+
+    def init_params(self, *a, **k):
+        return self._mod.init_params(*a, **k)
+
+    def forward(self, *a, **k):
+        return self._mod.forward(*a, **k)
+
+    def backward(self, *a, **k):
+        return self._mod.backward(*a, **k)
+
+    def get_params(self):
+        return self._mod.get_params()
+
+    def update_metric(self, *a, **k):
+        return self._mod.update_metric(*a, **k)
+
+    # -- internals ---------------------------------------------------------
+    def _grads(self):
+        # asnumpy may return read-only views of device buffers: copy
+        return {n: _np.array(self._mod._exec.grad_dict[n].asnumpy())
+                for n in self._mod._param_names}
+
+    def _batch_grad(self, batch):
+        self._mod.forward(batch, is_train=True)
+        self._mod.backward()
+        return self._grads()
+
+    def take_snapshot(self, train_data):
+        """Full-pass average gradient at current weights (the mu term;
+        reference: svrg_module.py update_full_grads)."""
+        arg_params, _ = self._mod.get_params()
+        self._snapshot_params = {k: v.copy() for k, v in
+                                 arg_params.items()}
+        sums, count = None, 0
+        train_data.reset()
+        for batch in train_data:
+            g = self._batch_grad(batch)
+            if sums is None:
+                sums = g
+            else:
+                for k in sums:
+                    sums[k] += g[k]
+            count += 1
+        self._mu = {k: v / max(count, 1) for k, v in (sums or {}).items()}
+        train_data.reset()
+
+    def fit(self, train_data, num_epoch=1, lr=0.05, eval_metric="acc"):
+        """SVRG training loop (reference: svrg_module.py fit)."""
+        from .. import metric as _metric
+        from ..ndarray.ndarray import array
+        assert self._mod.binded and self._mod.params_initialized, \
+            "bind() and init_params() before fit()"
+        em = _metric.create(eval_metric) if eval_metric else None
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.take_snapshot(train_data)
+            if em is not None:
+                em.reset()
+            train_data.reset()
+            for batch in train_data:
+                g_cur = self._batch_grad(batch)
+                if em is not None:
+                    self._mod.update_metric(em, batch.label)
+                cur, aux = self._mod.get_params()
+                # same batch at the snapshot weights
+                self._mod.set_params(self._snapshot_params, aux)
+                g_snap = self._batch_grad(batch)
+                self._mod.set_params(cur, aux)
+                new = {}
+                for k, w in cur.items():
+                    adj = g_cur[k] - g_snap[k] + self._mu.get(
+                        k, _np.zeros_like(g_cur[k]))
+                    new[k] = array(w.asnumpy() - lr * adj)
+                self._mod.set_params(new, aux)
+            train_data.reset()
+        return em

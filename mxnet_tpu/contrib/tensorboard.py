@@ -1,0 +1,56 @@
+"""TensorBoard logging callback.
+
+Reference: python/mxnet/contrib/tensorboard.py (LogMetricsCallback
+writing eval metrics to an event file). The summary writer backend is
+optional; without it we fall back to a plain JSONL event log that the
+XLA-profiler TensorBoard plugin setup can ingest later.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Log metrics each batch/epoch (reference: contrib/tensorboard.py).
+
+    Uses tensorboardX / torch.utils.tensorboard when importable,
+    otherwise appends JSONL records to ``logging_dir/metrics.jsonl``.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._dir = logging_dir
+        os.makedirs(logging_dir, exist_ok=True)
+        self._writer = None
+        for mod, cls in (("tensorboardX", "SummaryWriter"),
+                         ("torch.utils.tensorboard", "SummaryWriter")):
+            try:
+                import importlib
+                m = importlib.import_module(mod)
+                self._writer = getattr(m, cls)(logging_dir)
+                break
+            except Exception:
+                continue
+        if self._writer is None:
+            self._fallback = open(os.path.join(logging_dir,
+                                               "metrics.jsonl"), "a")
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            step = getattr(param, "nbatch", 0) + \
+                getattr(param, "epoch", 0) * 1000000
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, step)
+            else:
+                self._fallback.write(json.dumps(
+                    {"ts": time.time(), "name": name, "value": float(value),
+                     "step": step}) + "\n")
+                self._fallback.flush()

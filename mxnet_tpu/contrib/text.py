@@ -1,0 +1,169 @@
+"""Text utilities: vocabulary + token embeddings.
+
+Reference: python/mxnet/contrib/text/ (vocab.py Vocabulary,
+embedding.py TokenEmbedding/CustomEmbedding, utils count_tokens).
+Pretrained-download variants (GloVe/FastText) are gated: this image has
+zero egress, so they raise with guidance; CustomEmbedding covers
+user-supplied vectors.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
+           "GloVe", "FastText"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens (reference: contrib/text/utils.py)."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source_str.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary(object):
+    """Indexed vocabulary (reference: contrib/text/vocab.py)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        self.unknown_token = unknown_token
+        self.reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self.reserved_tokens
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, cnt in pairs:
+                if cnt < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        ids = [self._token_to_idx.get(t, 0) for t in toks]
+        return ids[0] if single else ids
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError("index %d out of vocabulary range" % i)
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base: vocabulary + vector table (reference: embedding.py)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        from ..ndarray.ndarray import array
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        ids = []
+        for t in toks:
+            if t in self._token_to_idx:
+                ids.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                ids.append(self._token_to_idx[t.lower()])
+            else:
+                ids.append(0)
+        vecs = self._idx_to_vec[ids]
+        return array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        nv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors)
+        nv = nv.reshape(len(toks), -1)
+        for t, v in zip(toks, nv):
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r not in the embedding" % t)
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user token→vector file or dict
+    (reference: embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, vectors=None, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        table = {}
+        if pretrained_file_path is not None:
+            with open(pretrained_file_path, encoding=encoding) as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if len(parts) < 2:
+                        continue
+                    table[parts[0]] = _np.asarray(
+                        [float(x) for x in parts[1:]], _np.float32)
+        if vectors:
+            table.update({k: _np.asarray(v, _np.float32)
+                          for k, v in vectors.items()})
+        if not table:
+            raise MXNetError("CustomEmbedding needs a file or vectors=")
+        self._vec_len = len(next(iter(table.values())))
+        tokens = vocabulary.idx_to_token if vocabulary is not None else \
+            [self.unknown_token] + sorted(table)
+        self._idx_to_token = list(tokens)
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        self._idx_to_vec = _np.zeros((len(self._idx_to_token),
+                                      self._vec_len), _np.float32)
+        for t, i in self._token_to_idx.items():
+            if t in table:
+                self._idx_to_vec[i] = table[t]
+
+
+def _no_egress(name):
+    class _Gated(_TokenEmbedding):
+        def __init__(self, *a, **k):
+            raise MXNetError(
+                "%s requires downloading pretrained vectors, which this "
+                "environment cannot do (zero egress); use CustomEmbedding "
+                "with a local vector file" % name)
+    _Gated.__name__ = name
+    return _Gated
+
+
+GloVe = _no_egress("GloVe")
+FastText = _no_egress("FastText")

@@ -78,6 +78,8 @@ class KVStore(object):
             status, payload = recv_msg(self._sock)
             if status != "OK":
                 raise MXNetError("kvstore server auth failed: %s" % payload)
+        # register this rank for liveness tracking
+        self._ps_call("HELLO", None, self._env_rank)
 
     def _ps_call(self, op, key=None, value=None):
         from .kvstore_server import send_msg, recv_msg
@@ -279,6 +281,15 @@ class KVStore(object):
             multihost_utils.sync_global_devices(
                 "kvstore_barrier_%d" % self._barrier_count)
         self._barrier_count += 1
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Count of workers presumed dead: no traffic for ``timeout``
+        seconds (reference: include/mxnet/kvstore.h:353 ps-lite
+        heartbeat liveness). 0 outside PS mode — XLA-collective workers
+        fail as a unit, there is no partial-death state to query."""
+        if self._sock is None:
+            return 0
+        return len(self._ps_call("DEAD_NODES", None, timeout))
 
     # -- optimizer state io ------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -87,6 +87,9 @@ class KVStoreServer(object):
         self._round_done = threading.Condition(self._lock)
         self._barrier_waiting = 0
         self._barrier_gen = 0
+        import time as _t
+        self._start_time = _t.monotonic()
+        self._last_seen = {}        # rank -> monotonic seconds
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -166,6 +169,24 @@ class KVStoreServer(object):
             with self._lock:
                 self._compressor = create_compressor(value)
             return ("OK", None)
+        if op == "HELLO":
+            # rank registration + heartbeat (reference: ps-lite node
+            # liveness behind kvstore.h:353 get_num_dead_node)
+            import time as _t
+            with self._lock:
+                self._last_seen[int(value)] = _t.monotonic()
+            return ("OK", None)
+        if op == "DEAD_NODES":
+            import time as _t
+            timeout = 60.0 if value is None else float(value)
+            now = _t.monotonic()
+            with self._lock:
+                # never-connected ranks get a grace period measured from
+                # server start instead of counting dead instantly
+                dead = [r for r in range(self._num_workers)
+                        if now - self._last_seen.get(r, self._start_time)
+                        > timeout]
+            return ("OK", dead)
         if op == "STOP":
             self._stop.set()
             with self._lock:
@@ -194,8 +215,18 @@ class KVStoreServer(object):
                     send_msg(conn, ("ERR", "auth failed"))
                     return
                 send_msg(conn, ("OK", None))
+            rank = None
             while not self._stop.is_set():
                 msg = recv_msg(conn)
+                if msg[0] == "HELLO":
+                    rank = int(msg[2])
+                elif rank is not None:
+                    # heartbeat BEFORE handling: sync PUSH/BARRIER block
+                    # inside _handle waiting for stragglers, and a
+                    # blocked-but-alive worker must not read as dead
+                    import time as _t
+                    with self._lock:
+                        self._last_seen[rank] = _t.monotonic()
                 try:
                     resp = self._handle(*msg)
                 except Exception:

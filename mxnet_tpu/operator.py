@@ -1,0 +1,245 @@
+"""Custom Python operators: CustomOp / CustomOpProp / register.
+
+Reference: python/mxnet/operator.py:76-191 (CustomOp, CustomOpProp,
+register) and src/operator/custom/custom-inl.h:50-173 (the C++ bridge
+that runs Python callbacks off the engine threads).
+
+TPU-native design: two execution paths share the same user API —
+
+* **eager** (``nd.Custom``): the op runs as a host function between
+  device ops, wrapped in :class:`autograd.Function` so its
+  ``backward`` joins the tape like any other op.
+* **symbolic/jit** (``sym.Custom`` / hybridized graphs): the op lowers
+  to ``jax.pure_callback`` (host callback inside the compiled XLA
+  program — the analog of the reference's dedicated custom-op worker
+  thread) with a ``jax.custom_vjp`` whose backward is itself a host
+  callback into the user's ``backward``.
+
+``req`` write modes and ``assign`` mirror the reference semantics.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp(object):
+    """Base class for user ops (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the request type."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Op metadata provider (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``reg_name``
+    (reference: operator.py register → MXCustomOpRegister)."""
+    def _wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return _wrap
+
+
+def get_prop(op_type, **kwargs):
+    try:
+        cls = _CUSTOM_REGISTRY[op_type]
+    except KeyError:
+        raise MXNetError("custom op %r is not registered" % op_type) \
+            from None
+    return cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# eager path: nd.Custom
+# ---------------------------------------------------------------------------
+
+def custom_ndarray(*inputs, op_type=None, **kwargs):
+    """Eager invocation (generated as ``nd.Custom`` in the reference)."""
+    from .ndarray.ndarray import NDArray, zeros
+    from . import autograd
+    if op_type is None:
+        raise MXNetError("nd.Custom requires op_type=")
+    prop = get_prop(op_type, **kwargs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+    _, out_types, _ = prop.infer_type([x.dtype for x in inputs])
+    ctx = inputs[0].context if inputs else None
+    op = prop.create_operator(ctx, in_shapes,
+                              [x.dtype for x in inputs])
+    n_out = len(out_shapes)
+    # captured BEFORE Function.__call__ enters pause(): inside forward,
+    # is_recording() is always False
+    training = autograd.is_recording()
+
+    class _Fn(autograd.Function):
+        def forward(self, *ins):
+            outs = [zeros(s, dtype=t)
+                    for s, t in zip(out_shapes, out_types)]
+            op.forward(is_train=training,
+                       req=["write"] * n_out, in_data=list(ins),
+                       out_data=outs, aux=[])
+            # keep the real outputs: backward implementations read
+            # out_data (e.g. sigmoid grad = g * out * (1 - out))
+            self._fwd_outs = outs
+            return outs[0] if n_out == 1 else tuple(outs)
+
+        def backward(self, *out_grads):
+            in_grads = [zeros(s) for s in in_shapes]
+            op.backward(req=["write"] * len(inputs),
+                        out_grad=list(out_grads), in_data=list(inputs),
+                        out_data=self._fwd_outs, in_grad=in_grads, aux=[])
+            return in_grads[0] if len(in_grads) == 1 else tuple(in_grads)
+
+    return _Fn()(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# jit/symbolic path: host callbacks inside the compiled program
+# ---------------------------------------------------------------------------
+
+def make_custom_jax_fn(op_type, **kwargs):
+    """Build a jittable jax function for the custom op: pure_callback
+    forward + custom_vjp whose backward is another host callback (the
+    capability analog of custom-inl.h's async python bridge)."""
+    import jax
+    import jax.numpy as jnp
+
+    prop = get_prop(op_type, **kwargs)
+
+    def _host_forward(*arrays):
+        from .ndarray.ndarray import NDArray, zeros
+        ins = [NDArray(jnp.asarray(a)) for a in arrays]
+        in_shapes = [tuple(a.shape) for a in arrays]
+        _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+        _, out_types, _ = prop.infer_type([a.dtype for a in arrays])
+        op = prop.create_operator(None, in_shapes,
+                                  [a.dtype for a in arrays])
+        outs = [zeros(s, dtype=t) for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=True, req=["write"] * len(outs),
+                   in_data=ins, out_data=outs, aux=[])
+        return tuple(_np.asarray(o.asnumpy()) for o in outs)
+
+    def _host_backward(n_in, *arrays_and_cts):
+        from .ndarray.ndarray import NDArray, zeros
+        ins = [NDArray(jnp.asarray(a)) for a in arrays_and_cts[:n_in]]
+        cts = [NDArray(jnp.asarray(a)) for a in arrays_and_cts[n_in:]]
+        in_shapes = [tuple(a.shape) for a in ins]
+        _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+        op = prop.create_operator(None, in_shapes,
+                                  [a.dtype for a in ins])
+        outs = [zeros(s) for s in out_shapes]
+        op.forward(is_train=True, req=["write"] * len(outs),
+                   in_data=ins, out_data=outs, aux=[])
+        grads = [zeros(s) for s in in_shapes]
+        op.backward(req=["write"] * n_in, out_grad=cts, in_data=ins,
+                    out_data=outs, in_grad=grads, aux=[])
+        return tuple(_np.asarray(g.asnumpy()) for g in grads)
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        in_shapes = [tuple(a.shape) for a in arrays]
+        _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+        _, out_types, _ = prop.infer_type(
+            [_np.dtype(a.dtype) for a in arrays])
+        result_shapes = tuple(
+            jax.ShapeDtypeStruct(s, _np.dtype(t))
+            for s, t in zip(out_shapes, out_types))
+        out = jax.pure_callback(_host_forward, result_shapes, *arrays)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    def fn_fwd(*arrays):
+        return fn(*arrays), arrays
+
+    def fn_bwd(arrays, cts):
+        cts_t = cts if isinstance(cts, (tuple, list)) else (cts,)
+        grad_shapes = tuple(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                            for a in arrays)
+        cb = functools.partial(_host_backward, len(arrays))
+        grads = jax.pure_callback(cb, grad_shapes,
+                                  *(tuple(arrays) + tuple(cts_t)))
+        return tuple(grads)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# op-registry hook: makes ``Custom`` usable from nd, symbol graphs, and
+# hybridized blocks (evaluated inside jit via the callbacks above)
+# ---------------------------------------------------------------------------
+
+def _custom_op_fn(*arrays, op_type=None, **kwargs):
+    """Custom python op as a graph node (reference: sym.Custom)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    return make_custom_jax_fn(op_type, **kwargs)(*arrays)
+
+
+def _custom_num_outputs(attrs):
+    prop = get_prop(attrs["op_type"],
+                    **{k: v for k, v in attrs.items() if k != "op_type"})
+    return len(prop.list_outputs())
+
+
+def _register_custom_opdef():
+    from .ops.registry import register as _reg_op
+    _reg_op("Custom", num_outputs=_custom_num_outputs,
+            attr_defaults={"op_type": None})(_custom_op_fn)
+
+
+_register_custom_opdef()

@@ -1,0 +1,120 @@
+"""Serving helper backing the native C predict ABI.
+
+Reference: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc
+(MXPredCreate/SetInput/Forward/GetOutput on a symbol json + params
+blob). The native layer (src/native/c_predict_api.cc) embeds CPython
+and drives this module; keeping the marshalling here means the C side
+is a thin, stable ABI while the compute path stays XLA.
+
+Params blob format = mx.nd.save (zip of NPY entries, the framework's
+checkpoint format); arg/aux entries use the reference's ``arg:name`` /
+``aux:name`` prefixes (falling back to raw names).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Predictor"]
+
+
+class Predictor(object):
+    """One bound inference executor (reference: c_predict_api.cc
+    Predictor struct)."""
+
+    def __init__(self, symbol_json, param_bytes, dev_type=1, dev_id=0,
+                 input_shapes=None):
+        from .symbol.symbol import load_json
+        from .ndarray import utils as _utils
+        from . import context as _ctx
+        sym = load_json(symbol_json)
+        fd, tmp = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_bytes)
+            saved = _utils.load(tmp)
+        finally:
+            os.unlink(tmp)
+        if not isinstance(saved, dict):
+            raise MXNetError("param blob must be a named-tensor dict")
+        arg_params, aux_params = {}, {}
+        for k, v in saved.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        ctx = _ctx.tpu(dev_id) if dev_type == 2 else _ctx.cpu(dev_id)
+        shapes = dict(input_shapes or {})
+        self._sym = sym
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._ctx = ctx
+        self._exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+        for k, v in arg_params.items():
+            if k in self._exe.arg_dict:
+                self._exe.arg_dict[k][:] = v
+        for k, v in aux_params.items():
+            if k in self._exe.aux_dict:
+                self._exe.aux_dict[k][:] = v
+        self._input_names = list(shapes)
+        self._outputs = None
+
+    def set_input(self, key, data_bytes):
+        """data_bytes: raw float32 little-endian in the bound shape."""
+        if key not in self._exe.arg_dict:
+            raise MXNetError("unknown input %r" % key)
+        arr = self._exe.arg_dict[key]
+        flat = _np.frombuffer(data_bytes, dtype="<f4")
+        if flat.size != int(_np.prod(arr.shape)):
+            raise MXNetError("input %r size mismatch: got %d want %d"
+                             % (key, flat.size, int(_np.prod(arr.shape))))
+        from .ndarray.ndarray import array
+        arr[:] = array(flat.reshape(arr.shape))
+
+    def forward(self):
+        self._outputs = self._exe.forward(is_train=False)
+
+    def num_outputs(self):
+        self._ensure_forward()
+        return len(self._outputs)
+
+    def get_output_shape(self, index):
+        self._ensure_forward()
+        return tuple(int(d) for d in self._outputs[index].shape)
+
+    def get_output(self, index):
+        """Returns raw float32 bytes of output ``index``."""
+        self._ensure_forward()
+        out = self._outputs[index].asnumpy().astype("<f4", copy=False)
+        return out.tobytes()
+
+    def _ensure_forward(self):
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+
+    def reshape(self, input_shapes):
+        """Rebind for new input shapes (reference: MXPredReshape). The
+        graph program is shape-specialized by the jit cache; only the
+        argument buffers are reallocated."""
+        new = Predictor.__new__(Predictor)
+        new._sym = self._sym
+        new._arg_params = self._arg_params
+        new._aux_params = self._aux_params
+        new._ctx = self._ctx
+        new._exe = self._sym.simple_bind(ctx=self._ctx, grad_req="null",
+                                         **dict(input_shapes))
+        for k, v in self._arg_params.items():
+            if k in new._exe.arg_dict:
+                new._exe.arg_dict[k][:] = v
+        for k, v in self._aux_params.items():
+            if k in new._exe.aux_dict:
+                new._exe.aux_dict[k][:] = v
+        new._input_names = list(input_shapes)
+        new._outputs = None
+        return new

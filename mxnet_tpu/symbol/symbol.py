@@ -565,6 +565,14 @@ def _apply_op(op, args, attrs, name):
             inputs[k] = v
         else:
             attrs[k] = v
+
+    def _variadic():
+        # computed lazily: only the overflow/unknown-kw branches need it,
+        # and inspect.signature is the dominant _apply_op cost
+        import inspect as _inspect
+        return any(p.kind is _inspect.Parameter.VAR_POSITIONAL
+                   for p in _inspect.signature(op.fn).parameters.values())
+
     for a in args:
         if not isinstance(a, Symbol):
             raise TypeError("positional args to symbol ops must be Symbols, "
@@ -572,9 +580,23 @@ def _apply_op(op, args, attrs, name):
         while pos < len(in_names) and in_names[pos][0] in inputs:
             pos += 1
         if pos >= len(in_names):
-            raise MXNetError("too many inputs for op %s" % op.name)
+            if _variadic():
+                # *args ops (Custom, concat-style): synthesize input slots
+                in_names = list(in_names) + [("arg%d" % pos, False)]
+            else:
+                raise MXNetError("too many inputs for op %s" % op.name)
         inputs[in_names[pos][0]] = a
         pos += 1
+
+    # keyword Symbols unknown to the signature (variadic ops only, e.g.
+    # sym.Custom(data=x, op_type=...)): append them as extra input slots
+    # in keyword order rather than dropping them silently
+    unknown_kw = [k for k in inputs if k not in (n for n, _ in in_names)]
+    if unknown_kw:
+        if not _variadic():
+            raise MXNetError("unknown input(s) %s for op %s"
+                             % (unknown_kw, op.name))
+        in_names = list(in_names) + [(k, False) for k in unknown_kw]
 
     if name is None:
         name = _name_mgr.get(op.name)

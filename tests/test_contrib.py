@@ -1,0 +1,166 @@
+"""contrib package: quantization driver, text, svrg, tensorboard, onnx."""
+import collections
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# quantization driver
+# ---------------------------------------------------------------------------
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+class _Batches(object):
+    """Minimal calib iterable with .data batches."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def __iter__(self):
+        for a in self._arrays:
+            yield type("B", (), {"data": [a]})()
+
+
+def _fit_fp32(sym, X, Y):
+    exe = sym.simple_bind(data=(X.shape[0], X.shape[1]))
+    rng = np.random.RandomState(0)
+    for n, arr in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            arr[:] = mx.nd.array(
+                rng.randn(*arr.shape).astype(np.float32) * 0.2)
+    exe.arg_dict["data"][:] = mx.nd.array(X)
+    return exe
+
+
+def test_quantize_model_numeric_close():
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 10).astype(np.float32)
+    sym = _mlp_sym()
+    exe = _fit_fp32(sym, X, None)
+    fp32_out = exe.forward(is_train=False)[0].asnumpy()
+    arg_params = {n: a.copy() for n, a in exe.arg_dict.items()
+                  if n not in ("data", "softmax_label")}
+
+    calib = _Batches([mx.nd.array(X)])
+    qsym, qarg, qaux = mx.contrib.quantize_model(
+        sym, arg_params, {}, data_names=("data",), calib_mode="naive",
+        calib_data=calib)
+    ops = {n.op for n in
+           __import__("mxnet_tpu.symbol.symbol",
+                      fromlist=["_topo"])._topo(qsym._entries)
+           if not n.is_var}
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_quantize_v2" in ops
+    qexe = qsym.simple_bind(data=(8, 10))
+    for n, v in qarg.items():
+        if n in qexe.arg_dict:
+            qexe.arg_dict[n][:] = v
+    qexe.arg_dict["data"][:] = mx.nd.array(X)
+    int8_out = qexe.forward(is_train=False)[0].asnumpy()
+    # int8 probabilities track fp32 within quantization error
+    assert np.max(np.abs(int8_out - fp32_out)) < 0.06, \
+        np.max(np.abs(int8_out - fp32_out))
+
+
+def test_quantize_model_excluded_layer():
+    sym = _mlp_sym()
+    rng = np.random.RandomState(1)
+    arg_params = {"fc1_weight": mx.nd.array(rng.randn(16, 10) * 0.1),
+                  "fc1_bias": mx.nd.zeros((16,)),
+                  "fc2_weight": mx.nd.array(rng.randn(4, 16) * 0.1),
+                  "fc2_bias": mx.nd.zeros((4,))}
+    qsym, _, _ = mx.contrib.quantize_model(
+        sym, arg_params, {}, excluded_sym_names=("fc1",),
+        calib_mode="none")
+    from mxnet_tpu.symbol.symbol import _topo
+    names = {n.name: n.op for n in _topo(qsym._entries) if not n.is_var}
+    assert names.get("fc1") == "FullyConnected"       # kept fp32
+    assert "fc2_quantized" in names                   # quantized
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+
+def test_text_vocabulary():
+    from mxnet_tpu.contrib import text
+    counter = text.count_tokens_from_str("a b b c c c")
+    vocab = text.Vocabulary(counter, min_freq=2)
+    assert len(vocab) == 3                            # <unk>, c, b
+    assert vocab.to_indices("c") == 1
+    assert vocab.to_indices(["b", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([1, 2]) == ["c", "b"]
+
+
+def test_text_custom_embedding():
+    from mxnet_tpu.contrib import text
+    emb = text.CustomEmbedding(vectors={"hello": [1.0, 2.0],
+                                        "world": [3.0, 4.0]})
+    v = emb.get_vecs_by_tokens(["hello", "nope"])
+    np.testing.assert_allclose(v.asnumpy(), [[1, 2], [0, 0]])
+    emb.update_token_vectors("world", mx.nd.array([[9.0, 9.0]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [9, 9])
+
+
+def test_text_pretrained_gated():
+    from mxnet_tpu.contrib import text
+    with pytest.raises(MXNetError):
+        text.GloVe()
+
+
+# ---------------------------------------------------------------------------
+# svrg
+# ---------------------------------------------------------------------------
+
+def test_svrg_module_converges():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    from mxnet_tpu.io import NDArrayIter
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 8).astype(np.float32)
+    w_true = rng.randn(8, 4).astype(np.float32)
+    Y = np.argmax(X @ w_true, axis=1).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = SVRGModule(sym, update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    em = mod.fit(it, num_epoch=6, lr=0.2)
+    assert em.get_name_value()[0][1] > 0.8, em.get_name_value()
+
+
+# ---------------------------------------------------------------------------
+# tensorboard + onnx gating
+# ---------------------------------------------------------------------------
+
+def test_tensorboard_fallback_jsonl(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu import metric as _metric
+    cb = LogMetricsCallback(str(tmp_path))
+    m = _metric.create("acc")
+    m.update([mx.nd.array([1.0, 0.0])],
+             [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    param = type("P", (), {"eval_metric": m, "nbatch": 3, "epoch": 0})()
+    cb(param)
+    logged = os.path.join(str(tmp_path), "metrics.jsonl")
+    if cb._writer is None:
+        assert os.path.exists(logged)
+        assert "accuracy" in open(logged).read()
+
+
+def test_onnx_gated():
+    with pytest.raises(MXNetError):
+        mx.contrib.onnx.export_model(None, None, None)

@@ -1,0 +1,145 @@
+"""Custom python ops (operator.py) + runtime Pallas compile (rtc.py).
+
+Reference patterns: tests/python/unittest/test_operator.py custom-op
+cases (Sigmoid-style CustomOp with numeric grad check) and rtc usage.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, operator
+
+
+@operator.register("scaled_square")
+class ScaledSquareProp(operator.CustomOpProp):
+    def __init__(self, scale=2.0):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self.scale
+
+        class _Op(operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            in_data[0] * in_data[0] * scale)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            out_grad[0] * 2.0 * scale * in_data[0])
+        return _Op()
+
+
+def test_custom_nd_forward():
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+    np.testing.assert_allclose(y.asnumpy(), [3, 12, 27])
+
+
+def test_custom_nd_backward():
+    x = mx.nd.array(np.array([1.0, 2.0, -1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scaled_square", scale=2.0)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4.0 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_custom_jax_fn_in_jit():
+    fn = operator.make_custom_jax_fn("scaled_square", scale=2.0)
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(fn(x))
+
+    x = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+    assert abs(float(f(x)) - 28.0) < 1e-5
+    g = jax.grad(lambda x: jnp.sum(fn(x)))(x)
+    np.testing.assert_allclose(np.asarray(g), 4.0 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_custom_symbol_graph():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="scaled_square", scale=2.0,
+                      name="sq")
+    exe = y.simple_bind(data=(3,))
+    exe.arg_dict["data"][:] = mx.nd.array(
+        np.array([1.0, 2.0, 3.0], np.float32))
+    out = exe.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [2, 8, 18])
+
+
+def test_rtc_pallas_module():
+    src = """
+def doubler(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+"""
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("doubler")
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    out = k.launch([x])
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2.0)
+
+
+def test_rtc_kernel_cache_and_dtype():
+    def addone(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+    mod = mx.rtc.PallasModule(addone)
+    k = mod.get_kernel("addone")
+    a = mx.nd.array(np.zeros((4, 4), np.float32))
+    r1 = k.launch([a])
+    r2 = k.launch([a])
+    assert len(k._cache) == 1
+    np.testing.assert_allclose(r2.asnumpy(), np.ones((4, 4)))
+
+
+def test_custom_symbol_kwarg_input():
+    # reference form: sym.Custom(data=x, op_type=...) — keyword Symbol
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data=data, op_type="scaled_square", scale=3.0)
+    exe = y.simple_bind(data=(2,))
+    exe.arg_dict["data"][:] = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    out = exe.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [3, 12])
+
+
+@operator.register("sigmoid_outdata")
+class _SigmoidProp(operator.CustomOpProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class _Op(operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0],
+                            mx.nd.array(1.0 / (1.0 + np.exp(-x))))
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                # the canonical pattern: backward READS out_data
+                y = out_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0], mx.nd.array(g * y * (1 - y)))
+        return _Op()
+
+
+def test_custom_backward_reads_out_data():
+    x = mx.nd.array(np.array([0.5, -1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="sigmoid_outdata")
+        y.sum().backward()
+    s = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
