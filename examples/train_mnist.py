@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Train an MLP / LeNet on MNIST (the reference's first CLI milestone).
+
+Reference analog: example/image-classification/train_mnist.py +
+common/fit.py (argparse CLI driving Module.fit with --network,
+--kv-store, --lr...).
+
+MNIST loads from --data-dir (idx files, as the reference's iterator
+reads); without one, a synthetic separable dataset of the same shape is
+generated so the script runs in zero-egress environments.
+
+    python examples/train_mnist.py --network mlp --num-epochs 3
+"""
+import argparse
+import logging
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def load_mnist(data_dir, split):
+    img = os.path.join(data_dir, "%s-images-idx3-ubyte.gz" % split)
+    lbl = os.path.join(data_dir, "%s-labels-idx1-ubyte.gz" % split)
+    with gzip.open(lbl) as f:
+        struct.unpack(">II", f.read(8))
+        label = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img) as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        image = np.frombuffer(f.read(), dtype=np.uint8)
+        image = image.reshape(n, 1, rows, cols).astype(np.float32) / 255.0
+    return image, label.astype(np.float32)
+
+
+def synthetic_mnist(n, seed=0):
+    """Separable 10-class images: class-dependent blob positions."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.3
+    for i, c in enumerate(y):
+        r, col = divmod(int(c), 4)
+        x[i, 0, 4 + r * 6:10 + r * 6, 4 + col * 6:10 + col * 6] += 2.0
+    return x, y.astype(np.float32)
+
+
+def get_symbol(network):
+    data = mx.sym.Variable("data")
+    if network == "mlp":
+        h = mx.sym.Flatten(data)
+        h = mx.sym.FullyConnected(h, num_hidden=128, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    elif network == "lenet":
+        h = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+        h = mx.sym.Activation(h, act_type="tanh")
+        h = mx.sym.Pooling(h, pool_type="max", kernel=(2, 2), stride=(2, 2))
+        h = mx.sym.Convolution(h, kernel=(5, 5), num_filter=50)
+        h = mx.sym.Activation(h, act_type="tanh")
+        h = mx.sym.Pooling(h, pool_type="max", kernel=(2, 2), stride=(2, 2))
+        h = mx.sym.Flatten(h)
+        h = mx.sym.FullyConnected(h, num_hidden=500)
+        h = mx.sym.Activation(h, act_type="tanh")
+        h = mx.sym.FullyConnected(h, num_hidden=10)
+    else:
+        raise ValueError("unknown network %r" % network)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--num-examples", type=int, default=6000)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.data_dir:
+        X, Y = load_mnist(args.data_dir, "train")
+        Xv, Yv = load_mnist(args.data_dir, "t10k")
+    else:
+        X, Y = synthetic_mnist(args.num_examples)
+        Xv, Yv = synthetic_mnist(args.num_examples // 6, seed=1)
+
+    train = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(Xv, Yv, batch_size=args.batch_size,
+                            label_name="softmax_label")
+    mod = mx.module.Module(get_symbol(args.network))
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum},
+            kvstore=args.kv_store, num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    score = mod.score(val, "acc")
+    print("final validation accuracy: %.4f" % dict(score)["accuracy"])
+    if args.model_prefix:
+        mod.save_checkpoint(args.model_prefix, args.num_epochs)
+
+
+if __name__ == "__main__":
+    main()

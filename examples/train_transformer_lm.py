@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Train a small decoder-only LM with composed 5D parallelism.
+
+Showcases the TPU-first capabilities the reference never had
+(SURVEY.md §2.3 additions): ring-attention sequence parallelism,
+GPipe pipeline stages, Megatron-style tensor parallelism, and optional
+MoE expert parallelism — all in ONE compiled SPMD train step
+(mxnet_tpu/parallel/transformer.py).
+
+Smoke run on a virtual mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_transformer_lm.py --mesh 2,2,2,1,1
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh", default="2,2,2,1,1",
+                    help="dp,sp,tp,pp,ep sizes")
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=256)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-experts", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.transformer import (
+        TransformerConfig, init_transformer_params,
+        make_transformer_train_step)
+
+    shape = tuple(int(x) for x in args.mesh.split(","))
+    mesh = make_mesh(shape, axis_names=("dp", "sp", "tp", "pp", "ep"))
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
+        num_experts=args.num_experts)
+    params, _ = init_transformer_params(cfg, mesh, seed=0)
+    step = make_transformer_train_step(cfg, mesh, lr=args.lr)
+
+    # task: predict the next token of a repeating-ngram stream
+    rng = np.random.RandomState(0)
+    base = rng.randint(0, args.vocab, args.seq_len + 1)
+
+    def batch():
+        rolls = rng.randint(0, args.seq_len, args.batch_size)
+        seqs = np.stack([np.roll(base, -r) for r in rolls])
+        return (seqs[:, :-1].astype(np.int32),
+                seqs[:, 1:].astype(np.int32))
+
+    t0 = time.time()
+    for i in range(args.steps):
+        tok, tgt = batch()
+        params, loss = step(params, tok, tgt)
+        if i in (0, args.steps - 1) or i % 10 == 0:
+            print("step %4d  loss %.4f  (%.1fs)"
+                  % (i, float(loss), time.time() - t0))
+    print("mesh=%s final loss %.4f" % (dict(mesh.shape), float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,13 +9,18 @@ Usage mirrors the reference's ``import mxnet as mx``::
 """
 import os as _os
 
-if _os.environ.get("MXNET_TPU_PLATFORM"):
-    # Force the JAX platform before any backend initializes (part of the
-    # MXNET_* env-var config tier, reference: docs/faq/env_var.md). The
-    # env var JAX_PLATFORMS alone is not reliable when a site hook has
-    # already imported jax; the config update is.
+_platform = (_os.environ.get("MXNET_TPU_PLATFORM")
+             or _os.environ.get("JAX_PLATFORMS"))
+if _platform:
+    # Force the JAX platform (part of the MXNET_* env-var config tier,
+    # reference: docs/faq/env_var.md). The env var JAX_PLATFORMS alone is
+    # not reliable when a site hook has already imported jax (the config
+    # freezes at that import); syncing it into the live config covers the
+    # imported-but-uninitialized case. If the hook also *initialized* a
+    # backend, that backend stays live — call
+    # jax.extend.backend.clear_backends() yourself to drop it.
     import jax as _jax
-    _jax.config.update("jax_platforms", _os.environ["MXNET_TPU_PLATFORM"])
+    _jax.config.update("jax_platforms", _platform)
 
 from . import base
 from .base import MXNetError

@@ -1,0 +1,78 @@
+"""Runtime configuration: the MXNET_* environment-variable tier.
+
+Reference: the reference reads ~46 documented env vars via dmlc::GetEnv
+at point of use (docs/faq/env_var.md) on top of per-object dmlc
+Parameter structs. Here the same tier is a typed registry: every knob
+the framework consults is declared once with type/default/doc, read
+through :func:`get`, and enumerable for docs (``python -m
+mxnet_tpu.config`` prints the table).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get", "describe", "VARS"]
+
+# name -> (type, default, doc)
+VARS = {
+    "MXNET_TPU_PLATFORM": (str, "", "Force the JAX platform (cpu/tpu) "
+                           "before backend init — the reliable override "
+                           "when a site hook already imported jax."),
+    "MXNET_ENGINE_TYPE": (str, "ThreadedEnginePerDevice",
+                          "NaiveEngine = serialize after every op "
+                          "(degrade-to-serial debug mode, reference: "
+                          "docs/faq/env_var.md:77)."),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": (int, 15,
+                                            "Engine bulking knob (API "
+                                            "parity; XLA fusion subsumes "
+                                            "it)."),
+    "MXNET_TPU_PS_URI": (str, "", "Parameter-server host for dist_* "
+                         "KVStore types (DCN tier)."),
+    "MXNET_TPU_PS_PORT": (int, 9090, "Parameter-server port."),
+    "MXNET_TPU_PS_BIND": (str, "127.0.0.1", "Server bind address; "
+                          "non-loopback requires MXNET_TPU_PS_TOKEN."),
+    "MXNET_TPU_PS_TOKEN": (str, "", "Shared auth token for the PS wire "
+                           "protocol."),
+    "MXNET_TPU_PS_MODE": (str, "sync", "sync = aggregate-then-update "
+                          "BSP; async = per-push updates."),
+    "MXNET_TPU_NUM_WORKERS": (int, 1, "World size in PS mode."),
+    "MXNET_TPU_RANK": (int, 0, "This worker's rank in PS mode."),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000,
+                                     "Arrays above this size may be "
+                                     "sharded across servers "
+                                     "(reference: env_var.md:102)."),
+    "MXNET_ENFORCE_DETERMINISM": (bool, False,
+                                  "Prefer deterministic reductions "
+                                  "(maps to XLA deterministic flags)."),
+    "MXNET_PROFILER_AUTOSTART": (bool, False,
+                                 "Start the profiler at import."),
+}
+
+
+def get(name, default=None):
+    """Read a declared config var with its registered type/default."""
+    if name in VARS:
+        typ, reg_default, _ = VARS[name]
+        raw = os.environ.get(name)
+        if raw is None:
+            return reg_default if default is None else default
+        if typ is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return typ(raw)
+    return os.environ.get(name, default)
+
+
+def describe():
+    """Human-readable table of every config variable."""
+    lines = []
+    for name in sorted(VARS):
+        typ, default, doc = VARS[name]
+        cur = os.environ.get(name)
+        lines.append("%-40s %-6s default=%-24r %s%s" %
+                     (name, typ.__name__, default,
+                      ("[set: %r] " % cur) if cur is not None else "", doc))
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(describe())

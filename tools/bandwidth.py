@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Collective-bandwidth microbenchmark over a device mesh.
+
+Reference analog: tools/bandwidth/measure.py (KVStore push/pull
+bandwidth across GPUs/machines). On TPU the communication substrate is
+XLA collectives over ICI, so this measures what actually bounds
+data-parallel training: psum (allreduce) / all_gather / ppermute
+bandwidth per device as a function of payload size.
+
+Usage (virtual CPU mesh for a smoke run):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bandwidth.py --sizes 1,8,64 --collective psum
+"""
+import argparse
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="1,4,16,64",
+                    help="payload sizes in MB, comma separated")
+    ap.add_argument("--collective", default="psum",
+                    choices=["psum", "all_gather", "ppermute"])
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--axis", default="x")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), (args.axis,))
+    print("devices: %d x %s" % (n, devs[0].device_kind))
+
+    def body(x):
+        if args.collective == "psum":
+            return jax.lax.psum(x, args.axis)
+        if args.collective == "all_gather":
+            return jax.lax.all_gather(x, args.axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.lax.ppermute(x, args.axis, perm)
+
+    for mb in [float(s) for s in args.sizes.split(",")]:
+        elems = int(mb * 1e6 / 4)
+        per_dev = max(1, elems // n)
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(args.axis),
+                               out_specs=P() if args.collective !=
+                               "ppermute" else P(args.axis),
+                               check_rep=False))
+        x = jnp.ones((per_dev * n,), jnp.float32)
+        fn(x).block_until_ready()            # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / args.iters
+        payload = per_dev * 4
+        # allreduce moves ~2*(n-1)/n of the payload per device
+        algo_bytes = payload * (2 * (n - 1) / n
+                                if args.collective == "psum" else
+                                (n - 1) / n if args.collective ==
+                                "all_gather" else 1.0)
+        print("%-12s %8.2f MB/dev  %8.3f ms  %8.2f GB/s/dev (algo)"
+              % (args.collective, payload / 1e6, dt * 1e3,
+                 algo_bytes / dt / 1e9))
+
+
+if __name__ == "__main__":
+    main()

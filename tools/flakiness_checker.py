@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Run a test many times to measure flakiness.
+
+Reference analog: tools/flakiness_checker.py (repeated nosetests runs
+with per-trial seeds). Here: repeated pytest invocations with
+MXNET_TEST_SEED rotated per trial.
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_dot -n 20
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="pytest node id")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fixed seed for every trial (default: rotate)")
+    args = ap.parse_args()
+
+    failures = 0
+    for trial in range(args.trials):
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(args.seed if args.seed is not None
+                                     else trial)
+        r = subprocess.run([sys.executable, "-m", "pytest", "-x", "-q",
+                            args.test], env=env, capture_output=True,
+                           text=True)
+        ok = r.returncode == 0
+        failures += (not ok)
+        print("trial %3d seed=%s %s" % (trial, env["MXNET_TEST_SEED"],
+                                        "PASS" if ok else "FAIL"))
+        if not ok:
+            sys.stdout.write(r.stdout[-2000:])
+    print("flakiness: %d/%d failed" % (failures, args.trials))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch tables.
+
+Reference analog: tools/parse_log.py (extracts accuracy/throughput from
+`Epoch[k] ...` log lines emitted by Module.fit / Speedometer).
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+EPOCH_RE = re.compile(
+    r"Epoch\[(\d+)\].*?(Validation-)?([\w-]+)=([0-9.eE+-]+)")
+SPEED_RE = re.compile(
+    r"Epoch\[(\d+)\].*?Speed[:=]\s*([0-9.]+)\s*(samples|img)/sec")
+TIME_RE = re.compile(r"Epoch\[(\d+)\].*?Time cost=([0-9.]+)")
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        for m in EPOCH_RE.finditer(line):
+            epoch = int(m.group(1))
+            key = ("val-" if m.group(2) else "train-") + m.group(3)
+            rows.setdefault(epoch, {})[key] = float(m.group(4))
+        m = SPEED_RE.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["speed"] = float(m.group(2))
+        m = TIME_RE.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "csv"])
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no epoch records found", file=sys.stderr)
+        return
+    cols = sorted({k for r in rows.values() for k in r})
+    sep = "," if args.format == "csv" else " | "
+    print(sep.join(["epoch"] + cols))
+    if args.format == "markdown":
+        print(sep.join(["---"] * (len(cols) + 1)))
+    for epoch in sorted(rows):
+        print(sep.join([str(epoch)] +
+                       ["%g" % rows[epoch].get(c, float("nan"))
+                        for c in cols]))
+
+
+if __name__ == "__main__":
+    main()
