@@ -47,6 +47,10 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
 
 def __getattr__(name):
     import importlib
+    if name == "AttrScope":
+        from .symbol import AttrScope
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
     if name in ("sym", "symbol"):
         mod = importlib.import_module(".symbol", __name__)
         globals()["symbol"] = mod

@@ -1,0 +1,301 @@
+"""Manual model parallelism: group2ctx device placement.
+
+Reference: bind-time ``group2ctx`` maps symbol ``ctx_group`` attributes
+to devices (src/executor/graph_executor.cc:1578-1620,
+python/mxnet/executor.py:56-84), with cross-device copies auto-inserted
+(src/operator/cross_device_copy.cc); docs/faq/model_parallel_lstm.md.
+
+TPU-native design: the graph splits into maximal contiguous topo
+segments sharing a device; each segment compiles to its OWN XLA program
+pinned to that device (jit follows committed inputs), and the
+boundaries are ``jax.device_put`` transfers — PjRt issues them
+device-to-device over ICI, overlapping with compute exactly like the
+reference's cross-device copy ops ride the engine. Backward replays
+the segment chain in reverse through per-segment ``jax.vjp``.
+
+Usage (reference-compatible)::
+
+    a = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(a, num_hidden=64, name="fc1",
+                              attr={"ctx_group": "dev1"})
+    out = mx.sym.FullyConnected(h, num_hidden=8, name="fc2",
+                                attr={"ctx_group": "dev2"})
+    exe = out.bind(mx.cpu(), args,
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["GroupExecutor"]
+
+
+def _ek(node, oi):
+    """Entry key, stringified: pytree dict keys must be sortable (mixed
+    tuple/str keys are not)."""
+    return "e|%d|%d" % (id(node), oi)
+
+
+class _Segment(object):
+    __slots__ = ("nodes", "ctx", "fn", "in_entries", "out_entries")
+
+    def __init__(self, ctx):
+        self.nodes = []
+        self.ctx = ctx
+
+
+class GroupExecutor(object):
+    """Executor placing ctx_group-annotated ops on different devices.
+
+    API-compatible subset of Executor: arg_dict / aux_dict / grad_dict,
+    forward / backward / outputs.
+    """
+
+    def __init__(self, symbol, default_ctx, args, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None):
+        from .symbol.symbol import _topo
+        from .ndarray.ndarray import NDArray
+        self._symbol = symbol
+        self._default_ctx = default_ctx or current_context()
+        self._group2ctx = dict(group2ctx or {})
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, dict):
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind missing arguments: %s" % missing)
+            self.arg_arrays = [args[n] for n in arg_names]
+        else:
+            self.arg_arrays = list(args)
+        self.arg_dict = dict(zip(arg_names, self.arg_arrays))
+        aux_states = aux_states or []
+        if isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            self.aux_arrays = list(aux_states)
+        self.aux_dict = dict(zip(aux_names, self.aux_arrays))
+        # per-arg grad requests (string | list | dict, like Executor)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in arg_names}
+        self._any_grad = any(r != "null" for r in self._grad_req.values())
+        self.grad_dict = {}
+        from .ndarray.ndarray import zeros
+        if isinstance(args_grad, dict):
+            self.grad_dict.update(args_grad)
+        elif isinstance(args_grad, (list, tuple)):
+            self.grad_dict.update(zip(arg_names, args_grad))
+        for n, a in self.arg_dict.items():
+            if self._grad_req.get(n, "null") != "null":
+                self.grad_dict.setdefault(n, zeros(a.shape))
+        self.outputs = []
+        self._plan(_topo(symbol._entries))
+        self._vjps = None
+        self._fwd_cache = {}      # (seg idx, is_train) -> jitted seg fn
+        self._seg_inputs = None
+
+    # -- planning ----------------------------------------------------------
+    def _node_ctx(self, node):
+        grp = (node.attrs or {}).get("__ctx_group__") if not node.is_var \
+            else None
+        if grp is None:
+            return self._default_ctx
+        if grp not in self._group2ctx:
+            return self._default_ctx
+        return self._group2ctx[grp]
+
+    def _plan(self, nodes):
+        """Split op nodes into contiguous same-device segments."""
+        self._segments = []
+        cur = None
+        for node in nodes:
+            if node.is_var:
+                continue
+            ctx = self._node_ctx(node)
+            if cur is None or cur.ctx != ctx:
+                cur = _Segment(ctx)
+                self._segments.append(cur)
+            cur.nodes.append(node)
+        self._nodes = [n for n in nodes if not n.is_var]
+
+    # -- evaluation --------------------------------------------------------
+    def _eval_node(self, node, env, key, is_train, aux_new):
+        from .ops import registry as _reg
+        from .symbol.symbol import AUX_STATES, _aux_input_positions
+        op = _reg.get_op(node.op)
+        attrs = {k: v for k, v in (node.attrs or {}).items()
+                 if not k.startswith("__")}
+        if "train_mode" in op.attr_defaults and "train_mode" not in attrs:
+            attrs["train_mode"] = is_train
+        ins = []
+        for (src, oi) in node.inputs:
+            if src.is_var:
+                ins.append(env[src.name])
+            else:
+                ins.append(env[_ek(src, oi)])
+        if op.needs_rng:
+            ins = [key] + ins
+        if (node.op in AUX_STATES and is_train
+                and not attrs.get("use_global_stats", False)):
+            # functional moving-stat update (mirrors _graph_eval_fn)
+            attrs["output_mean_var"] = True
+            out, mean, var = op.fn(*ins, **attrs)
+            mom = attrs.get("momentum", 0.9)
+            mm, mv = [node.inputs[i][0]
+                      for i in _aux_input_positions(op, node)]
+            aux_new[mm.name] = mom * env[mm.name] + (1 - mom) * mean
+            aux_new[mv.name] = mom * env[mv.name] + (1 - mom) * var
+            outs = (out,)
+            if node.attrs.get("output_mean_var", False):
+                outs = (out, mean, var)
+        else:
+            outs = op.fn(*ins, **attrs)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for i, o in enumerate(outs):
+            env[_ek(node, i)] = o
+
+    def forward(self, is_train=False, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        from .ndarray.ndarray import NDArray
+        from . import random as _random
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v))
+
+        env = {n: a._data for n, a in self.arg_dict.items()}
+        env.update({n: a._data for n, a in self.aux_dict.items()})
+        key = _random.next_key()
+        self._vjps = []
+
+        self._seg_inputs = []
+        for si, seg in enumerate(self._segments):
+            dev = seg.ctx.jax_device()
+            # inputs crossing onto this segment's device: one transfer
+            # each (the cross_device_copy analog), then computation
+            # follows the committed data.
+            seg_ids = {id(n) for n in seg.nodes}
+            needed = set()
+            for node in seg.nodes:
+                for (src, oi) in node.inputs:
+                    if src.is_var:
+                        needed.add(src.name)
+                    elif id(src) not in seg_ids:   # produced upstream
+                        needed.add(_ek(src, oi))
+            seg_in = {k: jax.device_put(env[k], dev) for k in needed}
+
+            fwd = self._fwd_cache.get((si, is_train))
+            if fwd is None:
+                def seg_fn(seg_env, seg_key, seg=seg, is_train=is_train):
+                    local = dict(seg_env)
+                    aux_new = {}
+                    for node in seg.nodes:
+                        self._eval_node(node, local, seg_key, is_train,
+                                        aux_new)
+                    outs = {_ek(n, i): local[_ek(n, i)]
+                            for n in seg.nodes
+                            for i in range(_n_out(n))
+                            if _ek(n, i) in local}
+                    return outs, aux_new
+                # each segment is ONE compiled XLA program pinned to its
+                # device (jit follows the committed inputs); the jit
+                # cache persists across steps.
+                fwd = jax.jit(seg_fn)
+                self._fwd_cache[(si, is_train)] = fwd
+
+            if is_train and self._any_grad:
+                (outs, aux_new), vjp = jax.vjp(
+                    lambda e: fwd(e, key), seg_in)
+                out_specs = {k: (v.shape, v.dtype) for k, v in outs.items()}
+                aux_specs = {k: (v.shape, v.dtype)
+                             for k, v in aux_new.items()}
+                self._vjps.append((seg, out_specs, aux_specs, vjp))
+            else:
+                outs, aux_new = fwd(seg_in, key)
+            env.update(outs)
+            for an, av in aux_new.items():
+                if an in self.aux_dict:
+                    self.aux_dict[an]._set_data(
+                        jax.lax.stop_gradient(av))
+                    env[an] = self.aux_dict[an]._data
+
+        self.outputs = [NDArray(env[_ek(n, oi)])
+                        for (n, oi) in self._symbol._entries]
+        self._env_keys = None
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        import jax.numpy as jnp
+        from .ndarray.ndarray import NDArray
+        if not self._vjps:
+            raise MXNetError("forward(is_train=True) before backward")
+        if out_grads is None:
+            cts = {_ek(n, oi): jnp.ones(o.shape, o.dtype)
+                   for (n, oi), o in zip(self._symbol._entries,
+                                         self.outputs)}
+        else:
+            og = out_grads if isinstance(out_grads, (list, tuple)) \
+                else [out_grads]
+            cts = {_ek(n, oi): (g._data if isinstance(g, NDArray) else g)
+                   for (n, oi), g in zip(self._symbol._entries, og)}
+
+        import jax
+        acc = dict(cts)      # entry-key / arg-name -> cotangent
+        for seg, out_specs, aux_specs, vjp in reversed(self._vjps):
+            dev = seg.ctx.jax_device()
+            # cotangents for this segment's outputs: what downstream
+            # accumulated (transferred back onto this segment's device —
+            # the reverse cross-device copy), zeros for unconsumed ones
+            full = {}
+            hit = False
+            for k, (shape, dtype) in out_specs.items():
+                if k in acc:
+                    full[k] = jax.device_put(
+                        jnp.asarray(acc.pop(k), dtype), dev)
+                    hit = True
+                else:
+                    full[k] = jax.device_put(jnp.zeros(shape, dtype), dev)
+            if not hit:
+                continue
+            # moving-stat updates carry no cotangent (stop_gradient
+            # semantics, like the reference's aux states)
+            aux_ct = {k: jax.device_put(jnp.zeros(shape, dtype), dev)
+                      for k, (shape, dtype) in aux_specs.items()}
+            (in_ct,) = vjp((full, aux_ct))
+            for k, g in in_ct.items():
+                if k in acc:
+                    # contributions from different downstream segments may
+                    # live on different devices: bring to the first's
+                    prev = acc[k]
+                    dev0 = next(iter(prev.devices())) \
+                        if hasattr(prev, "devices") else None
+                    if dev0 is not None:
+                        g = jax.device_put(g, dev0)
+                    acc[k] = prev + g
+                else:
+                    acc[k] = g
+        for name, g in acc.items():
+            if name.startswith("e|") or name not in self.grad_dict:
+                continue
+            req = self._grad_req.get(name, "write")
+            if req == "null":
+                continue
+            if req == "add":
+                self.grad_dict[name]._set_data(
+                    self.grad_dict[name]._data + g)
+            else:
+                self.grad_dict[name]._set_data(jnp.asarray(g))
+
+
+def _n_out(node):
+    from .symbol.symbol import _n_outputs
+    return _n_outputs(node)

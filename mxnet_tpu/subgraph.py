@@ -1,0 +1,222 @@
+"""Subgraph framework: property-based graph partitioning.
+
+Reference: src/operator/subgraph/ (partition_graph.cc:774 partitions an
+nnvm graph by a SubgraphProperty's selection; subgraph_property.h
+registry; default_subgraph_property.cc executes matched subgraphs via
+CachedOp).
+
+TPU-native design: a partitioned region becomes ONE ``_subgraph`` op
+node whose attr carries the serialized sub-symbol; the op executes the
+sub-symbol through the registry's jit cache, so each matched region
+compiles to a single fused XLA program — the partition is exactly the
+compilation-unit boundary (the reference's accelerator-handoff use case
+maps to "compile this region as one unit").
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["SubgraphProperty", "register_subgraph_property",
+           "partition_graph", "get_subgraph_property"]
+
+_PROPERTIES = {}
+
+
+class SubgraphProperty(object):
+    """Node-selection policy (reference: subgraph_property.h).
+
+    Subclass and override :meth:`match`; optionally :meth:`min_size`."""
+
+    name = "default"
+
+    def match(self, node):
+        """True if the op node may join a subgraph. Ops with auxiliary
+        states (BatchNorm moving stats) never join: the fused region
+        cannot thread functional aux updates back to the executor."""
+        from .symbol.symbol import AUX_STATES
+        return node.op not in AUX_STATES
+
+    def min_size(self):
+        """Smallest region worth fusing."""
+        return 2
+
+
+def register_subgraph_property(prop):
+    """Register a property instance or class (reference:
+    MXNET_REGISTER_SUBGRAPH_PROPERTY)."""
+    inst = prop() if isinstance(prop, type) else prop
+    _PROPERTIES[inst.name] = inst
+    return prop
+
+
+def get_subgraph_property(name):
+    try:
+        return _PROPERTIES[name]
+    except KeyError:
+        raise MXNetError("subgraph property %r is not registered"
+                         % name) from None
+
+
+register_subgraph_property(SubgraphProperty)
+
+
+# ---------------------------------------------------------------------------
+# the _subgraph executor op
+# ---------------------------------------------------------------------------
+
+def _subgraph_fn(key, *arrays, graph_json=None, in_names=(), n_out=1,
+                 train_mode=False, **_ig):
+    """Evaluate a serialized sub-symbol on the given inputs. Jitted by
+    the registry keyed on (graph_json, in_names) — one compiled program
+    per matched region (the CachedOp analog, cached_op.cc:835).
+    train_mode threads through like any stateful op; the leading rng key
+    serves any samplers inside the region."""
+    from .symbol.symbol import load_json, _graph_eval_fn
+    sub = load_json(graph_json)
+    fn = _graph_eval_fn(sub, is_train=bool(train_mode))
+    env = dict(zip(in_names, arrays))
+    outs, _aux = fn(env, key)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _register_subgraph_op():
+    from .ops.registry import register, get_op, MXNetError as _E
+    try:
+        get_op("_subgraph")
+    except Exception:
+        register("_subgraph", needs_rng=True,
+                 num_outputs=lambda attrs: int(attrs.get("n_out", 1)),
+                 attr_defaults={"graph_json": None, "in_names": (),
+                                "n_out": 1, "train_mode": False})(
+                     _subgraph_fn)
+
+
+_register_subgraph_op()
+
+
+# ---------------------------------------------------------------------------
+# partitioning pass
+# ---------------------------------------------------------------------------
+
+def partition_graph(symbol, prop="default", excluded_names=()):
+    """Collapse maximal contiguous runs of property-matched nodes into
+    ``_subgraph`` nodes (reference: partition_graph.cc BuildSubgraph).
+
+    Returns a new Symbol computing the same outputs.
+    """
+    from .symbol import symbol as _S
+    if isinstance(prop, str):
+        prop = get_subgraph_property(prop)
+    excluded = set(excluded_names)
+
+    from .symbol.symbol import AUX_STATES
+
+    nodes = _S._topo(symbol._entries)
+    # head entries must stay addressable: map old entry -> new entry
+    runs = []
+    cur = []
+    # outputs of the whole symbol (cannot be internal to a region unless
+    # they are the region's outputs — handled below via out mapping)
+    for node in nodes:
+        if node.is_var:
+            continue            # params/inputs never break a run
+        if (prop.match(node) and node.name not in excluded
+                and node.op not in AUX_STATES):
+            cur.append(node)
+        else:
+            if len(cur) >= prop.min_size():
+                runs.append(list(cur))
+            cur = []
+    if len(cur) >= prop.min_size():
+        runs.append(cur)
+
+    in_region = {}
+    for ri, run in enumerate(runs):
+        for n in run:
+            in_region[id(n)] = ri
+
+    new_of = {}          # id(old node) -> {out_idx: (new node, new idx)}
+
+    def sub_entry(src, oi):
+        if src.is_var:
+            if id(src) not in new_of:
+                new_of[id(src)] = {0: (src, 0)}
+            return new_of[id(src)][0]
+        return new_of[id(src)][oi]
+
+    emitted = set()
+    for node in nodes:
+        if node.is_var:
+            continue
+        ri = in_region.get(id(node))
+        if ri is None:
+            # ordinary node: rebuild with remapped inputs
+            new_inputs = [sub_entry(s, oi) for (s, oi) in node.inputs]
+            nn = _S._Node(node.op, node.name, dict(node.attrs),
+                          new_inputs, in_names=node.in_names)
+            new_of[id(node)] = {i: (nn, i)
+                                for i in range(_S._n_outputs(node))}
+            continue
+        if ri in emitted:
+            continue
+        emitted.add(ri)
+        run = runs[ri]
+        run_ids = {id(n) for n in run}
+        # region inputs: entries produced outside, in first-use order
+        ext_in = []
+        seen = set()
+        for n in run:
+            for (s, oi) in n.inputs:
+                k = (id(s), oi)
+                if (s.is_var or id(s) not in run_ids) and k not in seen:
+                    seen.add(k)
+                    ext_in.append((s, oi))
+        # region outputs: entries consumed outside the region (or heads)
+        head_set = {(id(n), oi) for (n, oi) in symbol._entries}
+        consumers = {}
+        for m in nodes:
+            if m.is_var or id(m) in run_ids:
+                continue
+            for (s, oi) in m.inputs:
+                consumers.setdefault((id(s), oi), True)
+        reg_out = []
+        for n in run:
+            for i in range(_S._n_outputs(n)):
+                k = (id(n), i)
+                if k in consumers or k in head_set:
+                    reg_out.append((n, i))
+        # build the sub-symbol: region nodes with external inputs turned
+        # into fresh variables named in0, in1, ...
+        var_of = {}
+        for j, (s, oi) in enumerate(ext_in):
+            var_of[(id(s), oi)] = _S._Node(None, "in%d" % j)
+        sub_map = {}
+
+        def sub_in(s, oi):
+            k = (id(s), oi)
+            if k in var_of:
+                return (var_of[k], 0)
+            return sub_map[id(s)][oi]
+
+        for n in run:
+            ni = [sub_in(s, oi) for (s, oi) in n.inputs]
+            nn = _S._Node(n.op, n.name, dict(n.attrs), ni,
+                          in_names=n.in_names)
+            sub_map[id(n)] = {i: (nn, i)
+                              for i in range(_S._n_outputs(n))}
+        sub_sym = _S.Symbol([sub_map[id(n)][i] for (n, i) in reg_out])
+        gjson = sub_sym.tojson()
+        sg_node = _S._Node(
+            "_subgraph", "subgraph%d" % ri,
+            {"graph_json": gjson,
+             "in_names": tuple("in%d" % j for j in range(len(ext_in))),
+             "n_out": len(reg_out)},
+            [sub_entry(s, oi) for (s, oi) in ext_in],
+            in_names=["in%d" % j for j in range(len(ext_in))])
+        for k, (n, i) in enumerate(reg_out):
+            new_of.setdefault(id(n), {})[i] = (sg_node, k)
+
+    entries = [new_of[id(n)][oi] for (n, oi) in symbol._entries]
+    return _S.Symbol(entries)

@@ -1,7 +1,7 @@
 """Symbol package: graph construction + generated op namespace
 (reference: python/mxnet/symbol/__init__.py)."""
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
-                     AUX_STATES)
+                     AUX_STATES, AttrScope)
 from . import _internal
 
 from . import register as _register

@@ -50,6 +50,38 @@ class _NameManager(threading.local):
 _name_mgr = _NameManager()
 
 
+class AttrScope(object):
+    """Scope applying attributes to every symbol created inside
+    (reference: python/mxnet/attribute.py AttrScope; the model-parallel
+    docs' `with mx.AttrScope(ctx_group='dev1'):` pattern). The stack is
+    thread-local, like _NameManager."""
+
+    _tls = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    @classmethod
+    def _stack(cls):
+        if not hasattr(cls._tls, "stack"):
+            cls._tls.stack = []
+        return cls._tls.stack
+
+    def __enter__(self):
+        AttrScope._stack().append(self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._stack().pop()
+
+    @staticmethod
+    def _current_attrs():
+        merged = {}
+        for frame in AttrScope._stack():
+            merged.update(frame)
+        return merged
+
+
 def _input_names(op):
     """Array-input parameter names of an op, derived from its pure-function
     signature (attrs are whatever appears in ``attr_defaults``)."""
@@ -372,6 +404,12 @@ class Symbol(object):
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
+        if group2ctx:
+            # manual model parallelism: ctx_group attrs -> devices
+            # (reference: graph_executor.cc:1578-1620 group2ctx)
+            from ..model_parallel import GroupExecutor
+            return GroupExecutor(self, ctx, args, args_grad, grad_req,
+                                 aux_states, group2ctx=group2ctx)
         from ..executor import Executor
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
 
@@ -493,7 +531,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     """Create a symbolic variable (reference: symbol.py var)."""
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
-    attrs = dict(attr or {})
+    attrs = {}
+    for k, v in {**AttrScope._current_attrs(), **dict(attr or {})}.items():
+        # same annotation convention as _apply_op: dunder-prefixed
+        attrs["__%s__" % k] = v
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if lr_mult is not None:
@@ -560,11 +601,19 @@ def _apply_op(op, args, attrs, name):
     pos = 0
     kw_syms = dict(attrs)
     attrs = {}
+    annotations = dict(AttrScope._current_attrs())
     for k, v in kw_syms.items():
         if isinstance(v, Symbol):
             inputs[k] = v
+        elif k == "attr" and isinstance(v, dict):
+            # annotation attrs (ctx_group, lr_mult, ...) — reference
+            # symbol attr dicts; stored dunder-prefixed so graph eval
+            # can strip them from op kwargs
+            annotations.update(v)
         else:
             attrs[k] = v
+    for k, v in annotations.items():
+        attrs.setdefault("__%s__" % k, v)
 
     def _variadic():
         # computed lazily: only the overflow/unknown-kw branches need it,
@@ -654,7 +703,8 @@ def _graph_eval_fn(symbol, is_train):
                 values[(id(node), 0)] = env[node.name]
                 continue
             op = _reg.get_op(node.op)
-            attrs = dict(node.attrs)
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
             if "train_mode" in op.attr_defaults and "train_mode" not in attrs:
                 attrs["train_mode"] = is_train
             arrs = [values[(id(src), oi)] for (src, oi) in node.inputs]
@@ -743,7 +793,8 @@ def _deduce_shapes(symbol, known, partial=False):
                     progress = True
                 continue
             op = _reg.get_op(node.op)
-            attrs = dict(node.attrs)
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
             if "train_mode" in op.attr_defaults:
                 attrs["train_mode"] = False
             args = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]

@@ -1,0 +1,204 @@
+"""group2ctx model parallelism, subgraph partitioning, dtype sweeps.
+
+Reference patterns: tests/python/unittest/test_model_parallel.py and
+test_multi_device_exec.py (multiple mx.cpu(i) fake contexts exercising
+the multi-context paths), test_subgraph_op.py, and the GPU suite's
+check_consistency dtype matrix (test_utils.py:1207).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils
+from mxnet_tpu.subgraph import (partition_graph, SubgraphProperty,
+                                register_subgraph_property)
+from mxnet_tpu.symbol.symbol import _topo
+
+
+def _mlp_args(rng):
+    return {"data": mx.nd.array(rng.randn(4, 8).astype(np.float32)),
+            "fc1_weight": mx.nd.array(
+                rng.randn(16, 8).astype(np.float32) * 0.2),
+            "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.array(
+                rng.randn(4, 16).astype(np.float32) * 0.2),
+            "fc2_bias": mx.nd.zeros((4,))}
+
+
+def _grouped_net():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return out
+
+
+def test_group2ctx_forward_backward_matches_single_device():
+    rng = np.random.RandomState(0)
+    out = _grouped_net()
+    args = _mlp_args(rng)
+    exe = out.bind(mx.cpu(0), dict(args),
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    assert len(exe._segments) == 2
+    assert {s.ctx.device_id for s in exe._segments} == {1, 2}
+    o = exe.forward(is_train=True)[0]
+    ref_exe = out.bind(mx.cpu(0), dict(args))
+    ref = ref_exe.forward(is_train=True)[0]
+    np.testing.assert_allclose(o.asnumpy(), ref.asnumpy(), rtol=1e-6)
+    exe.backward()
+    ref_exe.backward()
+    for n in ("fc1_weight", "fc2_weight", "fc1_bias", "data"):
+        np.testing.assert_allclose(exe.grad_dict[n].asnumpy(),
+                                   ref_exe.grad_dict[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_group2ctx_outputs_on_last_group_device():
+    rng = np.random.RandomState(1)
+    out = _grouped_net()
+    exe = out.bind(mx.cpu(0), _mlp_args(rng),
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    o = exe.forward()[0]
+    devs = {d.id for d in o._data.devices()}
+    assert devs == {2}
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(ctx_group="a", lr_mult=2):
+        with mx.AttrScope(ctx_group="b"):
+            s = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=2,
+                                      name="f")
+    node = s._entries[0][0]
+    assert node.attrs["__ctx_group__"] == "b"
+    assert node.attrs["__lr_mult__"] == 2
+
+
+# ---------------------------------------------------------------------------
+# subgraph
+# ---------------------------------------------------------------------------
+
+class _FCActProp(SubgraphProperty):
+    name = "test_fc_act"
+
+    def match(self, node):
+        return node.op in ("FullyConnected", "Activation")
+
+
+register_subgraph_property(_FCActProp)
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="r1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.softmax(h, name="prob")
+
+
+def test_partition_collapses_matched_region():
+    psym = partition_graph(_net(), "test_fc_act")
+    ops = [n.op for n in _topo(psym._entries) if not n.is_var]
+    assert ops == ["_subgraph", "softmax"], ops
+
+
+def test_partitioned_graph_same_outputs():
+    rng = np.random.RandomState(2)
+    sym = _net()
+    psym = partition_graph(sym, "test_fc_act")
+    args = _mlp_args(rng)
+    r1 = sym.bind(mx.cpu(), dict(args)).forward()[0]
+    r2 = psym.bind(mx.cpu(), dict(args)).forward()[0]
+    np.testing.assert_allclose(r1.asnumpy(), r2.asnumpy(), rtol=1e-6)
+
+
+def test_partition_respects_exclusion():
+    psym = partition_graph(_net(), "test_fc_act",
+                           excluded_names=("r1",))
+    ops = [n.op for n in _topo(psym._entries) if not n.is_var]
+    # r1 breaks the region; fc1 alone is below min_size, fc2 alone too
+    assert "_subgraph" not in ops
+
+
+# ---------------------------------------------------------------------------
+# dtype sweeps (fp16/bf16) — the reference's GPU-suite consistency matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opname", ["dot", "FullyConnected_like",
+                                    "softmax", "exp", "sum"])
+def test_dtype_consistency(opname):
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 16) * 0.5
+    b = rng.randn(16, 8) * 0.5
+
+    fns = {
+        "dot": (lambda x, y: mx.nd.dot(x, y), [a, b]),
+        "FullyConnected_like": (
+            lambda x, w: mx.nd.FullyConnected(x, w, num_hidden=8,
+                                              no_bias=True),
+            [a, rng.randn(8, 16) * 0.5]),
+        "softmax": (lambda x: mx.nd.softmax(x), [a]),
+        "exp": (lambda x: mx.nd.exp(x), [a]),
+        "sum": (lambda x: mx.nd.sum(x, axis=1), [a]),
+    }
+    f, inputs = fns[opname]
+    test_utils.check_consistency(
+        f, inputs, dtypes=("float32", "bfloat16", "float16"))
+
+
+def test_dtype_consistency_conv_bn():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 8, 8) * 0.5
+    w = rng.randn(4, 3, 3, 3) * 0.3
+
+    def f(x, w):
+        return mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4,
+                                 no_bias=True)
+
+    test_utils.check_consistency(f, [x, w],
+                                 dtypes=("float32", "bfloat16"))
+
+
+def test_group2ctx_batchnorm_aux_updates():
+    rng = np.random.RandomState(5)
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        h = mx.sym.BatchNorm(data, name="bn")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = mx.sym.FullyConnected(h, num_hidden=2, name="fc")
+    args = {"data": mx.nd.array(rng.randn(8, 4).astype(np.float32) + 2.0),
+            "bn_gamma": mx.nd.ones((4,)), "bn_beta": mx.nd.zeros((4,)),
+            "fc_weight": mx.nd.array(rng.randn(2, 4).astype(np.float32)),
+            "fc_bias": mx.nd.zeros((2,))}
+    aux = {"bn_moving_mean": mx.nd.zeros((4,)),
+           "bn_moving_var": mx.nd.ones((4,))}
+    exe = out.bind(mx.cpu(0), dict(args), aux_states=dict(aux),
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    exe.forward(is_train=True)
+    # moving mean must have moved toward the batch mean (~2.0)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.all(mm > 0.05), mm
+
+
+def test_group2ctx_honors_args_grad_buffers():
+    rng = np.random.RandomState(6)
+    out = _grouped_net()
+    args = _mlp_args(rng)
+    my_grads = {n: mx.nd.zeros(a.shape) for n, a in args.items()}
+    exe = out.bind(mx.cpu(0), dict(args), args_grad=my_grads,
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    exe.forward(is_train=True)
+    exe.backward()
+    assert float(np.abs(my_grads["fc1_weight"].asnumpy()).sum()) > 0
+
+
+def test_group2ctx_jit_cache_reused():
+    rng = np.random.RandomState(7)
+    out = _grouped_net()
+    exe = out.bind(mx.cpu(0), _mlp_args(rng),
+                   group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    exe.forward(is_train=True)
+    n_cached = len(exe._fwd_cache)
+    exe.forward(is_train=True)
+    assert len(exe._fwd_cache) == n_cached  # no re-trace entries
