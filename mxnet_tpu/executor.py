@@ -85,6 +85,54 @@ class Executor(object):
         self._vjp_jitted = {}
         self.outputs = []
         self._monitor_callback = None
+        self._dp_mesh = None
+        self._dp_batch_names = ()
+
+    # -- data parallelism --------------------------------------------------
+    def set_dp_mesh(self, mesh, batch_arg_names):
+        """Make this executor data-parallel over ``mesh`` (1-D, axis 'dp').
+
+        The TPU-native DataParallelExecutorGroup (reference:
+        python/mxnet/module/executor_group.py:143,310-341): instead of one
+        executor per device plus a KVStore reduce, the SAME compiled
+        program runs over the mesh with batch args sharded on dim 0 and
+        parameters replicated; GSPMD partitions the compute and inserts
+        the gradient all-reduce that `Comm`/NCCL performed in the
+        reference. ``batch_arg_names`` lists the args sharded on dim 0
+        (data + labels)."""
+        self._dp_mesh = mesh
+        self._dp_batch_names = tuple(batch_arg_names)
+        # re-place already-bound buffers so the first forward starts from
+        # consistently-committed arrays
+        for n, arr in list(self.arg_dict.items()):
+            if arr is not None:
+                arr._set_data(self._dp_place(n, arr._data))
+        for n, arr in self.aux_dict.items():
+            arr._set_data(self._dp_place(n, arr._data))
+        for n, arr in self.grad_dict.items():
+            if arr is not None:
+                arr._set_data(self._dp_place(n, arr._data))
+
+    def _dp_place(self, name, data):
+        """device_put ``data`` to its declared mesh sharding if it is not
+        already there (no-op on the steady-state path)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._dp_mesh
+        if name in self._dp_batch_names:
+            ndev = mesh.shape["dp"]
+            if data.ndim == 0 or data.shape[0] % ndev != 0:
+                raise MXNetError(
+                    "data-parallel Module: batch dim of %r (shape %s) must "
+                    "be divisible by the %d devices"
+                    % (name, tuple(data.shape), ndev))
+            spec = P("dp", *([None] * (data.ndim - 1)))
+        else:
+            spec = P()
+        sh = NamedSharding(mesh, spec)
+        if getattr(data, "sharding", None) == sh:
+            return data
+        return jax.device_put(data, sh)
 
     # -- compilation -------------------------------------------------------
     def _fwd(self, is_train):
@@ -121,6 +169,17 @@ class Executor(object):
         env = {n: a._data for n, a in zip(self._arg_names, self.arg_arrays)}
         env.update({n: a._data
                     for n, a in zip(self._aux_names, self.aux_arrays)})
+        if self._dp_mesh is not None:
+            # keep every input committed to its mesh sharding; steady-state
+            # this is a cheap sharding-equality check per array
+            for n in env:
+                placed = self._dp_place(n, env[n])
+                if placed is not env[n]:
+                    env[n] = placed
+                    tgt = (self.arg_dict[n] if n in self.arg_dict
+                           else self.aux_dict.get(n))
+                    if tgt is not None:
+                        tgt._set_data(placed)
         return env
 
     def forward(self, is_train=False, **kwargs):

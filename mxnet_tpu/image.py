@@ -359,7 +359,7 @@ class ImageIter(object):
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, aug_list=None, imglist=None, dtype="float32",
-                 **kwargs):
+                 num_parts=1, part_index=0, **kwargs):
         from .io import DataDesc
         assert path_imgrec or path_imglist or imglist is not None
         self.batch_size = batch_size
@@ -367,6 +367,8 @@ class ImageIter(object):
         self.label_width = label_width
         self.shuffle = shuffle
         self.dtype = dtype
+        self._num_parts = int(num_parts)
+        self._part_index = int(part_index)
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **kwargs)
         self.imgrec = None
@@ -395,6 +397,18 @@ class ImageIter(object):
                             for i, (lbl, p) in enumerate(imglist)}
             self.seq = sorted(self.imglist)
             self.path_root = path_root
+        if self._num_parts > 1:
+            # distributed sharding: each worker reads a contiguous slice of
+            # the key sequence (reference: iter_image_recordio_2.cc
+            # param.num_parts/part_index chunk split)
+            if self.seq is None:
+                raise ValueError(
+                    "num_parts>1 needs an indexed .rec (an .idx next to the "
+                    ".rec) or an image list to shard")
+            n = len(self.seq)
+            lo = n * self._part_index // self._num_parts
+            hi = n * (self._part_index + 1) // self._num_parts
+            self.seq = self.seq[lo:hi]
         self.provide_data = [DataDesc(
             "data", (batch_size,) + self.data_shape, dtype)]
         self.provide_label = [DataDesc(

@@ -563,8 +563,6 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
     if (std_r, std_g, std_b) != (1, 1, 1):
         std = _np.array([std_r, std_g, std_b])
     kwargs.pop("preprocess_threads", None)
-    kwargs.pop("num_parts", None)
-    kwargs.pop("part_index", None)
     return ImageIter(batch_size=batch_size, data_shape=data_shape,
                      path_imgrec=path_imgrec, shuffle=shuffle,
                      rand_crop=rand_crop, rand_mirror=rand_mirror,

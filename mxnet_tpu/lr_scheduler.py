@@ -42,69 +42,66 @@ class LRScheduler(object):
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every ``step`` updates (reference: lr_scheduler.py)."""
+    """lr decays by ``factor`` once per ``step`` updates, floored at
+    ``stop_factor_lr``. Computed in closed form from num_update — there is
+    no incremental state to corrupt on checkpoint resume."""
 
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0.0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("step must be a positive update count")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a decay factor > 1 would grow the lr")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+        self._decays_logged = 0
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+        decays = max(0, (num_update - 1) // self.step)
+        lr = self.base_lr * (self.factor ** decays)
+        floored = lr < self.stop_factor_lr
+        if floored:
+            lr = self.stop_factor_lr
+        if decays != self._decays_logged:
+            self._decays_logged = decays
+            logging.info("lr schedule: update %d -> lr %.5e%s", num_update,
+                         lr, " (floor reached; holding)" if floored else "")
+        return lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in a given list."""
+    """lr decays by ``factor`` after each milestone in ``step`` (an
+    increasing list of update counts). Closed-form: the lr at update t is
+    base_lr * factor^(milestones passed)."""
 
     def __init__(self, step, factor=1.0, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0.0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("milestones must be positive update counts")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("milestones must be strictly increasing")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a decay factor > 1 would grow the lr")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
+        self._decays_logged = 0
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        passed = sum(1 for s in self.step if num_update > s)
+        lr = self.base_lr * (self.factor ** passed)
+        if passed != self._decays_logged:
+            self._decays_logged = passed
+            logging.info("lr schedule: update %d -> lr %.5e", num_update, lr)
+        return lr
 
 
 class PolyScheduler(LRScheduler):

@@ -6,3 +6,6 @@ the same workloads as docs/faq/perf.md. The Gluon model zoo
 """
 from .resnet import get_symbol as resnet
 from .mlp import get_symbol as mlp
+from .alexnet import get_symbol as alexnet
+from .vgg import get_symbol as vgg
+from .mobilenet import get_symbol as mobilenet

@@ -248,6 +248,8 @@ class Module(BaseModule):
                 type_dict[l.name] = l.dtype
         self._exec = self._symbol.simple_bind(
             ctx, grad_req=reqs, type_dict=type_dict, **shape_kwargs)
+        if len(self._context) > 1:
+            self._install_dp_mesh()
         self.binded = True
 
         # re-install cached params into the fresh executor (the reference
@@ -255,6 +257,31 @@ class Module(BaseModule):
         if self.params_initialized and self._arg_params is not None:
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
+
+    def _install_dp_mesh(self):
+        """Data-parallel execution over the context list — the
+        TPU-native DataParallelExecutorGroup (reference:
+        python/mxnet/module/executor_group.py:143): one compiled program
+        over a 1-D 'dp' mesh, batch args sharded on dim 0, parameters
+        replicated; GSPMD inserts the gradient all-reduce the reference
+        ran through KVStore local/device (comm.h:451).
+
+        Raises when the context list cannot be mapped onto distinct
+        devices — a context list must never silently train on one
+        device."""
+        import numpy as np
+        from jax.sharding import Mesh
+        devices = [c.jax_device() for c in self._context]
+        unique = list(dict.fromkeys(devices))
+        if len(unique) != len(devices):
+            raise MXNetError(
+                "Module got %d contexts (%s) but they resolve to only %d "
+                "distinct devices; data-parallel binding needs one device "
+                "per context. Use fewer contexts or run under more devices."
+                % (len(self._context), self._context, len(unique)))
+        mesh = Mesh(np.array(unique), ("dp",))
+        batch_names = list(self._data_names) + list(self._label_names)
+        self._exec.set_dp_mesh(mesh, batch_names)
 
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -254,6 +254,49 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out
 
 
+@register("SyncBatchNorm", num_outputs=_mean_var_outputs,
+          attr_defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                         "use_global_stats": False, "output_mean_var": False,
+                         "axis": 1, "ndev": 1, "key": "", "axis_name": "",
+                         "train_mode": False})
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                     momentum=0.9, fix_gamma=True, use_global_stats=False,
+                     output_mean_var=False, axis=1, ndev=1, key="",
+                     axis_name="", train_mode=False, **_ignored):
+    """Cross-device BatchNorm (reference:
+    src/operator/contrib/sync_batch_norm-inl.h — the reference syncs
+    per-GPU moments with a host-side barrier keyed by ``key``/``ndev``).
+
+    TPU-native semantics: under the GSPMD paths (Module DP mesh /
+    ShardedTrainer) the batch axis is one *logical* axis, so the plain
+    batch moments below already reduce over every device — XLA inserts
+    the cross-chip all-reduce; ``ndev``/``key`` are accepted for API
+    parity and unused. Under an explicit ``shard_map``/``pmap`` with a
+    mapped batch axis, pass ``axis_name`` and the moments are pmean'd
+    across it."""
+    axis = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if train_mode and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        meansq = jnp.mean(jnp.square(data), axis=red)
+        if axis_name:
+            mean = lax.pmean(mean, axis_name)
+            meansq = lax.pmean(meansq, axis_name)
+        var = meansq - jnp.square(mean)
+    else:
+        mean, var = moving_mean, moving_var
+    out = (data - mean.reshape(bshape)) * lax.rsqrt(
+        var.reshape(bshape) + eps) * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
 @register("LayerNorm", num_outputs=_mean_var_outputs,
           attr_defaults={"axis": -1, "eps": 1e-5, "output_mean_var": False})
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):

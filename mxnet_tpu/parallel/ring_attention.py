@@ -53,8 +53,138 @@ def _chunk_attention(q, k, v, q_off, k_off, causal, scale):
     return pv, m_safe, l
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, sm_scale):
-    """Per-shard body (runs inside shard_map). q/k/v: local seq shards."""
+def _ring_attention_local(q, k, v, axis_name, causal, sm_scale,
+                          impl="auto", interpret=None):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    impl="flash" streams each rotating K/V chunk through the Pallas
+    flash-attention kernel (ops/pallas/flash_attention.py) and merges
+    chunk outputs by log-sum-exp — O(block) VMEM instead of the
+    O(S_local^2) score matrix; impl="einsum" is the plain-XLA reference
+    path; "auto" picks flash (the kernel interprets itself off-TPU).
+    """
+    if impl == "auto":
+        impl = "flash"
+    if impl == "flash":
+        if interpret is None:
+            import jax as _jax
+            interpret = _jax.default_backend() != "tpu"
+        return _ring_flash(q, k, v, axis_name, bool(causal),
+                           float(sm_scale), bool(interpret))
+    return _ring_einsum_local(q, k, v, axis_name, causal, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# flash-kernel ring path (forward: Pallas chunks + LSE merge; backward:
+# blockwise recompute with the chunk gradients riding the ring home)
+# ---------------------------------------------------------------------------
+
+def _chunk_block_sizes(s_q, s_k):
+    return min(128, max(8, s_q)), min(128, max(8, s_k))
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, interpret):
+    from ..ops.pallas.flash_attention import _flash_fwd
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    bq, bk = _chunk_block_sizes(s_local, s_local)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out_acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse_acc = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    k_cur, v_cur = k, v
+    for j in range(n):
+        if j == 0:
+            # diagonal chunk: local q and k offsets align, the kernel's
+            # relative causal mask IS the global causal mask
+            o_c, lse_c = _flash_fwd(q, k_cur, v_cur, causal, sm_scale,
+                                    bq, bk, interpret)
+        elif causal:
+            # chunk owner src=(idx-j)%n is fully visible iff idx >= j,
+            # fully hidden otherwise (never partially visible)
+            o_c, lse_c = jax.lax.cond(
+                idx >= j,
+                lambda kc, vc: _flash_fwd(q, kc, vc, False, sm_scale,
+                                          bq, bk, interpret),
+                lambda kc, vc: (jnp.zeros_like(q),
+                                jnp.full((b, h, s_local), NEG_INF,
+                                         jnp.float32)),
+                k_cur, v_cur)
+        else:
+            o_c, lse_c = _flash_fwd(q, k_cur, v_cur, False, sm_scale,
+                                    bq, bk, interpret)
+        lse_new = jnp.logaddexp(lse_acc, lse_c)
+        w_prev = jnp.exp(lse_acc - lse_new)[..., None]
+        w_cur = jnp.exp(lse_c - lse_new)[..., None]
+        out_acc = out_acc * w_prev + o_c.astype(jnp.float32) * w_cur
+        lse_acc = lse_new
+        if j < n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    return out_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_bwd_impl(axis_name, causal, sm_scale, interpret, res, g):
+    """Blockwise backward: recompute probabilities per chunk from the
+    saved global LSE (flash-attention-2 identity p = exp(s - lse)); dK/dV
+    accumulate on a buffer that rotates WITH its chunk, so after n hops
+    every chunk arrives home carrying its full gradient."""
+    q, k, v, o, lse = res
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    g = g.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    delta = jnp.sum(o.astype(jnp.float32) * g, axis=-1)        # (b,h,sq)
+    qpos = idx * s_local + jnp.arange(s_local)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq = jnp.zeros((b, h, s_local, d), jnp.float32)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros((b, h, s_local, d), jnp.float32)
+    dv_cur = jnp.zeros((b, h, s_local, d), jnp.float32)
+    for j in range(n):
+        src = (idx - j) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       k_cur.astype(jnp.float32)) * sm_scale
+        if causal:
+            kpos = src * s_local + jnp.arange(s_local)
+            s = jnp.where((kpos[None, :] <= qpos[:, None])[None, None],
+                          s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                        # 0 when masked
+        dv_cur = dv_cur + jnp.einsum("bhqk,bhqd->bhkd", p, g)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g, v_cur.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dk_cur = dk_cur + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_cur.astype(jnp.float32))
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+    return (dq.astype(q.dtype), dk_cur.astype(k.dtype),
+            dv_cur.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, sm_scale, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                    interpret)
+    return out, (q, k, v, out, lse)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_bwd_impl)
+
+
+def _ring_einsum_local(q, k, v, axis_name, causal, sm_scale):
+    """Plain-XLA per-shard body (the non-kernel reference path)."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -93,12 +223,13 @@ def _ring_attention_local(q, k, v, axis_name, causal, sm_scale):
 
 
 def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
-                   sm_scale=None):
+                   sm_scale=None, impl="auto"):
     """Sequence-parallel attention over mesh axis ``axis``.
 
     q, k, v : (batch, heads, seq, head_dim), with seq divisible by the
         axis size. Arrays may be unsharded (shard_map partitions them).
     mesh : jax.sharding.Mesh (defaults to parallel.current_mesh()).
+    impl : "flash" (Pallas kernel per chunk), "einsum", or "auto".
     """
     from .mesh import current_mesh
     mesh = mesh or current_mesh()
@@ -109,7 +240,8 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
     spec = P(None, None, axis, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=bool(causal), sm_scale=float(sm_scale)),
+                          causal=bool(causal), sm_scale=float(sm_scale),
+                          impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

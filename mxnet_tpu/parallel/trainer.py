@@ -40,15 +40,23 @@ def _loss_and_probs(outputs, label):
 
 
 def make_train_step(symbol, data_name="data", label_name="softmax_label",
-                    lr=0.05, momentum=0.9, wd=0.0):
+                    lr=0.05, momentum=0.9, wd=0.0, compute_dtype=None):
     """Build ``step(params, moms, aux, data, label, key) ->
     (params, moms, aux, loss)`` as one pure function.
 
     Gradients are taken with a ones-cotangent on output 0, matching
     executor.backward for the *Output loss heads (their custom vjp carries
-    the real loss gradient)."""
+    the real loss gradient).
+
+    ``compute_dtype="bfloat16"`` enables mixed precision: master params
+    stay fp32, the forward/backward graph runs in bf16 (conv/matmul hit
+    the MXU at 2x fp32 rate), gradients are accumulated back into fp32
+    for the update — the capability analog of the reference's
+    multi-precision fp16 mode (python/mxnet/optimizer.py
+    multi_precision)."""
     import jax
     import jax.numpy as jnp
+    cdt = jnp.dtype(compute_dtype) if compute_dtype else None
 
     fn = _graph_eval_fn(symbol, is_train=True)
     arg_names = symbol.list_arguments()
@@ -56,11 +64,16 @@ def make_train_step(symbol, data_name="data", label_name="softmax_label",
 
     def step(params, moms, aux, data, label, key):
         def fwd(p):
+            if cdt is not None:
+                p = {k: v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                     else v for k, v in p.items()}
             env = dict(p)
             env.update(aux)
-            env[data_name] = data
+            env[data_name] = data.astype(cdt) if cdt is not None else data
             env[label_name] = label
             outs, new_aux = fn(env, key)
+            outs = tuple(o.astype(jnp.float32) for o in outs)
+            new_aux = {k: v.astype(jnp.float32) for k, v in new_aux.items()}
             return outs, new_aux
 
         (outs, new_aux), vjp = jax.vjp(fwd, params)
@@ -97,7 +110,8 @@ class ShardedTrainer(object):
 
     def __init__(self, symbol, mesh, data_name="data",
                  label_name="softmax_label", lr=0.05, momentum=0.9, wd=0.0,
-                 dp_axis="dp", tp_axis=None, tp_min_size=2048):
+                 dp_axis="dp", tp_axis=None, tp_min_size=2048,
+                 compute_dtype=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._symbol = symbol
@@ -108,7 +122,8 @@ class ShardedTrainer(object):
         self._tp_axis = tp_axis
         self._tp_min_size = tp_min_size
         step, self._param_names = make_train_step(
-            symbol, data_name, label_name, lr=lr, momentum=momentum, wd=wd)
+            symbol, data_name, label_name, lr=lr, momentum=momentum, wd=wd,
+            compute_dtype=compute_dtype)
         self._aux_names = symbol.list_auxiliary_states()
         self._step_raw = step
         self._jitted = None

@@ -1,0 +1,49 @@
+"""LR scheduler semantics (reference: python/mxnet/lr_scheduler.py
+behavior contract; implementations here are closed-form)."""
+import math
+
+import pytest
+
+from mxnet_tpu import lr_scheduler as lrs
+
+
+def test_factor_scheduler_decay_points():
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(10) == 1.0          # boundary: no decay at exactly `step`
+    assert s(11) == 0.5          # first decay
+    assert s(20) == 0.5
+    assert s(21) == 0.25
+    # idempotent / order-independent (closed form)
+    assert s(11) == 0.5
+
+
+def test_factor_scheduler_floor():
+    s = lrs.FactorScheduler(step=1, factor=0.1, base_lr=1.0,
+                            stop_factor_lr=1e-3)
+    assert s(100) == pytest.approx(1e-3)
+
+
+def test_multifactor_scheduler():
+    s = lrs.MultiFactorScheduler(step=[5, 8], factor=0.1, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(6) == pytest.approx(0.1)
+    assert s(8) == pytest.approx(0.1)
+    assert s(9) == pytest.approx(0.01)
+    with pytest.raises(ValueError):
+        lrs.MultiFactorScheduler(step=[8, 5], factor=0.1)
+
+
+def test_warmup():
+    s = lrs.FactorScheduler(step=100, factor=0.5, base_lr=1.0,
+                            warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert s(5) == pytest.approx(0.5)
+    assert s(10) == 1.0
+
+
+def test_cosine_endpoints():
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert s(0) == pytest.approx(1.0)
+    assert s(50) == pytest.approx(0.55)
+    assert s(100) == pytest.approx(0.1)

@@ -1,0 +1,108 @@
+"""Data-parallel Module(context=[...]) tests.
+
+The reference's primary multi-GPU pattern is
+``Module(sym, context=[mx.gpu(i) for i in range(N)])`` with
+DataParallelExecutorGroup slicing the batch (reference:
+python/mxnet/module/executor_group.py:143,310-341). Here the same API
+shards the batch over a 1-D 'dp' mesh inside one compiled program; these
+tests verify the multi-device trajectory matches single-device training
+and that an unmappable context list fails loudly instead of silently
+using one device.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.module import Module
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=256, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 64).astype(np.float32) * 1.5
+    labels = rng.randint(0, 10, size=n)
+    data = (centers[labels] + rng.randn(n, 64)).astype(np.float32)
+    return data, labels.astype(np.float32)
+
+
+def _train_losses(contexts, steps=8, batch=32):
+    """Train with fixed init/data; return the per-step CE losses."""
+    data, labels = _toy_data()
+    mod = Module(_mlp_sym(), context=contexts)
+    mod.bind(data_shapes=[("data", (batch, 64))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    # deterministic init: overwrite with a seeded dense init so both runs
+    # start from identical weights
+    rng = np.random.RandomState(11)
+    args = {n: mx.nd.array(rng.randn(*a.shape).astype(np.float32) * 0.05)
+            for n, a in mod._exec.arg_dict.items()
+            if n not in ("data", "softmax_label")}
+    mod.set_params(args, {}, allow_missing=True, force_init=True)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    losses = []
+    for i in range(steps):
+        lo = (i * batch) % (len(data) - batch)
+        db = io.DataBatch(data=[mx.nd.array(data[lo:lo + batch])],
+                          label=[mx.nd.array(labels[lo:lo + batch])])
+        mod.forward(db, is_train=True)
+        probs = mod.get_outputs()[0].asnumpy()
+        li = labels[lo:lo + batch].astype(int)
+        losses.append(float(-np.mean(
+            np.log(np.maximum(probs[np.arange(batch), li], 1e-10)))))
+        mod.backward()
+        mod.update()
+    return losses
+
+
+def test_module_multi_context_matches_single_device():
+    """4-device DP trajectory == 1-device trajectory (the reference's
+    multi_lenet.py-style consistency check)."""
+    single = _train_losses(mx.cpu(0))
+    multi = _train_losses([mx.cpu(i) for i in range(4)])
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=2e-5)
+    assert single[-1] < single[0] * 0.7, "training did not reduce loss"
+
+
+def test_module_multi_context_actually_shards():
+    """The bound executor must hold a real 4-way mesh — not context[0]."""
+    mod = Module(_mlp_sym(), context=[mx.cpu(i) for i in range(4)])
+    mod.bind(data_shapes=[("data", (32, 64))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params()
+    assert mod._exec._dp_mesh is not None
+    assert mod._exec._dp_mesh.shape["dp"] == 4
+    batch = io.DataBatch(data=[mx.nd.zeros((32, 64))],
+                         label=[mx.nd.zeros((32,))])
+    mod.forward(batch, is_train=True)
+    data_arr = mod._exec.arg_dict["data"]._data
+    assert len(data_arr.sharding.device_set) == 4
+
+
+def test_module_duplicate_contexts_raise():
+    """A context list that folds onto one device must fail loudly
+    (round-2 verdict: silent single-device training is unacceptable)."""
+    import jax
+    n = len(jax.devices())
+    with pytest.raises(MXNetError, match="distinct devices"):
+        mod = Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(n)])
+        mod.bind(data_shapes=[("data", (8, 64))],
+                 label_shapes=[("softmax_label", (8,))])
+
+
+def test_module_dp_indivisible_batch_raises():
+    mod = Module(_mlp_sym(), context=[mx.cpu(i) for i in range(3)])
+    with pytest.raises(MXNetError, match="divisible"):
+        mod.bind(data_shapes=[("data", (32, 64))],
+                 label_shapes=[("softmax_label", (32,))])
