@@ -104,50 +104,60 @@ class MultiFactorScheduler(LRScheduler):
         return lr
 
 
-class PolyScheduler(LRScheduler):
-    """Polynomial decay from base_lr to final_lr over max_update steps."""
+class _AnnealingScheduler(LRScheduler):
+    """Shared shape for Poly/Cosine: anneal from base_lr down to final_lr
+    over the post-warmup window, hold final_lr afterwards. Pure function
+    of num_update — no mutable lr state, so resume-from-checkpoint at any
+    update count reproduces the schedule exactly."""
+
+    def __init__(self, max_update, base_lr, final_lr, warmup_steps,
+                 warmup_begin_lr, warmup_mode):
+        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
+        if int(max_update) != max_update or max_update < 1:
+            raise ValueError("max_update must be a positive integer")
+        if max_update <= warmup_steps:
+            raise ValueError("max_update must exceed warmup_steps")
+        self.max_update = int(max_update)
+        self.final_lr = final_lr
+        self.max_steps = self.max_update - self.warmup_steps
+
+    def _progress(self, num_update):
+        """Fraction of the annealing window consumed, clamped to [0, 1]."""
+        t = (num_update - self.warmup_steps) / self.max_steps
+        return min(max(t, 0.0), 1.0)
+
+    def _anneal(self, t):
+        raise NotImplementedError()
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        span = self.base_lr - self.final_lr
+        return self.final_lr + span * self._anneal(self._progress(num_update))
+
+
+class PolyScheduler(_AnnealingScheduler):
+    """Polynomial decay: lr(t) follows (1 - t)^pwr over max_update steps,
+    then holds at final_lr."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0.0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
         self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                      self.power)
-        return self.base_lr
+    def _anneal(self, t):
+        return (1.0 - t) ** self.power
 
 
-class CosineScheduler(LRScheduler):
-    """Cosine decay from base_lr to final_lr over max_update steps."""
+class CosineScheduler(_AnnealingScheduler):
+    """Cosine (half-period) decay over max_update steps, then holds at
+    final_lr."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0.0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * (1 + math.cos(math.pi * (num_update - self.warmup_steps)
-                                / self.max_steps)) / 2
-        return self.base_lr
+    def _anneal(self, t):
+        return 0.5 * (1.0 + math.cos(math.pi * t))

@@ -22,7 +22,14 @@ def _wrap(op_name, public):
     from .ndarray import NDArray
 
     def fn(*args, **kwargs):
-        arrays = [a for a in args if isinstance(a, NDArray)]
+        arrays = []
+        for i, a in enumerate(args):
+            if isinstance(a, NDArray):
+                arrays.append(a)
+            elif a is not None:   # None = optional input slot (reference
+                raise TypeError(  # convention, e.g. quantized FC bias)
+                    "%s: positional argument %d is not an NDArray; pass "
+                    "operator parameters by keyword" % (public, i))
         attrs = {k: v for k, v in kwargs.items()
                  if not isinstance(v, NDArray)}
         arrays += [v for v in kwargs.values() if isinstance(v, NDArray)]
@@ -61,3 +68,32 @@ quantized_fully_connected = _wrap("_contrib_quantized_fully_connected",
 quantized_conv = _wrap("_contrib_quantized_conv", "quantized_conv")
 quantized_pooling = _wrap("_contrib_quantized_pooling", "quantized_pooling")
 quantized_flatten = _wrap("_contrib_quantized_flatten", "quantized_flatten")
+
+
+def _populate_generated():
+    """Expose every registered ``_contrib_*`` op under its public name,
+    mirroring the reference's generated contrib bindings
+    (python/mxnet/ndarray/register.py)."""
+    from ..ops import registry as _reg
+    g = globals()
+    for op_name in _reg.list_ops():
+        if not op_name.startswith("_contrib_"):
+            continue
+        public = op_name[len("_contrib_"):]
+        if public not in g:
+            g[public] = _wrap(op_name, public)
+            __all__.append(public)
+
+
+_populate_generated()
+
+
+def __getattr__(name):  # PEP 562: resolve late-registered contrib ops
+    from ..ops import registry as _reg
+    op_name = "_contrib_" + name
+    if op_name in _reg.list_ops():
+        fn = _wrap(op_name, name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,388 @@
+"""Detection operators: SSD target/decode + RPN proposals.
+
+Reference: src/operator/contrib/multibox_target.cc,
+multibox_detection.cc, proposal.cc. The reference implementations are
+sequential per-anchor CPU/CUDA loops; here every stage is a vectorized,
+statically-shaped masked computation (argmax matching, rank-based
+top-k, fori_loop NMS over a dense IoU matrix) so the whole pipeline
+jits and vmaps over the batch — no host round-trips inside training.
+
+All three ops are non-differentiable label/post-processing stages, as
+in the reference (their backward passes are zeros).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .contrib_ops import _iou_matrix
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (reference: src/operator/contrib/multibox_target.cc:58-280)
+# ---------------------------------------------------------------------------
+
+def _encode_loc(anchors, gt, variances):
+    """Center-offset box encoding (reference multibox_target.cc:32-55):
+    ((gx-ax)/aw/vx, (gy-ay)/ah/vy, log(gw/aw)/vw, log(gh/ah)/vh)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    aw = jnp.maximum(aw, 1e-12)
+    ah = jnp.maximum(ah, 1e-12)
+    safe = (gw > 0) & (gh > 0)
+    gw = jnp.where(safe, gw, 1.0)
+    gh = jnp.where(safe, gh, 1.0)
+    return jnp.stack([
+        (gx - ax) / aw / vx,
+        (gy - ay) / ah / vy,
+        jnp.log(gw / aw) / vw,
+        jnp.log(gh / ah) / vh,
+    ], axis=1)
+
+
+def _multibox_target_one(anchors, labels, cls_pred, overlap_threshold,
+                         ignore_label, negative_mining_ratio,
+                         negative_mining_thresh, minimum_negative_samples,
+                         variances):
+    """One batch element. anchors (N,4), labels (M,W>=5) rows
+    [cls, xmin, ymin, xmax, ymax, ...] padded with cls<0, cls_pred (C,N).
+    """
+    N = anchors.shape[0]
+    M = labels.shape[0]
+    gt_valid = labels[:, 0] >= 0                               # (M,)
+    iou = _iou_matrix(anchors, labels[:, 1:5])                 # (N, M)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+    # stage 1 — greedy bipartite: repeatedly take the globally best
+    # (anchor, gt) pair so every gt gets its best unclaimed anchor
+    # (reference multibox_target.cc:100-147)
+    def bip_step(state, _):
+        a_used, g_used, m_gt, m_iou = state
+        masked = jnp.where(a_used[:, None] | g_used[None, :], -1.0, iou)
+        flat = jnp.argmax(masked)
+        ai, gi = flat // M, flat % M
+        ok = masked[ai, gi] > 1e-12
+        a_used = a_used.at[ai].set(a_used[ai] | ok)
+        g_used = g_used.at[gi].set(g_used[gi] | ok)
+        m_gt = m_gt.at[ai].set(jnp.where(ok, gi, m_gt[ai]))
+        m_iou = m_iou.at[ai].set(jnp.where(ok, masked[ai, gi], m_iou[ai]))
+        return (a_used, g_used, m_gt, m_iou), None
+
+    init = (jnp.zeros(N, bool), jnp.zeros(M, bool),
+            jnp.zeros(N, jnp.int32), jnp.full(N, -1.0))
+    (a_used, _, m_gt, m_iou), _ = lax.scan(bip_step, init, None, length=M)
+
+    # stage 2 — per-anchor threshold matching for the rest
+    # (reference multibox_target.cc:150-179)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    m_gt = jnp.where(a_used, m_gt, best_gt)
+    m_iou = jnp.where(a_used, m_iou, best_iou)
+    thr_pos = (~a_used) & (best_iou > overlap_threshold) \
+        if overlap_threshold > 0 else jnp.zeros(N, bool)
+    positive = a_used | thr_pos
+
+    if negative_mining_ratio > 0:
+        # hard negative mining: unmatched anchors below the mining IoU
+        # threshold, ranked by background confidence ascending (least
+        # background-like first — reference multibox_target.cc:181-240)
+        num_pos = jnp.sum(positive)
+        num_neg = jnp.minimum(
+            (num_pos * negative_mining_ratio).astype(jnp.int32),
+            N - num_pos)
+        num_neg = jnp.maximum(num_neg, int(minimum_negative_samples))
+        bg_prob = jax.nn.softmax(cls_pred, axis=0)[0]          # (N,)
+        cand = (~positive) & (m_iou < negative_mining_thresh)
+        key = jnp.where(cand, bg_prob, jnp.inf)
+        order = jnp.argsort(key)
+        rank = jnp.zeros(N, jnp.int32).at[order].set(jnp.arange(N,
+                                                     dtype=jnp.int32))
+        negative = cand & (rank < num_neg)
+    else:
+        negative = ~positive
+
+    cls_target = jnp.where(
+        positive, labels[m_gt, 0] + 1.0,
+        jnp.where(negative, 0.0, float(ignore_label)))
+    loc = _encode_loc(anchors, labels[m_gt, 1:5], variances)   # (N, 4)
+    loc_target = jnp.where(positive[:, None], loc, 0.0).reshape(-1)
+    loc_mask = jnp.where(positive[:, None],
+                         jnp.ones((N, 4)), 0.0).reshape(-1)
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3, differentiable=False,
+          attr_defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                         "negative_mining_ratio": -1.0,
+                         "negative_mining_thresh": 0.5,
+                         "minimum_negative_samples": 0,
+                         "variances": (0.1, 0.1, 0.2, 0.2)})
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **_ig):
+    """SSD training-target assignment (reference: multibox_target.cc).
+
+    anchor (1, N, 4) corner boxes; label (B, M, 5+) rows
+    [cls, xmin, ymin, xmax, ymax] padded with cls=-1; cls_pred (B, C, N)
+    raw class scores (used only for hard negative mining).
+    Returns (loc_target (B, 4N), loc_mask (B, 4N), cls_target (B, N)).
+    """
+    anchors = anchor.reshape(-1, 4)
+    fn = lambda lab, cp: _multibox_target_one(
+        anchors, lab, cp, float(overlap_threshold), float(ignore_label),
+        float(negative_mining_ratio), float(negative_mining_thresh),
+        int(minimum_negative_samples), tuple(variances))
+    return jax.vmap(fn)(label, cls_pred)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (reference: src/operator/contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+def _decode_loc(anchors, loc, variances, clip):
+    """Inverse of _encode_loc (reference multibox_detection.cc:46-72)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    ox = loc[:, 0] * vx * aw + ax
+    oy = loc[:, 1] * vy * ah + ay
+    ow = jnp.exp(loc[:, 2] * vw) * aw * 0.5
+    oh = jnp.exp(loc[:, 3] * vh) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _multibox_detection_one(cls_prob, loc_pred, anchors, threshold, clip,
+                            background_id, nms_threshold, force_suppress,
+                            nms_topk, variances):
+    C, N = cls_prob.shape
+    # best non-background class per anchor
+    fg = jnp.where(jnp.arange(C)[:, None] == background_id,
+                   -jnp.inf, cls_prob)                          # (C, N)
+    cid = jnp.argmax(fg, axis=0)                                # (N,)
+    score = jnp.max(fg, axis=0)
+    keep_cls = score >= threshold
+    boxes = _decode_loc(anchors, loc_pred.reshape(-1, 4), variances, clip)
+
+    # class ids are re-based so background is dropped: classes after
+    # background shift down by one (reference stores id-1 with bg=0)
+    out_id = jnp.where(cid > background_id, cid - 1, cid).astype(
+        cls_prob.dtype)
+    out_id = jnp.where(keep_cls, out_id, -1.0)
+
+    # greedy NMS over score-descending order (reference: multibox NMS
+    # with per-class suppression unless force_suppress). Slice to the
+    # top-K candidates FIRST so the IoU matrix is K*K, not N*N — with
+    # SSD300's 8732 anchors that is the difference between ~300 MB and
+    # a few MB per batch element.
+    order = jnp.argsort(-jnp.where(keep_cls, score, -jnp.inf))
+    K = min(N, nms_topk) if nms_topk > 0 else N
+    order = order[:K]
+    sid = out_id[order]
+    sscore = jnp.where(keep_cls, score, -1.0)[order]
+    sbox = boxes[order]
+    valid0 = sid >= 0
+    iou = _iou_matrix(sbox, sbox)
+    same = jnp.ones((K, K), bool) if force_suppress \
+        else sid[:, None] == sid[None, :]
+
+    def body(i, keep):
+        sup = (iou[i] > nms_threshold) & same[i] & keep[i] \
+            & (jnp.arange(K) > i)
+        return jnp.where(sup, False, keep)
+
+    keep = lax.fori_loop(0, K, body, valid0)
+    rows = jnp.concatenate([
+        jnp.where(keep, sid, -1.0)[:, None],
+        sscore[:, None], sbox], axis=1)                         # (K, 6)
+    # compact: surviving detections first, suppressed rows become -1
+    # (the reference writes valid entries to the front of the output)
+    comp = jnp.argsort(~keep, stable=True)
+    rows = jnp.where(keep[comp, None], rows[comp],
+                     jnp.full((1, 6), -1.0, rows.dtype))
+    if K < N:
+        rows = jnp.concatenate(
+            [rows, jnp.full((N - K, 6), -1.0, rows.dtype)])
+    return rows
+
+
+@register("_contrib_MultiBoxDetection", differentiable=False,
+          attr_defaults={"clip": True, "threshold": 0.01,
+                         "background_id": 0, "nms_threshold": 0.5,
+                         "force_suppress": False, "nms_topk": -1,
+                         "variances": (0.1, 0.1, 0.2, 0.2)})
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False, nms_topk=-1,
+                        variances=(0.1, 0.1, 0.2, 0.2), **_ig):
+    """SSD inference decode + NMS (reference: multibox_detection.cc).
+
+    cls_prob (B, C, N) softmax class probabilities, loc_pred (B, 4N),
+    anchor (1, N, 4). Returns (B, N, 6) rows
+    [class_id, score, xmin, ymin, xmax, ymax], -1 padded.
+    """
+    anchors = anchor.reshape(-1, 4)
+    fn = lambda cp, lp: _multibox_detection_one(
+        cp, lp, anchors, float(threshold), bool(clip), int(background_id),
+        float(nms_threshold), bool(force_suppress), int(nms_topk),
+        tuple(variances))
+    return jax.vmap(fn)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (reference: src/operator/contrib/proposal.cc) — RPN stage of
+# Faster R-CNN: anchors + deltas -> clipped, filtered, NMS'd ROIs
+# ---------------------------------------------------------------------------
+
+def _base_anchors(base_size, scales, ratios):
+    """Faster-RCNN base anchors around a base_size window at the origin:
+    ratio enumeration then scale enumeration (reference
+    proposal-inl.h GenerateAnchors semantics)."""
+    import numpy as np
+    base = np.array([0, 0, base_size - 1, base_size - 1], dtype=np.float64)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    out = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(out, dtype=np.float32)                      # (A, 4)
+
+
+def _proposal_one(fg_scores, deltas, im_info, anchors_hw, pre_n, post_n,
+                  nms_thresh, min_size, iou_loss):
+    """fg_scores (A, H, W); deltas (A, 4, H, W); anchors_hw (A, H, W, 4)."""
+    A, H, W = fg_scores.shape
+    n = A * H * W
+    boxes = anchors_hw.reshape(n, 4)
+    d = jnp.transpose(deltas, (0, 2, 3, 1)).reshape(n, 4)
+    scores = fg_scores.reshape(n)
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+
+    if iou_loss:
+        # iou_loss decode: deltas are direct corner offsets
+        # (reference proposal.cc BBoxTransformInv2)
+        pred = boxes + d
+    else:
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        cx = boxes[:, 0] + 0.5 * (bw - 1.0)
+        cy = boxes[:, 1] + 0.5 * (bh - 1.0)
+        pcx = d[:, 0] * bw + cx
+        pcy = d[:, 1] * bh + cy
+        pw = jnp.exp(d[:, 2]) * bw
+        ph = jnp.exp(d[:, 3]) * bh
+        pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                          pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                         axis=1)
+    pred = jnp.stack([
+        jnp.clip(pred[:, 0], 0.0, im_w - 1.0),
+        jnp.clip(pred[:, 1], 0.0, im_h - 1.0),
+        jnp.clip(pred[:, 2], 0.0, im_w - 1.0),
+        jnp.clip(pred[:, 3], 0.0, im_h - 1.0)], axis=1)
+
+    # drop proposals smaller than min_size (scaled to the input image);
+    # the reference expands them and flags score=-1 — same net effect
+    ms = min_size * im_scale
+    pw = pred[:, 2] - pred[:, 0] + 1.0
+    ph = pred[:, 3] - pred[:, 1] + 1.0
+    ok = (pw >= ms) & (ph >= ms)
+    scores = jnp.where(ok, scores, -1.0)
+
+    # slice to pre_nms_top_n BEFORE the IoU matrix: with a 50x38 RPN
+    # map and 12 anchors (n~23k) the full n*n matrix would be ~2 GB;
+    # pre_n*pre_n (default 6000) is what the reference computes too
+    pre = min(n, pre_n)
+    order = jnp.argsort(-scores)[:pre]
+    sbox = pred[order]
+    sscore = scores[order]
+    valid0 = sscore > -1.0
+    iou = _iou_matrix(sbox, sbox)
+
+    def body(i, keep):
+        sup = (iou[i] > nms_thresh) & keep[i] & (jnp.arange(pre) > i)
+        return jnp.where(sup, False, keep)
+
+    keep = lax.fori_loop(0, pre, body, valid0)
+
+    # take the first post_n surviving proposals; when fewer survive,
+    # pad by repeating survivors (the reference pads cyclically)
+    comp = jnp.argsort(~keep, stable=True)                      # kept first
+    nk = jnp.maximum(jnp.sum(keep), 1)
+    idx = comp[jnp.arange(post_n) % nk]
+    return sbox[idx], sscore[idx]
+
+
+@register("_contrib_Proposal",
+          num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+          differentiable=False,
+          attr_defaults={"rpn_pre_nms_top_n": 6000,
+                         "rpn_post_nms_top_n": 300, "threshold": 0.7,
+                         "rpn_min_size": 16, "scales": (4, 8, 16, 32),
+                         "ratios": (0.5, 1, 2), "feature_stride": 16,
+                         "output_score": False, "iou_loss": False})
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False, **_ig):
+    """RPN proposal generation (reference: contrib/proposal.cc).
+
+    cls_prob (B, 2A, H, W) background/foreground scores; bbox_pred
+    (B, 4A, H, W); im_info (B, 3) rows [height, width, scale].
+    Returns rois (B*post_n, 5) [batch_idx, x1, y1, x2, y2] (+ scores
+    (B*post_n, 1) when output_score).
+    """
+    B, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    if A != len(scales) * len(ratios):
+        from ..base import MXNetError
+        raise MXNetError(
+            "Proposal: cls_prob has %d anchors/position but "
+            "len(scales)*len(ratios)=%d" % (A, len(scales) * len(ratios)))
+    base = jnp.asarray(_base_anchors(feature_stride, scales, ratios))
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack([
+        jnp.broadcast_to(sx[None, :], (H, W)),
+        jnp.broadcast_to(sy[:, None], (H, W)),
+        jnp.broadcast_to(sx[None, :], (H, W)),
+        jnp.broadcast_to(sy[:, None], (H, W))], axis=-1)        # (H, W, 4)
+    anchors_hw = base[:, None, None, :] + shift[None]           # (A,H,W,4)
+
+    fg = cls_prob[:, A:, :, :]                                  # (B, A, H, W)
+    deltas = bbox_pred.reshape(B, A, 4, H, W)
+    fn = lambda s, dl, info: _proposal_one(
+        s, dl, info, anchors_hw, int(rpn_pre_nms_top_n),
+        int(rpn_post_nms_top_n), float(threshold), float(rpn_min_size),
+        bool(iou_loss))
+    boxes, scores = jax.vmap(fn)(fg, deltas, im_info)           # (B,post,4)
+    bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype),
+                      int(rpn_post_nms_top_n))[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

@@ -116,9 +116,48 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, pad)
 
 
+def _out_vma(*xs):
+    """Union of the inputs' varying-across-mesh axes, so pallas_call
+    outputs carry the right `vma` under shard_map(check_vma=True)."""
+    vma = frozenset()
+    for x in xs:
+        try:
+            vma |= frozenset(jax.typeof(x).vma)
+        except Exception:
+            pass
+    return vma
+
+
+def _flash_fwd_xla(q, k, v, causal, sm_scale):
+    """Plain-XLA twin of the kernel (same (o, lse) contract).
+
+    Used when the kernel would run under the Pallas *interpreter* inside
+    a shard_map manual context: the interpreter's internal dynamic_slice
+    ops trip check_vma there (JAX-internal limitation). Off the manual
+    path the interpreter still exercises the real kernel logic, and on
+    TPU the compiled Mosaic kernel always runs.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        qpos = jnp.arange(q.shape[2])[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((kpos <= qpos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p / l_safe, v.astype(jnp.float32))
+    lse = (m + jnp.log(l_safe))[..., 0]
+    return o.astype(q.dtype), lse
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
                                              "block_k", "interpret"))
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    if interpret and _out_vma(q, k, v):
+        return _flash_fwd_xla(q, k, v, causal, sm_scale)
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     qf = q.reshape(b * h, s_q, d)
@@ -148,8 +187,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sp_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sp_q, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sp_q, d), q.dtype,
+                                 vma=_out_vma(q, k, v)),
+            jax.ShapeDtypeStruct((b * h, sp_q, _LANES), jnp.float32,
+                                 vma=_out_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),

@@ -21,7 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["top_k_gating", "moe_apply", "stack_expert_params"]
 
@@ -125,7 +125,7 @@ def moe_apply(x, gate_w, expert_params, expert_fn, mesh=None, axis="ep",
         mesh=mesh,
         in_specs=(pspec, P(None, axis, None), P(None, axis, None), P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     out = fn(expert_params, dispatch.astype(x.dtype),
              combine.astype(x.dtype), x)

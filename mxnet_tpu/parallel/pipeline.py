@@ -26,7 +26,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["pipeline_apply", "stack_stage_params"]
 
@@ -115,7 +115,7 @@ def pipeline_apply(stage_params, x, stage_fn, mesh=None, axis="pp",
         mesh=mesh,
         in_specs=(pspec, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     out = fn(stage_params, x_mb)
     return out.reshape((M * mb,) + out.shape[2:])

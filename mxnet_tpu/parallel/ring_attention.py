@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 NEG_INF = -1e30
 
@@ -107,9 +107,11 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, interpret):
                 idx >= j,
                 lambda kc, vc: _flash_fwd(q, kc, vc, False, sm_scale,
                                           bq, bk, interpret),
+                # NEG_INF lse derived from q so its varying-axes (vma)
+                # match the kernel branch under any enclosing mesh axes
                 lambda kc, vc: (jnp.zeros_like(q),
-                                jnp.full((b, h, s_local), NEG_INF,
-                                         jnp.float32)),
+                                jnp.sum(jnp.zeros_like(q, dtype=jnp.float32),
+                                        axis=-1) + NEG_INF),
                 k_cur, v_cur)
         else:
             o_c, lse_c = _flash_fwd(q, k_cur, v_cur, False, sm_scale,

@@ -1,0 +1,180 @@
+"""Detection stack: MultiBoxTarget/MultiBoxDetection/Proposal ops,
+ImageDetIter + bbox augmenters, SSD smoke training.
+
+Reference behavior: src/operator/contrib/multibox_target.cc,
+multibox_detection.cc, proposal.cc, src/io/image_det_aug_default.cc,
+python/mxnet/image/detection.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+
+
+def _mbt(anchors, labels, cls_pred, **kw):
+    return nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_pred),
+        **kw)
+
+
+def test_multibox_target_perfect_match():
+    # one anchor exactly over the gt box -> positive with zero offsets
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    labels = np.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    loc_t, loc_m, cls_t = _mbt(anchors, labels, cls_pred)
+    assert loc_t.shape == (1, 8) and cls_t.shape == (1, 2)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0          # gt class 1 -> target 1+1
+    assert ct[1] == 0.0          # background
+    lm = loc_m.asnumpy()[0]
+    np.testing.assert_array_equal(lm, [1, 1, 1, 1, 0, 0, 0, 0])
+    np.testing.assert_allclose(loc_t.asnumpy()[0][:4], 0.0, atol=1e-5)
+
+
+def test_multibox_target_encoding_roundtrip():
+    # encode then decode via MultiBoxDetection must recover the gt box
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.7]]], np.float32)
+    gt = np.array([0.25, 0.15, 0.55, 0.66], np.float32)
+    labels = np.concatenate([[3.0], gt]).reshape(1, 1, 5).astype(np.float32)
+    cls_pred = np.zeros((1, 5, 1), np.float32)
+    loc_t, loc_m, cls_t = _mbt(anchors, labels, cls_pred,
+                               overlap_threshold=0.3)
+    assert cls_t.asnumpy()[0, 0] == 4.0
+    # decode: variances match defaults
+    v = (0.1, 0.1, 0.2, 0.2)
+    a = anchors[0, 0]
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    t = loc_t.asnumpy()[0]
+    ox = t[0] * v[0] * aw + ax
+    oy = t[1] * v[1] * ah + ay
+    ow = np.exp(t[2] * v[2]) * aw / 2
+    oh = np.exp(t[3] * v[3]) * ah / 2
+    np.testing.assert_allclose(
+        [ox - ow, oy - oh, ox + ow, oy + oh], gt, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_bipartite_claims_best():
+    # two anchors both overlap the single gt; only the better one is
+    # positive via bipartite matching (threshold disabled by 0.9)
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.05, 0.05, 0.55, 0.55]]], np.float32)
+    labels = np.array([[[0.0, 0.05, 0.05, 0.55, 0.55]]], np.float32)
+    cls_pred = np.zeros((1, 2, 2), np.float32)
+    _, loc_m, cls_t = _mbt(anchors, labels, cls_pred,
+                           overlap_threshold=0.95)
+    ct = cls_t.asnumpy()[0]
+    assert ct[1] == 1.0 and ct[0] == 0.0
+    np.testing.assert_array_equal(loc_m.asnumpy()[0], [0] * 4 + [1] * 4)
+
+
+def test_multibox_target_negative_mining():
+    # 4 anchors, 1 positive; ratio 1 -> exactly 1 negative kept, the
+    # other two anchors ignored (-1)
+    anchors = np.zeros((1, 4, 4), np.float32)
+    anchors[0, 0] = [0.1, 0.1, 0.4, 0.4]
+    anchors[0, 1] = [0.5, 0.5, 0.6, 0.6]
+    anchors[0, 2] = [0.7, 0.7, 0.8, 0.8]
+    anchors[0, 3] = [0.85, 0.85, 0.95, 0.95]
+    labels = np.array([[[2.0, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+    cls_pred = np.zeros((1, 3, 4), np.float32)
+    # anchor 2 least background-like -> hardest negative
+    cls_pred[0, 0] = [5.0, 5.0, -5.0, 5.0]
+    loc_t, loc_m, cls_t = _mbt(anchors, labels, cls_pred,
+                               negative_mining_ratio=1.0,
+                               negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 3.0                     # positive, class 2 + 1
+    assert ct[2] == 0.0                     # mined negative
+    assert ct[1] == -1.0 and ct[3] == -1.0  # ignored
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # zero offsets -> boxes == anchors
+    loc_pred = np.zeros((1, 12), np.float32)
+    cls_prob = np.array([[[0.1, 0.2, 0.8],     # background
+                          [0.8, 0.1, 0.1],     # class 0
+                          [0.1, 0.7, 0.1]]], np.float32)  # class 1
+    out = nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5, threshold=0.05, force_suppress=True)
+    o = out.asnumpy()[0]
+    assert out.shape == (1, 3, 6)
+    # of the two overlapping anchors force_suppress keeps the higher;
+    # the far-away third anchor survives regardless of class
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) == 2
+    assert kept[0][0] == 0.0 and abs(kept[0][1] - 0.8) < 1e-5
+    np.testing.assert_allclose(kept[0][2:], anchors[0, 0], atol=1e-5)
+    np.testing.assert_allclose(kept[1][2:], anchors[0, 2], atol=1e-5)
+
+
+def test_multibox_detection_per_class_nms():
+    # same boxes, different classes: per-class NMS keeps both
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.2],
+                          [0.8, 0.1],
+                          [0.1, 0.7]]], np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5, threshold=0.05)
+    o = out.asnumpy()[0]
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) == 2
+    assert set(kept[:, 0]) == {0.0, 1.0}
+
+
+# ---------------------------------------------------------------------------
+# Proposal
+
+
+def test_proposal_shapes_and_clip():
+    rng = np.random.RandomState(0)
+    B, A, H, W = 1, 3, 4, 5
+    cls_prob = rng.uniform(0, 1, (B, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 80.0, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=8, feature_stride=16,
+        scales=(8,), ratios=(0.5, 1, 2), rpn_min_size=4)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 79).all()
+    assert (r[:, 2] >= 0).all() and (r[:, 4] <= 63).all()
+    # well-formed boxes
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+def test_proposal_output_score_and_order():
+    rng = np.random.RandomState(1)
+    B, A, H, W = 1, 1, 3, 3
+    cls_prob = rng.uniform(0, 1, (B, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = np.zeros((B, 4 * A, H, W), np.float32)
+    im_info = np.array([[48.0, 48.0, 1.0]], np.float32)
+    rois, scores = nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=9, rpn_post_nms_top_n=4, feature_stride=16,
+        scales=(4,), ratios=(1,), rpn_min_size=2, output_score=True,
+        threshold=0.99)
+    s = scores.asnumpy().ravel()
+    # scores non-increasing (sorted by objectness)
+    assert (np.diff(s) <= 1e-6).all()
+    assert rois.shape == (4, 5) and scores.shape == (4, 1)

@@ -64,10 +64,7 @@ def test_sync_bn_axis_name_shard_map():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from mxnet_tpu.ops import registry as reg
 
     op = reg.get_op("SyncBatchNorm")

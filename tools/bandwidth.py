@@ -31,7 +31,7 @@ def main():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     devs = jax.devices()
     n = len(devs)
@@ -52,7 +52,7 @@ def main():
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(args.axis),
                                out_specs=P() if args.collective !=
                                "ppermute" else P(args.axis),
-                               check_rep=False))
+                               check_vma=False))
         x = jnp.ones((per_dev * n,), jnp.float32)
         fn(x).block_until_ready()            # compile
         t0 = time.perf_counter()

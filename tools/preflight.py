@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Pre-snapshot gate: the full test suite AND the multi-chip dryrun.
+
+Run this before EVERY snapshot/commit of consequence:
+
+    python tools/preflight.py            # pytest + dryrun_multichip(8)
+    python tools/preflight.py --fast     # dryrun only (seconds)
+
+Both legs run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), the same configuration
+the driver uses for ``MULTICHIP_r*.json`` — so a green preflight means
+the driver gate passes too. Exits non-zero on any failure.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+               " --xla_force_host_platform_device_count=8").strip(),
+)
+
+
+def run(name, cmd):
+    print("== preflight: %s ==" % name, flush=True)
+    rc = subprocess.call(cmd, cwd=REPO, env=ENV)
+    print("== preflight: %s -> %s ==" % (name, "OK" if rc == 0 else
+                                         "FAIL rc=%d" % rc), flush=True)
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="skip pytest; dryrun_multichip only")
+    ap.add_argument("--pytest-args", default="-q",
+                    help="extra args for pytest (default -q)")
+    args = ap.parse_args()
+
+    rcs = []
+    if not args.fast:
+        rcs.append(run("pytest", [sys.executable, "-m", "pytest", "tests/"]
+                       + args.pytest_args.split()))
+    rcs.append(run("dryrun_multichip(8)",
+                   [sys.executable, "__graft_entry__.py"]))
+    if any(rcs):
+        print("PREFLIGHT FAILED", flush=True)
+        return 1
+    print("PREFLIGHT OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
