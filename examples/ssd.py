@@ -1,0 +1,183 @@
+"""SSD-style single-shot detector smoke training.
+
+Capability analog of the reference's SSD example (reference:
+example/ssd/train.py + symbol/symbol_builder.py): a small conv backbone
+produces two feature maps; per-map MultiBoxPrior anchors with cls/loc
+convolution heads; MultiBoxTarget assigns training targets with hard
+negative mining; loss = softmax CE over classes (ignoring -1 anchors) +
+smooth-L1 on the masked location offsets; MultiBoxDetection decodes at
+inference. Everything jits through the standard autograd path — the
+matching/NMS ops are the vectorized TPU formulations in
+ops/detection_ops.py.
+
+Run: python examples/ssd.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx                                     # noqa: E402
+from mxnet_tpu import autograd, nd                         # noqa: E402
+from mxnet_tpu import optimizer as opt                     # noqa: E402
+
+
+def _conv(x, w, b, stride=1):
+    y = nd.Convolution(x, w, b, kernel=w.shape[2:], stride=(stride, stride),
+                       pad=(1, 1), num_filter=w.shape[0])
+    return nd.relu(y)
+
+
+class TinySSD(object):
+    """Two-scale SSD head over a 3-layer backbone."""
+
+    def __init__(self, num_classes=3, seed=0):
+        rng = np.random.RandomState(seed)
+        self.num_classes = num_classes          # foreground classes
+        self.sizes = [(0.2, 0.35), (0.5, 0.75)]
+        self.ratios = (1.0, 2.0, 0.5)
+        self.anchors_per_pos = len(self.sizes[0]) + len(self.ratios) - 1
+
+        def W(*shape):
+            a = nd.array((rng.randn(*shape) * 0.05).astype(np.float32))
+            a.attach_grad()
+            return a
+
+        c = 16
+        self.params = {
+            "c1": W(c, 3, 3, 3), "b1": W(c),
+            "c2": W(c, c, 3, 3), "b2": W(c),
+            "c3": W(c, c, 3, 3), "b3": W(c),
+            # heads: cls (A*(C+1)) and loc (A*4) per feature map
+            "cls1": W(self.anchors_per_pos * (num_classes + 1), c, 3, 3),
+            "clb1": W(self.anchors_per_pos * (num_classes + 1)),
+            "loc1": W(self.anchors_per_pos * 4, c, 3, 3),
+            "lob1": W(self.anchors_per_pos * 4),
+            "cls2": W(self.anchors_per_pos * (num_classes + 1), c, 3, 3),
+            "clb2": W(self.anchors_per_pos * (num_classes + 1)),
+            "loc2": W(self.anchors_per_pos * 4, c, 3, 3),
+            "lob2": W(self.anchors_per_pos * 4),
+        }
+
+    def all_params(self):
+        return list(self.params.values())
+
+    def forward(self, x):
+        p = self.params
+        f1 = _conv(_conv(x, p["c1"], p["b1"], 2), p["c2"], p["b2"], 2)
+        f2 = _conv(f1, p["c3"], p["b3"], 2)
+        anchors, cls_preds, loc_preds = [], [], []
+        for feat, si, ci, li, cb, lb in ((f1, 0, "cls1", "loc1", "clb1",
+                                          "lob1"),
+                                         (f2, 1, "cls2", "loc2", "clb2",
+                                          "lob2")):
+            anchors.append(nd.contrib.MultiBoxPrior(
+                feat, sizes=self.sizes[si], ratios=self.ratios))
+            cp = nd.Convolution(feat, p[ci], p[cb], kernel=(3, 3),
+                                pad=(1, 1), num_filter=p[ci].shape[0])
+            lp = nd.Convolution(feat, p[li], p[lb], kernel=(3, 3),
+                                pad=(1, 1), num_filter=p[li].shape[0])
+            B = cp.shape[0]
+            n_pos = cp.shape[2] * cp.shape[3]
+            cls_preds.append(cp.transpose((0, 2, 3, 1)).reshape(
+                (B, n_pos * self.anchors_per_pos, self.num_classes + 1)))
+            loc_preds.append(lp.transpose((0, 2, 3, 1)).reshape(
+                (B, n_pos * self.anchors_per_pos * 4)))
+        anchors = nd.concat(*anchors, dim=1)
+        cls_preds = nd.concat(*cls_preds, dim=1)   # (B, N, C+1)
+        loc_preds = nd.concat(*loc_preds, dim=1)   # (B, N*4)
+        return anchors, cls_preds, loc_preds
+
+
+def ssd_loss(cls_preds, cls_target, loc_preds, loc_target, loc_mask):
+    """CE over anchors with target >= 0 (ignore -1) + smooth L1 on the
+    masked offsets (reference: example/ssd MultiBoxTarget training)."""
+    valid = cls_target >= 0
+    tgt = nd.broadcast_maximum(cls_target, 0 * cls_target)
+    logp = nd.log_softmax(cls_preds, axis=-1)
+    ce = -nd.pick(logp, tgt, axis=-1) * valid
+    cls_loss = nd.sum(ce) / nd.broadcast_maximum(nd.sum(valid), 1 + 0 * valid[0, 0])
+    diff = nd.abs((loc_preds - loc_target) * loc_mask)
+    sl1 = nd.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = nd.sum(sl1) / nd.broadcast_maximum(nd.sum(loc_mask),
+                                        1 + 0 * loc_mask[0, 0])
+    return cls_loss + loc_loss
+
+
+def synthetic_batch(batch, size, num_classes, rng):
+    """Images with one bright axis-aligned rectangle per class id."""
+    imgs = rng.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((batch, 2, 5), -1.0, np.float32)
+    for b in range(batch):
+        for o in range(rng.randint(1, 3)):
+            cls = rng.randint(0, num_classes)
+            x1, y1 = rng.uniform(0.05, 0.5, 2)
+            w, h = rng.uniform(0.2, 0.45, 2)
+            x2, y2 = min(x1 + w, 0.95), min(y1 + h, 0.95)
+            labels[b, o] = [cls, x1, y1, x2, y2]
+            xi = slice(int(x1 * size), int(x2 * size))
+            yi = slice(int(y1 * size), int(y2 * size))
+            imgs[b, cls % 3, yi, xi] = 1.0
+    return imgs, labels
+
+
+def train(epochs=3, steps_per_epoch=8, batch=8, size=64, num_classes=3,
+          lr=0.1, log=print):
+    net = TinySSD(num_classes=num_classes)
+    optim = opt.create("sgd", learning_rate=lr, momentum=0.9)
+    params = net.all_params()
+    states = {i: optim.create_state(i, p) for i, p in enumerate(params)}
+    rng = np.random.RandomState(0)
+    losses = []
+    for epoch in range(epochs):
+        tot = 0.0
+        for _ in range(steps_per_epoch):
+            imgs, labels = synthetic_batch(batch, size, num_classes, rng)
+            x = nd.array(imgs)
+            y = nd.array(labels)
+            with autograd.record():
+                anchors, cls_preds, loc_preds = net.forward(x)
+                with autograd.pause():
+                    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                        anchors, y, cls_preds.transpose((0, 2, 1)),
+                        negative_mining_ratio=3.0)
+                loss = ssd_loss(cls_preds, cls_t, loc_preds, loc_t, loc_m)
+            loss.backward()
+            for i, p in enumerate(params):
+                optim.update(i, p, p.grad, states[i])
+            tot += float(loss.asscalar())
+        losses.append(tot / steps_per_epoch)
+        log("epoch %d: loss %.4f" % (epoch, losses[-1]))
+    return losses, net
+
+
+def detect(net, imgs):
+    """Decode detections for a batch of images."""
+    x = nd.array(imgs)
+    anchors, cls_preds, loc_preds = net.forward(x)
+    probs = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    return nd.contrib.MultiBoxDetection(probs, loc_preds, anchors,
+                                        nms_threshold=0.45, threshold=0.1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    losses, net = train(epochs=args.num_epoch, batch=args.batch_size,
+                        lr=args.lr)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    rng = np.random.RandomState(7)
+    imgs, _ = synthetic_batch(4, 64, 3, rng)
+    out = detect(net, imgs)
+    print("detections:", out.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -168,6 +168,46 @@ def record_op(op, attrs, inputs, outputs, key=None):
 # backward
 # ---------------------------------------------------------------------------
 
+class RowSparseCT:
+    """A row-sparse cotangent flowing through the tape: (indices, values)
+    over ``shape``. Produced by sparse-grad ops (SparseEmbedding, csr
+    dot); stays sparse through accumulation so a large-vocab embedding
+    backward never materialises an O(vocab) dense gradient (reference
+    capability: row_sparse gradients, python/mxnet/ndarray/sparse.py +
+    optimizer lazy_update). Densified on demand when it flows into an op
+    that needs a dense cotangent."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices
+        self.values = values
+        self.shape = tuple(shape)
+
+    def to_dense(self):
+        # cotangent indices may contain duplicates (repeated embedding
+        # ids, repeated csr column ids) — densify by scatter-ADD, not
+        # set, or duplicate contributions overwrite each other
+        import jax.numpy as jnp
+        out = jnp.zeros(self.shape, dtype=self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def concat(self, other):
+        import jax.numpy as jnp
+        return RowSparseCT(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+    def aggregated(self):
+        """Canonical form: unique sorted indices, duplicates summed."""
+        from .ops.sparse_ops import rsp_aggregate
+        idx, vals = rsp_aggregate(self.indices, self.values)
+        return RowSparseCT(idx, vals, self.shape)
+
+
+def _densify_ct(g):
+    return g.to_dense() if isinstance(g, RowSparseCT) else g
+
 @functools.lru_cache(maxsize=None)
 def _vjp_fn(name, attr_key, with_key):
     """Jitted (inputs, cotangents) -> input gradients for one (op, attrs)."""
@@ -226,7 +266,12 @@ def _run_backward(heads, head_grads=None):
 
     def add_grad(node, g):
         prev = grad_map.get(id(node))
-        grad_map[id(node)] = g if prev is None else prev + g
+        if prev is None:
+            grad_map[id(node)] = g
+        elif isinstance(prev, RowSparseCT) and isinstance(g, RowSparseCT):
+            grad_map[id(node)] = prev.concat(g)
+        else:
+            grad_map[id(node)] = _densify_ct(prev) + _densify_ct(g)
 
     for i, h in enumerate(heads):
         if head_grads is None or head_grads[i] is None:
@@ -250,7 +295,7 @@ def _run_backward(heads, head_grads=None):
         cts = []
         needed = False
         for i, onode in enumerate(entry.output_nodes):
-            g = grad_map.get(id(onode))
+            g = _densify_ct(grad_map.get(id(onode)))
             if g is None:
                 # zero cotangent for unused outputs
                 arr = onode.array_ref() if onode.array_ref else None
@@ -270,13 +315,19 @@ def _run_backward(heads, head_grads=None):
             continue
         custom_bwd = getattr(entry.op, "custom_bwd", None)
         if custom_bwd is not None:
-            # autograd.Function: user-supplied backward
+            # autograd.Function: user-supplied backward (may return
+            # RowSparseNDArray for sparse-grad inputs)
             in_grads = custom_bwd(tuple(cts))
             for node, g in zip(entry.input_nodes, in_grads):
                 if node is None or g is None:
                     continue
                 from .ndarray.ndarray import NDArray as _ND
-                add_grad(node, g._data if isinstance(g, _ND) else g)
+                from .ndarray.sparse import RowSparseNDArray as _RSP
+                if isinstance(g, _RSP):
+                    g = RowSparseCT(g.indices, g.data, g.shape)
+                elif isinstance(g, _ND):
+                    g = g._data
+                add_grad(node, g)
             continue
         with_key = entry.key is not None
         inputs = ((entry.key,) + entry.input_values) if with_key \
@@ -329,13 +380,39 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         arr = node.array_ref() if node.array_ref else None
         if arr is None:
             continue
+        if isinstance(g, RowSparseCT):
+            from .ndarray.sparse import RowSparseNDArray
+            agg = g.aggregated()
+            if node.grad_req == "add" and isinstance(arr.grad,
+                                                     RowSparseNDArray):
+                both = RowSparseCT(arr.grad.indices, arr.grad.data,
+                                   g.shape).concat(agg).aggregated()
+                arr.grad = RowSparseNDArray(both.values, both.indices,
+                                            g.shape, ctx=arr.context)
+            elif node.grad_req == "add" and arr.grad is not None:
+                # mixed dense/sparse accumulation: correctness over
+                # laziness (grad_req='write', the default, stays sparse)
+                arr.grad._set_data(arr.grad._data + agg.to_dense())
+            else:
+                arr.grad = RowSparseNDArray(agg.values, agg.indices,
+                                            g.shape, ctx=arr.context)
+            continue
         if node.grad_req == "add" and arr.grad is not None:
-            arr.grad._set_data(arr.grad._data + g)
+            from .ndarray.sparse import RowSparseNDArray
+            if isinstance(arr.grad, RowSparseNDArray):
+                arr.grad = arr.grad + type(arr)(g, ctx=arr.context)
+            else:
+                arr.grad._set_data(arr.grad._data + g)
         else:
             if arr.grad is None:
                 from .ndarray.ndarray import zeros
                 arr.grad = zeros(arr.shape, ctx=arr.context, dtype=arr.dtype)
-            arr.grad._set_data(g)
+            from .ndarray.sparse import RowSparseNDArray
+            if isinstance(arr.grad, RowSparseNDArray):
+                from .ndarray.ndarray import NDArray
+                arr.grad = NDArray(g, ctx=arr.context)
+            else:
+                arr.grad._set_data(g)
 
 
 def _normalize(out):

@@ -25,7 +25,10 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
            "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
-           "LightingAug", "ColorJitterAug", "CreateAugmenter", "ImageIter"]
+           "LightingAug", "ColorJitterAug", "CreateAugmenter", "ImageIter",
+           "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetForceResizeAug",
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 def _cv2():
@@ -476,3 +479,265 @@ class ImageIter(object):
 
     def __iter__(self):
         return self
+
+
+# ---------------------------------------------------------------------------
+# detection augmenters + iterator (reference:
+# python/mxnet/image/detection.py, src/io/image_det_aug_default.cc:1 —
+# every geometric transform updates the box labels in lockstep)
+# ---------------------------------------------------------------------------
+
+class DetAugmenter(object):
+    """Detection augmenter: ``(image, label) -> (image, label)`` where
+    label rows are [cls, xmin, ymin, xmax, ymax] in [0,1] image coords
+    (reference: detection.py DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a color/cast-only classification augmenter into detection
+    (labels pass through untouched)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and x-coordinates with probability p."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src)[:, ::-1]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[:, 1].copy()
+            label[:, 1] = _np.where(valid, 1.0 - label[:, 3], label[:, 1])
+            label[:, 3] = _np.where(valid, 1.0 - x1, label[:, 3])
+            return array(_np.ascontiguousarray(arr)), label
+        return src, label
+
+
+def _boxes_iou_cover(label, box):
+    """Fraction of each gt box's area covered by crop ``box``."""
+    x1 = _np.maximum(label[:, 1], box[0])
+    y1 = _np.maximum(label[:, 2], box[1])
+    x2 = _np.minimum(label[:, 3], box[2])
+    y2 = _np.minimum(label[:, 4], box[3])
+    inter = _np.maximum(x2 - x1, 0) * _np.maximum(y2 - y1, 0)
+    area = _np.maximum((label[:, 3] - label[:, 1]) *
+                       (label[:, 4] - label[:, 2]), 1e-12)
+    return inter / area
+
+
+def _update_det_labels(label, box):
+    """Re-express labels in crop/pad box coords; drop boxes whose center
+    leaves the region (reference: detection.py _update_labels)."""
+    out = label.copy()
+    bw = box[2] - box[0]
+    bh = box[3] - box[1]
+    cx = (label[:, 1] + label[:, 3]) / 2
+    cy = (label[:, 2] + label[:, 4]) / 2
+    keep = ((label[:, 0] >= 0) & (cx >= box[0]) & (cx <= box[2])
+            & (cy >= box[1]) & (cy <= box[3]))
+    out[:, 1] = _np.clip((label[:, 1] - box[0]) / bw, 0, 1)
+    out[:, 2] = _np.clip((label[:, 2] - box[1]) / bh, 0, 1)
+    out[:, 3] = _np.clip((label[:, 3] - box[0]) / bw, 0, 1)
+    out[:, 4] = _np.clip((label[:, 4] - box[1]) / bh, 0, 1)
+    out[~keep] = -1.0
+    # compact valid rows to the front like the reference
+    order = _np.argsort(~keep, kind="stable")
+    return out[order]
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (SSD-style; reference: detection.py
+    DetRandomCropAug): sample candidate crops until one keeps at least
+    ``min_object_covered`` of some object, then remap labels."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.3, 1.0), max_attempts=30, p=0.5):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() > self.p:
+            return src, label
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, _np.sqrt(area * ratio))
+            ch = min(1.0, _np.sqrt(area / ratio))
+            cx = _pyrandom.uniform(0, 1 - cw)
+            cy = _pyrandom.uniform(0, 1 - ch)
+            box = (cx, cy, cx + cw, cy + ch)
+            valid = label[:, 0] >= 0
+            if not valid.any():
+                break
+            cover = _boxes_iou_cover(label[valid], box)
+            if cover.max() >= self.min_object_covered:
+                x0, y0 = int(cx * w), int(cy * h)
+                x1, y1 = int((cx + cw) * w), int((cy + ch) * h)
+                cropped = _np.ascontiguousarray(arr[y0:y1, x0:x1])
+                return array(cropped), _update_det_labels(label, box)
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger mean-filled canvas and
+    shrink the boxes accordingly (reference: detection.py
+    DetRandomPadAug)."""
+
+    def __init__(self, area_range=(1.0, 3.0), aspect_ratio_range=(0.75,
+                 1.33), fill=127, p=0.5):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.fill = fill
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() > self.p:
+            return src, label
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        area = _pyrandom.uniform(*self.area_range)
+        ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+        nw = max(w, int(w * _np.sqrt(area * ratio)))
+        nh = max(h, int(h * _np.sqrt(area / ratio)))
+        x0 = _pyrandom.randint(0, nw - w)
+        y0 = _pyrandom.randint(0, nh - h)
+        canvas = _np.full((nh, nw) + arr.shape[2:], self.fill, arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        # pad box in ORIGINAL normalized coords is the inverse crop
+        box = (-x0 / w, -y0 / h, (nw - x0) / w, (nh - y0) / h)
+        return array(canvas), _update_det_labels(label, box)
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Resize to exact (w, h); normalized labels are resize-invariant."""
+
+    def __init__(self, size, interp=2):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1],
+                        self.interp), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       min_object_covered=0.3, area_range=(0.3, 3.0),
+                       aspect_ratio_range=(0.75, 1.33), **kwargs):
+    """Standard detection pipeline (reference: detection.py
+    CreateDetAugmenter): photometric borrow-augs + geometric det-augs +
+    final force-resize to the network input."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize)))
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(area_range[0], min(1.0, area_range[1])),
+            p=rand_crop))
+    if rand_pad > 0:
+        auglist.append(DetRandomPadAug(
+            area_range=(max(1.0, area_range[0]), max(1.0, area_range[1])),
+            aspect_ratio_range=aspect_ratio_range, p=rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1])))
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: batches of (data, label (B, max_obj, 5))
+    with joint image/box augmentation (reference: detection.py
+    ImageDetIter over src/io/image_det_aug_default.cc).
+
+    Accepted label layouts per image: flat [cls, x1, y1, x2, y2] * k,
+    or the reference's packed header [header_width, obj_width,
+    (header...), objects...].
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", imglist=None,
+                 shuffle=False, aug_list=None, max_objects=None,
+                 dtype="float32", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, imglist=imglist,
+                         shuffle=shuffle, aug_list=[], dtype=dtype)
+        from .io import DataDesc
+        self.det_auglist = aug_list
+        if max_objects is None:
+            max_objects = self._scan_max_objects()
+        self.max_objects = int(max_objects)
+        self.provide_label = [DataDesc(
+            "label", (batch_size, self.max_objects, 5), dtype)]
+
+    @staticmethod
+    def _parse_det_label(raw):
+        raw = _np.asarray(raw, dtype=_np.float32).ravel()
+        if raw.size >= 2 and raw[0] >= 2 and raw[1] >= 5 and \
+                (raw.size - raw[0]) % raw[1] == 0 and raw[0] != 5:
+            hw, ow = int(raw[0]), int(raw[1])
+            objs = raw[hw:].reshape(-1, ow)[:, :5]
+        else:
+            objs = raw.reshape(-1, 5)
+        return objs
+
+    def _scan_max_objects(self):
+        if self.imglist is not None:
+            return max(len(self._parse_det_label(lbl))
+                       for lbl, _ in self.imglist.values()) or 1
+        return 16    # unindexed .rec streams: bounded default
+
+    def next(self):
+        from .io import DataBatch
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, c, h, w), dtype=self.dtype)
+        labels = _np.full((self.batch_size, self.max_objects, 5), -1.0,
+                          dtype=_np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, s = self.next_sample()
+                img = imdecode(s, 1 if c == 3 else 0)
+                objs = self._parse_det_label(raw_label)
+                padded = _np.full((self.max_objects, 5), -1.0, _np.float32)
+                padded[:len(objs)] = objs[:self.max_objects]
+                for aug in self.det_auglist:
+                    img, padded = aug(img, padded)
+                arr = img.asnumpy()
+                if arr.ndim == 3:
+                    arr = arr.transpose(2, 0, 1)
+                data[i] = arr
+                labels[i] = padded
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch(data=[array(data)], label=[array(labels)],
+                        pad=self.batch_size - i)

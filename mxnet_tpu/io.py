@@ -22,7 +22,7 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -493,6 +493,98 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """Iterate over LibSVM-format text files producing CSR data batches
+    (reference: src/io/iter_libsvm.cc — ``label idx:val idx:val ...``
+    per line, optional separate label file with multi-output rows).
+
+    Batches carry ``CSRNDArray`` data so downstream ``sparse.dot``
+    computes on the nonzeros only; labels are dense. The whole file is
+    parsed host-side once (the sparse training sets the reference
+    targets — kddb, criteo — are host-RAM scale).
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype="float32", **_kw):
+        from .ndarray import sparse as _sp
+        self._num_features = int(np.prod(data_shape))
+        vals, cols, indptr, labels = self._parse(data_libsvm, dtype)
+        if label_libsvm is not None:
+            lv, lc, lp, _ = self._parse(label_libsvm, dtype)
+            width = int(np.prod(label_shape))
+            lab = np.zeros((len(lp) - 1, width), dtype=dtype)
+            rows = np.repeat(np.arange(len(lp) - 1), np.diff(lp))
+            lab[rows, lc] = lv
+            labels = lab
+        else:
+            labels = labels.reshape(-1, 1)
+        self._vals, self._cols, self._indptr = vals, cols, indptr
+        self._labels = labels
+        self._n = len(indptr) - 1
+        self._round = round_batch
+        self._cursor = 0
+        self._sp = _sp
+        self._dtype = dtype
+        super().__init__(batch_size)
+        self.provide_data = [DataDesc("data",
+                                      (batch_size, self._num_features))]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size,) + tuple(label_shape))]
+
+    def _parse(self, path, dtype):
+        vals, cols, counts, labels = [], [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                n = 0
+                for tok in parts[1:]:
+                    i, _, v = tok.partition(":")
+                    cols.append(int(i))
+                    vals.append(float(v))
+                    n += 1
+                counts.append(n)
+        indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        return (np.asarray(vals, dtype=dtype),
+                np.asarray(cols, dtype=np.int64), indptr,
+                np.asarray(labels, dtype=dtype))
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self._n
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._n)
+        pad = self.batch_size - (hi - lo)
+        if pad and not self._round:
+            # round_batch=False discards the incomplete tail batch
+            self._cursor = self._n
+            raise StopIteration
+        take = list(range(lo, hi)) + [i % self._n for i in range(pad)]
+        ptr = np.zeros(len(take) + 1, dtype=np.int64)
+        vs, cs = [], []
+        for j, r in enumerate(take):
+            s, e = self._indptr[r], self._indptr[r + 1]
+            vs.append(self._vals[s:e])
+            cs.append(self._cols[s:e])
+            ptr[j + 1] = ptr[j] + (e - s)
+        data = self._sp.CSRNDArray(
+            np.concatenate(vs) if vs else np.zeros(0, self._dtype),
+            np.concatenate(cs) if cs else np.zeros(0, np.int64), ptr,
+            (len(take), self._num_features))
+        label = array(self._labels[[t for t in take]])
+        self._cursor = hi
+        return DataBatch(data=[data], label=[label], pad=pad)
 
 
 class MNISTIter(DataIter):

@@ -28,9 +28,10 @@ def _ctype_key_value(keys, vals):
     if isinstance(keys, (str, int)):
         keys = [keys]
         vals = [vals]
+    from .ndarray.sparse import BaseSparseNDArray
     out_vals = []
     for v in vals:
-        if isinstance(v, NDArray):
+        if isinstance(v, (NDArray, BaseSparseNDArray)):
             out_vals.append([v])
         else:
             out_vals.append(list(v))
@@ -132,6 +133,30 @@ class KVStore(object):
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("please init key %r before push" % (k,))
+            from .ndarray.sparse import RowSparseNDArray
+            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                # row_sparse gradient flow (reference: kvstore_local.h
+                # PushImpl kRowSparseStorage): concat per-device rows,
+                # sum duplicates, then lazy-update or scatter-add
+                import jax.numpy as jnp
+                from .ops.sparse_ops import rsp_aggregate
+                idx = jnp.concatenate([v.indices for v in vlist])
+                data = jnp.concatenate([v.data for v in vlist])
+                i2, v2 = rsp_aggregate(idx, data)
+                agg = RowSparseNDArray(v2, i2, vlist[0].shape)
+                # (gradient compression is not applied to sparse pushes,
+                # matching the reference: kvstore_dist rejects compression
+                # for kRowSparseStorage)
+                if self._sock is not None:
+                    self._ps_call("PUSH", k, agg.todense().asnumpy())
+                elif self._updater is not None:
+                    self._updater(self._key_index(k), agg, self._store[k])
+                else:
+                    # same semantics as the dense no-updater path: the
+                    # store holds the latest reduced value, not a running
+                    # accumulation
+                    self._store[k]._set_data(agg.todense()._data)
+                continue
             agg = self._aggregate(k, vlist)
             if self._sock is not None:
                 # PS hop: local reduce -> (compress) -> ZPush analog

@@ -22,12 +22,32 @@ from .ndarray import NDArray, array as _dense_array
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "row_sparse_array", "csr_matrix", "array", "zeros", "empty",
-           "retain", "dot"]
+           "retain", "dot", "embedding"]
 
 
 def _jnp():
     import jax.numpy as jnp
     return jnp
+
+
+def _as_index(a, shape):
+    """Cast indices to the platform index dtype EXPLICITLY.
+
+    JAX disables 64-bit by default, so a bare ``asarray(..., int64)``
+    silently truncates with a warning. Here the policy is explicit:
+    int64 when x64 is enabled, else int32 after a bounds check — any
+    dimension that genuinely needs 64-bit indices raises instead of
+    truncating (reference contract: ndarray.h int64 sparse indices)."""
+    import jax
+    jnp = _jnp()
+    if jax.config.jax_enable_x64:
+        return jnp.asarray(a, dtype=jnp.int64)
+    limit = _np.iinfo(_np.int32).max
+    if shape and max(shape) > limit:
+        raise MXNetError(
+            "sparse index dimension %d exceeds int32 range; enable "
+            "jax_enable_x64 for 64-bit sparse indices" % max(shape))
+    return jnp.asarray(a, dtype=jnp.int32)
 
 
 class BaseSparseNDArray(object):
@@ -99,7 +119,7 @@ class RowSparseNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, shape, dtype=None, ctx=None):
         jnp = _jnp()
         self.data = jnp.asarray(data)
-        self.indices = jnp.asarray(indices, dtype=jnp.int64)
+        self.indices = _as_index(indices, shape)
         super().__init__(shape, dtype or self.data.dtype, ctx)
 
     @property
@@ -115,11 +135,10 @@ class RowSparseNDArray(BaseSparseNDArray):
                                 self.shape, dtype, self._ctx)
 
     def retain(self, to_retain):
-        jnp = _jnp()
         if isinstance(to_retain, NDArray):
             to_retain = to_retain._data
         idx, vals = _sk.rsp_retain(self.indices, self.data,
-                                   jnp.asarray(to_retain, jnp.int64))
+                                   _as_index(to_retain, self.shape))
         return RowSparseNDArray(vals, idx, self.shape, self.dtype,
                                 self._ctx)
 
@@ -145,8 +164,8 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, indptr, shape, dtype=None, ctx=None):
         jnp = _jnp()
         self.data = jnp.asarray(data)
-        self.indices = jnp.asarray(indices, dtype=jnp.int64)
-        self.indptr = jnp.asarray(indptr, dtype=jnp.int64)
+        self.indices = _as_index(indices, shape)
+        self.indptr = _as_index(indptr, (len(self.data) + 1,))
         super().__init__(shape, dtype or self.data.dtype, ctx)
 
     @property
@@ -249,16 +268,82 @@ def retain(data, indices):
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse dot (reference: src/operator/tensor/dot-inl.h sparse
-    paths): csr x dense and dense x dense fallbacks."""
+    paths): csr x dense (differentiable w.r.t. the dense rhs, with a
+    ROW-SPARSE gradient covering only the feature columns present in
+    the csr batch) and dense x dense fallbacks."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
         if transpose_b:
             raise MXNetError("transpose_b unsupported for csr dot")
-        out = _sk.csr_dot_dense(lhs.shape, lhs.data, lhs.indices,
-                                lhs.indptr, rhs._data,
-                                transpose_lhs=transpose_a)
-        return NDArray(out, ctx=rhs.context)
+        return _CsrDotDense(lhs, transpose_a)(rhs)
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         from . import dot as _dense_dot
         return _dense_dot(lhs, rhs, transpose_a, transpose_b)
     raise MXNetError("unsupported sparse dot combination: %s x %s"
                      % (type(lhs).__name__, type(rhs).__name__))
+
+
+class _CsrDotDense(object):
+    """autograd-recorded dot(csr, dense W): forward is the segment-sum
+    kernel; backward w.r.t. W is row_sparse over the columns the batch
+    actually touched — dW[c] += X[r,c] * dY[r] per stored nonzero
+    (reference: dot-inl.h DotCsrDnsRspImpl backward)."""
+
+    def __init__(self, csr, transpose_a):
+        self._csr = csr
+        self._ta = transpose_a
+
+    def __call__(self, rhs):
+        from .. import autograd as ag
+        csr = self._csr
+        ta = self._ta
+
+        class _Fn(ag.Function):
+            def forward(self, w):
+                out = _sk.csr_dot_dense(csr.shape, csr.data, csr.indices,
+                                        csr.indptr, w._data,
+                                        transpose_lhs=ta)
+                return NDArray(out, ctx=w.context)
+
+            def backward(self, dout):
+                jnp = _jnp()
+                if ta:
+                    # out = X^T W with W (m, k): dW = X dY (dense rows)
+                    dw = _sk.csr_dot_dense(csr.shape, csr.data,
+                                           csr.indices, csr.indptr,
+                                           dout._data)
+                    return NDArray(dw)
+                nnz = csr.data.shape[0]
+                rows = jnp.searchsorted(
+                    csr.indptr, jnp.arange(nnz, dtype=csr.indptr.dtype),
+                    side="right") - 1
+                vals = csr.data[:, None] * dout._data[rows]    # (nnz, k)
+                return RowSparseNDArray(
+                    vals, csr.indices, (csr.shape[1],) + dout.shape[1:])
+
+        return _Fn()(rhs)
+
+
+def embedding(data, weight, sparse_grad=True):
+    """Embedding lookup whose weight gradient is ROW-SPARSE over the ids
+    present in the batch (reference: src/operator/tensor/indexing_op.cc
+    SparseEmbedding / Embedding with sparse_grad): O(batch) optimizer
+    work per step via the lazy-update kernels instead of O(vocab)."""
+    from .. import autograd as ag
+    if not sparse_grad:
+        from . import Embedding as _dense_embedding
+        return _dense_embedding(data, weight, input_dim=weight.shape[0],
+                                output_dim=weight.shape[1])
+
+    class _Fn(ag.Function):
+        def forward(self, ids, w):
+            jnp = _jnp()
+            self._ids = _as_index(ids._data, w.shape)
+            self._vocab = w.shape
+            return NDArray(w._data[self._ids], ctx=w.context)
+
+        def backward(self, dout):
+            flat = self._ids.reshape(-1)
+            vals = dout._data.reshape((flat.shape[0],) + self._vocab[1:])
+            return None, RowSparseNDArray(vals, flat, self._vocab)
+
+    return _Fn()(data, weight)

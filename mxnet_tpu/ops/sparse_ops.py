@@ -15,7 +15,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["dense_to_rsp", "rsp_to_dense", "dense_to_csr", "csr_to_dense",
-           "csr_dot_dense", "rsp_retain", "rsp_add_rsp", "dot_dense_t_dense_rsp"]
+           "csr_dot_dense", "rsp_retain", "rsp_add_rsp",
+           "dot_dense_t_dense_rsp", "rsp_sgd_update", "rsp_sgd_mom_update",
+           "rsp_adam_update", "rsp_aggregate"]
 
 
 def dense_to_rsp(dense):
@@ -99,3 +101,64 @@ def dot_dense_t_dense_rsp(lhs, rhs):
     """dot(dense^T, dense) producing row_sparse gradient layout
     (embedding-gradient pattern, reference: dot-inl.h)."""
     return jnp.matmul(lhs.T, rhs)
+
+
+# ---------------------------------------------------------------------------
+# row_sparse lazy-update optimizer kernels (reference:
+# src/operator/optimizer_op.cc SGDUpdateRspImpl / AdamUpdateRspImpl:
+# "lazy" semantics — only rows present in the gradient are touched, so
+# an embedding update costs O(batch rows), not O(vocab))
+# ---------------------------------------------------------------------------
+
+def _prep_grad(vals, rescale, clip):
+    g = vals * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def rsp_sgd_update(weight, idx, vals, lr, wd=0.0, rescale=1.0, clip=None):
+    """Lazy SGD: rows[idx] -= lr * (grad + wd * rows[idx]). ``idx`` must
+    be duplicate-free (aggregate with rsp_aggregate first)."""
+    rows = weight[idx]
+    g = _prep_grad(vals, rescale, clip) + wd * rows
+    return weight.at[idx].set(rows - lr * g)
+
+
+def rsp_sgd_mom_update(weight, mom, idx, vals, lr, momentum, wd=0.0,
+                       rescale=1.0, clip=None):
+    """Lazy SGD+momentum: momentum state of untouched rows is left as-is
+    (the reference's lazy_update=True contract)."""
+    rows = weight[idx]
+    g = _prep_grad(vals, rescale, clip) + wd * rows
+    m_rows = mom[idx] * momentum - lr * g
+    return weight.at[idx].set(rows + m_rows), mom.at[idx].set(m_rows)
+
+
+def rsp_adam_update(weight, mean, var, idx, vals, lr, beta1, beta2,
+                    epsilon, wd=0.0, rescale=1.0, clip=None):
+    """Lazy Adam on the touched rows only (reference:
+    optimizer_op.cc AdamUpdateRspImpl)."""
+    rows = weight[idx]
+    g = _prep_grad(vals, rescale, clip) + wd * rows
+    m_rows = beta1 * mean[idx] + (1 - beta1) * g
+    v_rows = beta2 * var[idx] + (1 - beta2) * g * g
+    step = lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    return (weight.at[idx].set(rows - step),
+            mean.at[idx].set(m_rows), var.at[idx].set(v_rows))
+
+
+def rsp_aggregate(indices, values):
+    """Combine duplicate row indices by summation, returning
+    (unique_sorted_indices, summed_values) — the canonical row_sparse
+    form the reference maintains on gradient aggregation. Host-side
+    (eager) because the result shape is data-dependent."""
+    import numpy as np
+    idx_np = np.asarray(indices)
+    uniq, inv = np.unique(idx_np, return_inverse=True)
+    if uniq.shape[0] == idx_np.shape[0]:
+        order = np.argsort(idx_np, kind="stable")
+        return jnp.asarray(idx_np[order]), values[jnp.asarray(order)]
+    summed = jax.ops.segment_sum(values, jnp.asarray(inv),
+                                 num_segments=int(uniq.shape[0]))
+    return jnp.asarray(uniq), summed

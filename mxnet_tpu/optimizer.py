@@ -192,6 +192,11 @@ def _common_kwargs(opt):
     return kw
 
 
+def _is_row_sparse(grad):
+    from .ndarray.sparse import RowSparseNDArray
+    return isinstance(grad, RowSparseNDArray)
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum and optional multi-precision
@@ -211,6 +216,25 @@ class SGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = _common_kwargs(self)
+        if _is_row_sparse(grad):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                # lazy path: touch only the rows present in the gradient
+                # (reference: optimizer_op.cc SGDUpdateRspImpl)
+                from .ops import sparse_ops as _sk
+                clip = self.clip_gradient
+                if state is not None:
+                    w, m = _sk.rsp_sgd_mom_update(
+                        weight._data, state._data, grad.indices, grad.data,
+                        lr, self.momentum, wd, self.rescale_grad, clip)
+                    weight._set_data(w)
+                    state._set_data(m)
+                else:
+                    weight._set_data(_sk.rsp_sgd_update(
+                        weight._data, grad.indices, grad.data, lr, wd,
+                        self.rescale_grad, clip))
+                return
         if state is not None:
             nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
                               momentum=self.momentum, **kw)
@@ -305,6 +329,20 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= numpy.sqrt(coef2) / coef1
         mean, var = state
+        if _is_row_sparse(grad):
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                # lazy Adam (reference: optimizer_op.cc AdamUpdateRspImpl)
+                from .ops import sparse_ops as _sk
+                w, m, v = _sk.rsp_adam_update(
+                    weight._data, mean._data, var._data, grad.indices,
+                    grad.data, lr, self.beta1, self.beta2, self.epsilon,
+                    wd, self.rescale_grad, self.clip_gradient)
+                weight._set_data(w)
+                mean._set_data(m)
+                var._set_data(v)
+                return
         kw = _common_kwargs(self)
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2,

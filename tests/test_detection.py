@@ -5,11 +5,16 @@ Reference behavior: src/operator/contrib/multibox_target.cc,
 multibox_detection.cc, proposal.cc, src/io/image_det_aug_default.cc,
 python/mxnet/image/detection.py.
 """
+import os
+import sys
+
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import ndarray as nd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 # ---------------------------------------------------------------------------
@@ -178,3 +183,89 @@ def test_proposal_output_score_and_order():
     # scores non-increasing (sorted by objectness)
     assert (np.diff(s) <= 1e-6).all()
     assert rois.shape == (4, 5) and scores.shape == (4, 1)
+
+
+# ---------------------------------------------------------------------------
+# ImageDetIter + detection augmenters
+
+
+def _make_det_dataset(tmp_path, n=6, size=48):
+    import cv2
+    paths = []
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        p = os.path.join(str(tmp_path), "im%d.png" % i)
+        cv2.imwrite(p, img)
+        # two boxes, flat [cls, x1, y1, x2, y2] * 2
+        lbl = [0, 0.1, 0.1, 0.4, 0.5, 1, 0.5, 0.4, 0.9, 0.8]
+        paths.append((lbl, "im%d.png" % i))
+    return paths
+
+
+def test_image_det_iter_shapes_and_labels(tmp_path):
+    import mxnet_tpu.image as img
+    data = _make_det_dataset(tmp_path)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          imglist=data, path_root=str(tmp_path),
+                          aug_list=[img.DetForceResizeAug((32, 32))])
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4, 2, 5)
+    lab = b.label[0].asnumpy()
+    np.testing.assert_allclose(lab[0, 0], [0, 0.1, 0.1, 0.4, 0.5],
+                               rtol=1e-5)
+
+
+def test_det_horizontal_flip_updates_boxes(tmp_path):
+    import mxnet_tpu.image as img
+    arr = mx.nd.array(np.zeros((10, 10, 3), np.uint8))
+    lbl = np.array([[0, 0.1, 0.2, 0.4, 0.6], [-1] * 5], np.float32)
+    aug = img.DetHorizontalFlipAug(p=1.1)   # always flip
+    _, out = aug(arr, lbl)
+    np.testing.assert_allclose(out[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    np.testing.assert_allclose(out[1], [-1] * 5)   # padding untouched
+
+
+def test_det_random_crop_keeps_coverage():
+    import mxnet_tpu.image as img
+    rng = np.random.RandomState(0)
+    arr = mx.nd.array((rng.rand(40, 40, 3) * 255).astype(np.uint8))
+    lbl = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    aug = img.DetRandomCropAug(min_object_covered=0.5, p=1.1)
+    out_img, out_lbl = aug(arr, lbl)
+    valid = out_lbl[out_lbl[:, 0] >= 0]
+    if len(valid):    # crop kept the object: coords still a proper box
+        assert (valid[:, 3] > valid[:, 1]).all()
+        assert (valid[:, 4] > valid[:, 2]).all()
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    import mxnet_tpu.image as img
+    rng = np.random.RandomState(1)
+    arr = mx.nd.array((rng.rand(20, 20, 3) * 255).astype(np.uint8))
+    lbl = np.array([[2, 0.2, 0.2, 0.8, 0.8]], np.float32)
+    aug = img.DetRandomPadAug(area_range=(2.0, 2.5), p=1.1)
+    out_img, out_lbl = aug(arr, lbl)
+    h, w = out_img.shape[:2]
+    assert h >= 20 and w >= 20 and (h > 20 or w > 20)
+    b = out_lbl[0]
+    assert b[0] == 2
+    assert (b[3] - b[1]) < 0.6 or (b[4] - b[2]) < 0.6   # shrunk
+
+
+# ---------------------------------------------------------------------------
+# SSD end-to-end smoke
+
+
+def test_ssd_trains_with_finite_decreasing_loss():
+    from examples.ssd import train, detect, synthetic_batch
+    losses, net = train(epochs=2, steps_per_epoch=4, batch=4, size=64,
+                       log=lambda *a: None)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    rng = np.random.RandomState(3)
+    imgs, _ = synthetic_batch(2, 64, 3, rng)
+    out = detect(net, imgs)
+    assert out.shape[0] == 2 and out.shape[2] == 6

@@ -1,0 +1,251 @@
+"""Sparse training slice: LibSVMIter, row-sparse gradients through the
+tape, lazy-update optimizers, kvstore rsp push, end-to-end examples.
+
+Reference behavior: src/io/iter_libsvm.cc, indexing_op.cc sparse
+embedding, dot-inl.h csr backward, optimizer_op.cc *UpdateRspImpl.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.io import LibSVMIter
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter
+
+
+def _write_libsvm(tmp_path, lines):
+    p = os.path.join(str(tmp_path), "d.libsvm")
+    with open(p, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return p
+
+
+def test_libsvm_iter_batches(tmp_path):
+    p = _write_libsvm(tmp_path, [
+        "1 0:1.0 3:2.0",
+        "0 1:3.0",
+        "1 2:4.0 4:5.0 5:6.0",
+    ])
+    it = LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=2)
+    b1 = next(it)
+    x = b1.data[0]
+    assert x.stype == "csr" and x.shape == (2, 6)
+    dense = x.asnumpy()
+    np.testing.assert_allclose(dense[0], [1, 0, 0, 2, 0, 0])
+    np.testing.assert_allclose(dense[1], [0, 3, 0, 0, 0, 0])
+    np.testing.assert_allclose(b1.label[0].asnumpy().ravel(), [1, 0])
+    b2 = next(it)
+    assert b2.pad == 1                       # wrap-padded final batch
+    np.testing.assert_allclose(b2.data[0].asnumpy()[0],
+                               [0, 0, 4, 0, 5, 6])
+    with pytest.raises(StopIteration):
+        next(it)
+    it.reset()
+    assert next(it).data[0].shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding gradient
+
+
+def test_sparse_embedding_rsp_grad_matches_dense():
+    rng = np.random.RandomState(0)
+    W = nd.array(rng.randn(50, 4).astype(np.float32))
+    W.attach_grad()
+    ids = nd.array(np.array([3, 7, 3, 9], np.float32))
+    with autograd.record():
+        out = sparse.embedding(ids, W)
+        loss = nd.sum(out * out)
+    loss.backward()
+    g = W.grad
+    assert isinstance(g, RowSparseNDArray)
+    # touched rows only, sorted unique
+    np.testing.assert_array_equal(np.asarray(g.indices), [3, 7, 9])
+    # dense check: dL/dW = scatter-add of 2*out
+    Wn = W.asnumpy()
+    expect = np.zeros_like(Wn)
+    for i, r in enumerate([3, 7, 3, 9]):
+        expect[r] += 2 * Wn[r]
+    np.testing.assert_allclose(g.todense().asnumpy(), expect, rtol=1e-5)
+
+
+def test_csr_dot_rsp_grad_matches_dense():
+    rng = np.random.RandomState(1)
+    Xd = (rng.rand(5, 8) < 0.3) * rng.randn(5, 8)
+    X = sparse.array(Xd.astype(np.float32), stype="csr")
+    W = nd.array(rng.randn(8, 3).astype(np.float32))
+    W.attach_grad()
+    with autograd.record():
+        y = sparse.dot(X, W)
+        loss = nd.sum(y * y)
+    loss.backward()
+    g = W.grad
+    assert isinstance(g, RowSparseNDArray)
+    yn = Xd @ W.asnumpy()
+    expect = Xd.T @ (2 * yn)
+    np.testing.assert_allclose(g.todense().asnumpy(), expect.astype(
+        np.float32), rtol=1e-4, atol=1e-5)
+    touched = set(np.asarray(g.indices).tolist())
+    assert touched == set(np.nonzero(Xd.any(axis=0))[0].tolist())
+
+
+# ---------------------------------------------------------------------------
+# lazy optimizers
+
+
+def test_lazy_sgd_touches_only_grad_rows():
+    W = nd.array(np.ones((6, 2), np.float32))
+    g = RowSparseNDArray(np.array([[1.0, 1.0], [2.0, 2.0]], np.float32),
+                         np.array([1, 4]), (6, 2))
+    sgd = opt.create("sgd", learning_rate=0.1, lazy_update=True)
+    sgd.update(0, W, g, sgd.create_state(0, W))
+    out = W.asnumpy()
+    np.testing.assert_allclose(out[1], 1 - 0.1 * 1)
+    np.testing.assert_allclose(out[4], 1 - 0.1 * 2)
+    for r in (0, 2, 3, 5):
+        np.testing.assert_allclose(out[r], 1.0)   # untouched
+
+
+def test_lazy_sgd_momentum_state_untouched_rows():
+    W = nd.array(np.ones((4, 2), np.float32))
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                     lazy_update=True)
+    state = sgd.create_state(0, W)
+    g1 = RowSparseNDArray(np.ones((1, 2), np.float32), np.array([2]),
+                          (4, 2))
+    sgd.update(0, W, g1, state)
+    st = state.asnumpy()
+    assert np.all(st[2] != 0) and np.all(st[[0, 1, 3]] == 0)
+
+
+def test_lazy_adam_matches_dense_on_touched_rows():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    gd = np.zeros_like(w0)
+    rows = np.array([0, 3])
+    gvals = rng.randn(2, 3).astype(np.float32)
+    gd[rows] = gvals
+
+    # dense adam
+    Wd = nd.array(w0.copy())
+    ad = opt.create("adam", learning_rate=0.01, lazy_update=False)
+    std = ad.create_state(0, Wd)
+    ad.update(0, Wd, nd.array(gd), std)
+
+    # lazy adam on the same (single-step) problem
+    Wl = nd.array(w0.copy())
+    al = opt.create("adam", learning_rate=0.01, lazy_update=True)
+    stl = al.create_state(0, Wl)
+    al.update(0, Wl, RowSparseNDArray(gvals, rows, (5, 3)), stl)
+    # touched rows match the dense update exactly on step 1
+    np.testing.assert_allclose(Wl.asnumpy()[rows], Wd.asnumpy()[rows],
+                               rtol=1e-5, atol=1e-6)
+    # untouched rows completely unchanged under lazy (dense adam moves
+    # them only via wd/eps terms; with zero grad + zero state they stay)
+    np.testing.assert_allclose(Wl.asnumpy()[[1, 2, 4]], w0[[1, 2, 4]])
+
+
+def test_duplicate_indices_aggregate_before_update():
+    # two hits on the same row must sum, not last-write-win
+    W = nd.array(np.zeros((3, 1), np.float32))
+    W.attach_grad()
+    ids = nd.array(np.array([1, 1], np.float32))
+    with autograd.record():
+        out = sparse.embedding(ids, W)
+        loss = nd.sum(out * 3.0)
+    loss.backward()
+    g = W.grad
+    np.testing.assert_array_equal(np.asarray(g.indices), [1])
+    np.testing.assert_allclose(np.asarray(g.data), [[6.0]])
+
+
+# ---------------------------------------------------------------------------
+# kvstore row_sparse push
+
+
+def test_kvstore_rsp_push_lazy_update():
+    kv = mx.kvstore.create("local")
+    W = nd.array(np.ones((5, 2), np.float32))
+    kv.init(0, W)
+    sgd = opt.create("sgd", learning_rate=0.1, lazy_update=True)
+    kv.set_optimizer(sgd)
+    g1 = RowSparseNDArray(np.ones((1, 2), np.float32), np.array([1]), (5, 2))
+    g2 = RowSparseNDArray(np.ones((1, 2), np.float32), np.array([1]), (5, 2))
+    kv.push(0, [g1, g2])                       # two device slices, same row
+    out = nd.zeros((5, 2))
+    kv.pull(0, out=out)
+    o = out.asnumpy()
+    np.testing.assert_allclose(o[1], 1 - 0.1 * 2)   # summed then updated
+    np.testing.assert_allclose(o[0], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end examples
+
+
+def test_linear_classification_trains(tmp_path):
+    from examples.sparse.linear_classification import (synthetic_libsvm,
+                                                       train)
+    p = synthetic_libsvm(os.path.join(str(tmp_path), "s.libsvm"),
+                         n=512, d=2000, nnz=8)
+    losses = train(p, 2000, batch_size=64, epochs=3, lr=0.5,
+                   log=lambda *a: None)
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_matrix_factorization_trains():
+    from examples.sparse.matrix_factorization import train
+    losses = train(num_users=200, num_items=300, factor_size=8, n=1024,
+                   batch_size=128, epochs=3, lr=0.05, log=lambda *a: None)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_duplicate_ids_into_nonleaf_weight_densify_adds():
+    # sparse ct flowing into a NON-leaf (w*2) must densify by scatter-add
+    # so duplicate ids sum (regression: .at[].set overwrote)
+    W = nd.array(np.ones((5, 1), np.float32))
+    W.attach_grad()
+    ids = nd.array(np.array([1, 1, 2], np.float32))
+    with autograd.record():
+        w2 = W * 2.0
+        out = sparse.embedding(ids, w2)
+        loss = nd.sum(out)
+    loss.backward()
+    g = W.grad.asnumpy()                     # dense (non-leaf path)
+    np.testing.assert_allclose(g.ravel(), [0, 4.0, 2.0, 0, 0])
+
+
+def test_libsvm_smaller_than_batch_wraps():
+    import tempfile, os as _os
+    p = _os.path.join(tempfile.gettempdir(), "tiny.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.0\n0 2:2.0\n")
+    it = LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=5)
+    b = next(it)
+    assert b.data[0].shape == (5, 3) and b.pad == 3
+    d = b.data[0].asnumpy()
+    np.testing.assert_allclose(d[0], d[2])   # wrapped cyclically
+    np.testing.assert_allclose(d[1], d[3])
+
+
+def test_kvstore_rsp_push_no_updater_assign_semantics():
+    kv = mx.kvstore.create("local")
+    W = nd.array(np.full((3, 1), 7.0, np.float32))
+    kv.init(0, W)
+    g = RowSparseNDArray(np.ones((1, 1), np.float32), np.array([1]), (3, 1))
+    kv.push(0, g)
+    kv.push(0, g)                            # second push must not stack
+    out = nd.zeros((3, 1))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy().ravel(), [0, 1, 0])
