@@ -1,0 +1,154 @@
+// C++ NDArray/autograd wrapper over the general C ABI
+// (include/mxnet_tpu/c_api.h). Capability analog of the reference's
+// cpp-package/include/mxnet-cpp/ndarray.h: RAII handles, typed
+// imperative op invocation (see the generated op.h), autograd record/
+// backward — enough surface for a C++ client to train a model.
+#ifndef MXNET_TPU_CPP_NDARRAY_HPP_
+#define MXNET_TPU_CPP_NDARRAY_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxnet_tpu/c_api.h"
+
+namespace mxnet_tpu_cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr) {}
+
+  NDArray(const std::vector<uint32_t>& shape, int dtype = MXTPU_FLOAT32,
+          const char* dev_type = "cpu", int dev_id = 0) {
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<uint32_t>(shape.size()), dtype,
+                          dev_type, dev_id, &handle_));
+  }
+
+  // adopt an ABI-owned handle (strong reference transferred)
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.handle_ = h;
+    return a;
+  }
+
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      Free();
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+
+  ~NDArray() { Free(); }
+
+  NDArrayHandle handle() const { return handle_; }
+
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0;
+    uint32_t buf[8] = {0};
+    Check(MXNDArrayGetShape(handle_, &ndim, buf));
+    return std::vector<uint32_t>(buf, buf + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (uint32_t d : Shape()) n *= d;
+    return n;
+  }
+
+  void CopyFrom(const std::vector<float>& src) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, src.data(),
+                                   src.size() * sizeof(float)));
+  }
+
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle_, out.data(),
+                                 out.size() * sizeof(float)));
+    return out;
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle_)); }
+
+  void AttachGrad() {
+    NDArrayHandle h = handle_;
+    Check(MXAutogradMarkVariables(1, &h));
+  }
+
+  NDArray Grad() const {
+    NDArrayHandle g = nullptr;
+    Check(MXAutogradGetGrad(handle_, &g));
+    return FromHandle(g);
+  }
+
+  void Backward() {
+    NDArrayHandle h = handle_;
+    Check(MXAutogradBackward(1, &h));
+  }
+
+ private:
+  void Free() {
+    if (handle_ != nullptr) MXNDArrayFree(handle_);
+    handle_ = nullptr;
+  }
+  NDArrayHandle handle_;
+};
+
+// Invoke one registered operator; returns its first output.
+inline NDArray Invoke(
+    const std::string& op, const std::vector<const NDArray*>& inputs,
+    const std::map<std::string, std::string>& attrs = {}) {
+  std::vector<NDArrayHandle> ins;
+  ins.reserve(inputs.size());
+  for (const NDArray* a : inputs) ins.push_back(a->handle());
+  std::vector<const char*> keys, vals;
+  for (const auto& kv : attrs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle* outs = nullptr;
+  Check(MXImperativeInvoke(op.c_str(), static_cast<int>(ins.size()),
+                           ins.data(), &n_out, &outs,
+                           static_cast<int>(keys.size()), keys.data(),
+                           vals.data()));
+  NDArray first = NDArray::FromHandle(outs[0]);
+  for (int i = 1; i < n_out; ++i) MXNDArrayFree(outs[i]);
+  return first;
+}
+
+// In-place op (optimizer updates): outputs alias inputs; drop them.
+inline void InvokeInPlace(
+    const std::string& op, const std::vector<const NDArray*>& inputs,
+    const std::map<std::string, std::string>& attrs = {}) {
+  NDArray out = Invoke(op, inputs, attrs);
+  out.WaitToRead();
+}
+
+class AutogradRecord {
+ public:
+  AutogradRecord() { Check(MXAutogradSetIsRecording(1, &prev_)); }
+  ~AutogradRecord() { MXAutogradSetIsRecording(prev_, nullptr); }
+
+ private:
+  int prev_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_NDARRAY_HPP_

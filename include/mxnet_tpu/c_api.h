@@ -1,0 +1,104 @@
+/*
+ * General C ABI for mxnet_tpu.
+ *
+ * Capability analog of the reference's include/mxnet/c_api.h (the flat
+ * ~198-function surface every language binding links against): NDArray
+ * CRUD + serialization, op discovery, imperative invoke, autograd, and
+ * the symbol/executor path. The compute engine is XLA behind an
+ * embedded CPython (see src/native/c_api.cc); this header is the
+ * stable boundary.
+ *
+ * Conventions (same as the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *  - MXGetLastError() returns the failure message for this thread's
+ *    most recent error;
+ *  - handles are opaque; free NDArray/Symbol/Executor handles with the
+ *    matching *Free call.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+
+/* dtype ids (reference: mshadow type codes) */
+#define MXTPU_FLOAT32 0
+#define MXTPU_FLOAT64 1
+#define MXTPU_FLOAT16 2
+#define MXTPU_UINT8 3
+#define MXTPU_INT32 4
+#define MXTPU_INT8 5
+#define MXTPU_INT64 6
+#define MXTPU_BFLOAT16 12
+
+const char* MXGetLastError(void);
+
+/* ---- NDArray ---------------------------------------------------- */
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dtype,
+                    const char* dev_type, int dev_id, NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle h);
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
+                      uint32_t* out_shape /* caller buf, >= 8 */);
+int MXNDArrayGetDType(NDArrayHandle h, int* out_dtype);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                             size_t nbytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, size_t nbytes);
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* arrs,
+                  const char** names /* or NULL */);
+int MXNDArrayLoad(const char* fname, uint32_t* out_num,
+                  NDArrayHandle** out_arrs, uint32_t* out_name_num,
+                  const char*** out_names);
+
+/* ---- operators --------------------------------------------------- */
+int MXListAllOpNames(uint32_t* out_num, const char*** out_names);
+int MXOpGetInfo(const char* name, const char** out_doc,
+                uint32_t* out_num_attrs, const char*** out_attr_names,
+                const char*** out_attr_defaults, int* out_num_outputs);
+/* Invoke one op. *num_outputs returns the count; *outputs is an
+ * ABI-owned array valid until the next invoke on this thread. */
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+
+/* ---- autograd ----------------------------------------------------- */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradMarkVariables(uint32_t num, NDArrayHandle* vars);
+int MXAutogradBackward(uint32_t num_heads, NDArrayHandle* heads);
+int MXAutogradGetGrad(NDArrayHandle var, NDArrayHandle* out_grad);
+
+/* ---- symbol + executor ------------------------------------------- */
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_num,
+                          const char*** out_names);
+int MXSymbolFree(SymbolHandle sym);
+/* Bind with input shapes taken from example NDArrays (name -> array). */
+int MXExecutorSimpleBind(SymbolHandle sym, uint32_t num_inputs,
+                         const char** input_names,
+                         NDArrayHandle* input_examples,
+                         ExecutorHandle* out);
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+int MXExecutorBackward(ExecutorHandle exec);
+int MXExecutorGetArg(ExecutorHandle exec, const char* name,
+                     NDArrayHandle* out);
+int MXExecutorGetGrad(ExecutorHandle exec, const char* name,
+                      NDArrayHandle* out);
+int MXExecutorOutputs(ExecutorHandle exec, uint32_t* out_num,
+                      NDArrayHandle** outputs);
+int MXExecutorFree(ExecutorHandle exec);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -42,7 +42,8 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "initializer", "init", "kvstore", "kv", "callback", "lr_scheduler",
          "profiler", "parallel", "test_utils", "image", "recordio", "engine",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
-         "checkpoint", "gradient_compression", "kvstore_server")
+         "checkpoint", "gradient_compression", "kvstore_server", "storage",
+         "config")
 
 
 def __getattr__(name):

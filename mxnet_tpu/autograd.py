@@ -114,7 +114,7 @@ class AGNode:
 
 class TapeEntry:
     __slots__ = ("op", "attrs", "input_nodes", "input_values", "key",
-                 "n_outputs", "output_nodes")
+                 "n_outputs", "output_nodes", "freed", "__weakref__")
 
     def __init__(self, op, attrs, input_nodes, input_values, key, n_outputs):
         self.op = op
@@ -124,6 +124,23 @@ class TapeEntry:
         self.key = key
         self.n_outputs = n_outputs
         self.output_nodes = []
+        self.freed = False
+        _UNFREED_ENTRIES.add(self)
+
+
+# Entries whose saved input buffers are still live. Optimizer buffer
+# donation (ops/registry.py) consults this: while ANY unfreed entry
+# exists (retain_graph=True, autograd.grad() without backward, a graph
+# recorded but not yet differentiated), a weight buffer might still be
+# read by a later backward, so donating it would be unsound. A WeakSet
+# so entries garbage-collected with their output arrays drop out.
+_UNFREED_ENTRIES = weakref.WeakSet()
+
+
+def has_live_tape():
+    """True while any recorded-but-unfreed tape entry exists (used by
+    the donation gate in ops/registry.py)."""
+    return len(_UNFREED_ENTRIES) > 0
 
 
 def mark_variable(x, grad_req="write"):
@@ -247,10 +264,17 @@ def _topo_entries(head_nodes):
     return order
 
 
-def _run_backward(heads, head_grads=None):
+def _run_backward(heads, head_grads=None, free_graph=False):
     """Walk the tape in reverse, returning (grad_map keyed by id(node),
     leaf_nodes dict). Pure with respect to NDArray state — callers decide
-    whether to write results into ``.grad`` slots."""
+    whether to write results into ``.grad`` slots.
+
+    ``free_graph=True`` (the backward() default) drops each consumed
+    entry's saved input buffers afterwards — prompt memory release, and
+    the safety condition for optimizer buffer donation (no stale tape
+    reference can read a donated weight buffer). A second backward over
+    a freed graph raises, like the reference frees its graph after
+    Backward unless retain_graph."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
 
@@ -292,6 +316,11 @@ def _run_backward(heads, head_grads=None):
                 leaf_nodes[id(n)] = n
 
     for entry in reversed(entries):
+        if entry.freed:
+            raise MXNetError(
+                "Trying to backward through a graph whose saved buffers "
+                "were already freed; pass retain_graph=True to the first "
+                "backward to differentiate it again")
         cts = []
         needed = False
         for i, onode in enumerate(entry.output_nodes):
@@ -355,6 +384,12 @@ def _run_backward(heads, head_grads=None):
                 continue
             add_grad(node, g)
 
+    if free_graph:
+        for entry in entries:
+            entry.input_values = ()
+            entry.freed = True
+            _UNFREED_ENTRIES.discard(entry)
+
     return grad_map, leaf_nodes
 
 
@@ -370,7 +405,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     (reference: Imperative::Backward, src/imperative/imperative.cc:270;
     accepts a single NDArray or a list for both arguments like the
     reference's _parse_head)."""
-    grad_map, leaf_nodes = _run_backward(_as_list(heads), _as_list(head_grads))
+    grad_map, leaf_nodes = _run_backward(_as_list(heads),
+                                         _as_list(head_grads),
+                                         free_graph=not retain_graph)
 
     # write accumulated gradients into leaf arrays
     for node in leaf_nodes.values():
@@ -498,7 +535,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
                              "(call attach_grad / mark_variables first)")
     if create_graph:
         return _grad_create_graph(heads_l, vars_l, head_grads, vars_single)
-    grad_map, _ = _run_backward(heads_l, head_grads)
+    # like the reference (and torch): retain_graph defaults to
+    # create_graph — a plain grad() frees the saved buffers, keeping
+    # memory bounded and the donation gate open
+    retain = bool(create_graph) if retain_graph is None else retain_graph
+    grad_map, _ = _run_backward(heads_l, head_grads,
+                                free_graph=not retain)
     outs = []
     for v in vars_l:
         g = grad_map.get(id(v._ag_node))
@@ -506,7 +548,13 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
             raise MXNetError(
                 "one of the variables does not participate in the "
                 "computation of the heads (reference: autograd.grad)")
-        outs.append(NDArray(g, ctx=v.context))
+        if isinstance(g, RowSparseCT):
+            from .ndarray.sparse import RowSparseNDArray
+            agg = g.aggregated()
+            outs.append(RowSparseNDArray(agg.values, agg.indices,
+                                         agg.shape, ctx=v.context))
+        else:
+            outs.append(NDArray(g, ctx=v.context))
     return outs[0] if vars_single else outs
 
 

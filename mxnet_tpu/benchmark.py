@@ -34,6 +34,10 @@ BASELINES = {
     "resnet50_train_img_per_sec": 298.51,          # b32 fp32 train
     "resnet50_train_b128_img_per_sec": 363.69,     # b128 fp32 train
     "resnet50_train_bf16_img_per_sec": 298.51,     # vs same fp32 anchor
+    # no published V100 fp16 *train* row exists; the chip-native
+    # reduced-precision run is held against the reference's best
+    # published ResNet-50 train number (b128 fp32)
+    "resnet50_train_b128_bf16_img_per_sec": 363.69,
     "inception-v3_train_img_per_sec": 214.48,
     "resnet50_infer_img_per_sec": 1076.81,         # b32 fp32 infer
     "resnet50_infer_bf16_img_per_sec": 2085.51,    # vs V100 fp16
@@ -44,11 +48,32 @@ BASELINES = {
 }
 
 # Peak MXU throughput per chip for MFU estimates; overridable because the
-# attached chip generation is not introspectable portably.
-PEAK_FLOPS = float(os.environ.get("MXNET_TPU_PEAK_FLOPS", 197e12))  # v5e bf16
+# attached chip generation is not introspectable portably. v5e has no
+# separate fp32 systolic path: under JAX's default precision fp32
+# matmuls/convs run the MXU with bf16 operands (3-pass fp32 only when
+# precision=HIGHEST is requested), so the bf16 peak is the honest
+# denominator for default-precision fp32 too — but we report the peak
+# used alongside every MFU figure so the number is self-describing.
+PEAK_FLOPS_BF16 = float(os.environ.get("MXNET_TPU_PEAK_FLOPS", 197e12))
+
+
+def peak_flops(dtype):
+    return PEAK_FLOPS_BF16  # dtype-invariant on v5e (see note above)
 RESNET50_GFLOP_PER_IMG = 4.09 * 2  # fwd GFLOPs (He et al.); x2 MACs->FLOPs
 # train step ~= 3x forward (fwd + 2x bwd)
 RESNET50_TRAIN_GFLOP_PER_IMG = 3 * RESNET50_GFLOP_PER_IMG
+
+# forward GFLOPs/image at the standard input size (2x MACs), used to
+# sanity-gate measurements: a reading implying more FLOP/s than the
+# chip's physical peak means the timing loop was not actually blocking
+# (seen when the accelerator tunnel degrades) and must not be banked.
+MODEL_GFLOP_PER_IMG = {
+    "alexnet": 1.43,
+    "vgg16": 30.9,
+    "resnet50": RESNET50_GFLOP_PER_IMG,
+    "resnet152": 23.1,
+    "inception-v3": 11.4,
+}
 
 
 def log(*a):
@@ -98,6 +123,7 @@ def persist(metric, value, unit, extra=None):
         tmp = RESULTS_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(results, f, indent=1, sort_keys=True)
+            f.write("\n")
         os.replace(tmp, RESULTS_PATH)
         log("persisted %s = %s %s" % (metric, rec["value"], unit))
     return rec
@@ -149,11 +175,87 @@ def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
     dt = _timeit(step, warmup=3, iters=iters)
     log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
     img_s = batch / dt
-    mfu = (img_s * RESNET50_TRAIN_GFLOP_PER_IMG * 1e9) / PEAK_FLOPS \
+    pk = peak_flops(dtype)
+    mfu = (img_s * RESNET50_TRAIN_GFLOP_PER_IMG * 1e9) / pk \
         if num_layers == 50 else None
+    if mfu and mfu > 1.05:
+        raise RuntimeError(
+            "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
+            "— transport not blocking, refusing to bank" % (img_s, mfu))
     return img_s, {"ms_per_step": round(dt * 1e3, 1),
                    "mfu_est": round(mfu, 4) if mfu else None,
+                   "peak_flops": pk,
                    "dtype": dtype, "batch": batch}
+
+
+def data_pipeline(batch=128, n_images=512, size=224, iters=8,
+                  num_workers=4):
+    """Input-pipeline throughput: RecordIO JPEG decode + augment
+    (resize/crop/mirror) through the process DataLoader — the SURVEY §7f
+    requirement that the host pipeline can feed >=1k img/s/chip
+    (reference: iter_image_recordio_2.cc multithreaded decode)."""
+    import os
+    import tempfile
+    import cv2
+    from . import recordio
+    from .gluon.data import DataLoader
+    from .gluon.data.dataset import Dataset
+    from . import image as img
+
+    d = tempfile.mkdtemp(prefix="bench_rec_")
+    rec_path = os.path.join(d, "bench.rec")
+    idx_path = os.path.join(d, "bench.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n_images):
+        im = rng.randint(0, 255, (256, 256, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", im)
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), buf.tobytes()))
+    rec.close()
+
+    augs = img.CreateAugmenter((3, size, size), resize=size,
+                               rand_crop=True, rand_mirror=True)
+
+    class _RecDataset(Dataset):
+        def __init__(self):
+            self._rec = None
+
+        def __len__(self):
+            return n_images
+
+        def __getitem__(self, i):
+            if self._rec is None:     # one reader per worker process
+                self._rec = recordio.MXIndexedRecordIO(idx_path, rec_path,
+                                                       "r")
+            header, s = recordio.unpack(self._rec.read_idx(i))
+            im2 = img.imdecode(s, to_ndarray=False)
+            for aug in augs:
+                im2 = aug(im2)
+            arr = np.asarray(im2)
+            if arr.shape[-1] in (1, 3):
+                arr = arr.transpose(2, 0, 1)
+            return arr.astype(np.float32), np.float32(header.label)
+
+    dl = DataLoader(_RecDataset(), batch_size=batch,
+                    num_workers=num_workers, last_batch="discard")
+    # warm one epoch fragment
+    it = iter(dl)
+    next(it)
+    n = 0
+    t0 = time.time()
+    for x, y in it:
+        n += x.shape[0]
+        if n >= iters * batch:
+            break
+    dt = time.time() - t0
+    img_s = n / dt
+    # throughput scales ~linearly with host cores (process workers);
+    # record the core count so a 1-core dev VM's number is read as
+    # img/s/core, not a pipeline ceiling
+    return img_s, {"num_workers": num_workers, "batch": batch,
+                   "host_cpus": os.cpu_count(),
+                   "decode": "jpeg256->aug%d" % size}
 
 
 def train_mlp(batch=64, iters=50):
@@ -194,12 +296,16 @@ _SCORE_MODELS = {
 
 def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
     """Forward-only img/s on a hybridized zoo model, the analog of
-    example/image-classification/benchmark_score.py."""
+    example/image-classification/benchmark_score.py.
+
+    The timing loop chains each iteration on the previous output (the
+    next input adds 0*prev_logit), so a degrading async transport that
+    stops blocking cannot produce fake sub-millisecond batches; a
+    physics gate rejects any reading above the chip's peak FLOP/s.
+    """
     import jax
-    import jax.numpy as jnp
     from .gluon.model_zoo.vision import get_model
     from . import ndarray as nd
-    from . import autograd
 
     size = 299 if model == "inception-v3" else 224
     net = get_model(_SCORE_MODELS[model], classes=1000)
@@ -212,12 +318,36 @@ def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
         net.cast(dtype)
         x = x.astype(dtype)
 
-    def fwd():
-        return net(x)._data
+    def chain(n):
+        feed = x
+        out = None
+        for _ in range(n):
+            out = net(feed)
+            # serialize: next input carries a (zero) data dependency on
+            # this output, so a non-blocking transport cannot overlap
+            # or drop iterations
+            feed = x + out.reshape((-1,))[0:1] * 0
+        jax.block_until_ready(out._data)
+        return out
 
-    dt = _timeit(fwd, warmup=3, iters=iters)
-    return batch / dt, {"ms_per_batch": round(dt * 1e3, 2),
-                        "dtype": dtype, "batch": batch}
+    chain(3)                                     # warmup / compile
+    t0 = time.time()
+    chain(iters)
+    dt = (time.time() - t0) / iters
+    img_s = batch / dt
+    gflop = MODEL_GFLOP_PER_IMG.get(model)
+    extra = {"ms_per_batch": round(dt * 1e3, 2), "dtype": dtype,
+             "batch": batch}
+    if gflop:
+        tflops = img_s * gflop * 1e9
+        extra["mfu_est"] = round(tflops / peak_flops(dtype), 4)
+        if tflops > 1.05 * peak_flops(dtype):
+            raise RuntimeError(
+                "implausible measurement: %s %.0f img/s implies %.0f "
+                "TFLOP/s > chip peak %.0f — transport not blocking, "
+                "refusing to bank" % (model, img_s, tflops / 1e12,
+                                      peak_flops(dtype) / 1e12))
+    return img_s, extra
 
 
 # ---------------------------------------------------------------------------
@@ -252,6 +382,12 @@ def _job_mlp_train():
     return persist("mlp_train_img_per_sec", v, "img/s (batch 64, fp32)", x)
 
 
+def _job_data_pipeline():
+    v, x = data_pipeline()
+    return persist("data_pipeline_img_per_sec", v,
+                   "img/s (jpeg decode+augment, host pipeline)", x)
+
+
 def _make_infer_job(model, dtype):
     def job():
         v, x = infer_score(model, 32, dtype)
@@ -263,6 +399,7 @@ def _make_infer_job(model, dtype):
 
 JOBS = {
     "mlp_train": _job_mlp_train,
+    "data_pipeline": _job_data_pipeline,
     "resnet50_train": _job_resnet50_train,
     "resnet50_train_bf16": _job_resnet50_train_bf16,
     "resnet50_train_b128": _job_resnet50_train_b128,
@@ -275,6 +412,7 @@ for _m in _SCORE_MODELS:
 # priority order for the daemon: cheapest/highest-value first
 JOB_PRIORITY = [
     "mlp_train",
+    "data_pipeline",
     "resnet50_train",
     "resnet50_train_bf16",
     "resnet50_infer",

@@ -1,0 +1,189 @@
+"""Marshalling helpers behind the general C ABI (src/native/c_api.cc).
+
+Reference: src/c_api/c_api.cc + c_api_ndarray.cc + c_api_function.cc —
+the 198-function flat C surface. Here the C side owns handle lifetime
+(a handle IS a strong PyObject* to the object below) and calls these
+small, positional helpers; everything shape/dtype/attr-shaped stays in
+Python where the JAX runtime lives.
+
+All functions deal in plain types: bytes, lists of ints/strings — no
+numpy required on the C side beyond raw buffers.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros as _nd_zeros
+from .ops import registry as _reg
+
+__all__ = [
+    "nd_create", "nd_shape", "nd_dtype", "nd_copy_from_bytes",
+    "nd_to_bytes", "nd_wait", "nd_save", "nd_load",
+    "op_list", "op_info", "imperative_invoke",
+    "autograd_set_recording", "autograd_mark", "autograd_backward",
+    "symbol_from_json", "symbol_to_json", "symbol_list_arguments",
+    "executor_bind", "executor_forward", "executor_backward",
+    "executor_arg", "executor_grad", "executor_outputs",
+]
+
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
+_DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
+
+
+# -- NDArray CRUD (reference: c_api.cc MXNDArrayCreateEx etc.) -------------
+
+def nd_create(shape, dtype_id=0, device="cpu", dev_id=0):
+    from .context import Context
+    ctx = Context(device, dev_id)
+    return _nd_zeros(tuple(int(s) for s in shape), ctx=ctx,
+                     dtype=_DTYPES[int(dtype_id)])
+
+
+def nd_shape(arr):
+    return list(arr.shape)
+
+
+def nd_dtype(arr):
+    return _DTYPE_IDS[str(_np.dtype(arr.dtype))]
+
+
+def nd_copy_from_bytes(arr, buf):
+    src = _np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = NDArray(src.copy(), ctx=arr.context)
+    return 0
+
+
+def nd_to_bytes(arr):
+    return arr.asnumpy().tobytes()
+
+
+def nd_wait(arr):
+    arr.wait_to_read()
+    return 0
+
+
+def nd_save(fname, arrs, names):
+    from .ndarray import utils as _utils
+    _utils.save(fname, dict(zip(names, arrs)) if names else list(arrs))
+    return 0
+
+
+def nd_load(fname):
+    from .ndarray import utils as _utils
+    loaded = _utils.load(fname)
+    if isinstance(loaded, dict):
+        names = sorted(loaded)
+        return [loaded[n] for n in names], names
+    return list(loaded), []
+
+
+# -- op registry + imperative invoke ---------------------------------------
+
+def op_list():
+    return _reg.list_ops()
+
+
+def op_info(name):
+    """(doc, attr_names, attr_default_reprs, num_outputs_or_-1)."""
+    op = _reg.get_op(name)
+    keys = sorted(op.attr_defaults)
+    n_out = op.num_outputs if isinstance(op.num_outputs, int) else -1
+    return (op.doc or "", keys, [repr(op.attr_defaults[k]) for k in keys],
+            n_out)
+
+
+def _parse_attr(v):
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def imperative_invoke(name, inputs, keys, vals):
+    """Run one op on NDArray handles (reference: MXImperativeInvoke).
+    Returns the output list (mutating ops return their mutated input)."""
+    from .ndarray.ndarray import invoke_op
+    attrs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
+    out = invoke_op(name, list(inputs), attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -- autograd (reference: c_api.cc MXAutogradSetIsRecording etc.) ----------
+
+def autograd_set_recording(flag):
+    from . import autograd
+    return 1 if autograd.set_recording(bool(flag)) else 0
+
+
+def autograd_mark(arrs):
+    from . import autograd
+    autograd.mark_variables(list(arrs))
+    return 0
+
+
+def autograd_backward(heads):
+    from . import autograd
+    autograd.backward(list(heads))
+    return 0
+
+
+def autograd_get_grad(arr):
+    if arr.grad is None:
+        raise MXNetError("array has no gradient")
+    g = arr.grad
+    return g if isinstance(g, NDArray) else g.todense()
+
+
+# -- symbol + executor (reference: MXSymbolCreateFromJSON,
+#    MXExecutorSimpleBindEx families) ---------------------------------------
+
+def symbol_from_json(json_str):
+    from .symbol import symbol as _sym
+    return _sym.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+class _ExecWrap(object):
+    __slots__ = ("exe",)
+
+    def __init__(self, exe):
+        self.exe = exe
+
+
+def executor_bind(sym, names, shape_arrs):
+    """simple_bind with named input shapes taken from NDArray handles."""
+    shapes = {n: tuple(a.shape) for n, a in zip(names, shape_arrs)}
+    return _ExecWrap(sym.simple_bind(**shapes))
+
+
+def executor_forward(w, is_train):
+    w.exe.forward(is_train=bool(is_train))
+    return 0
+
+
+def executor_backward(w):
+    w.exe.backward()
+    return 0
+
+
+def executor_arg(w, name):
+    return w.exe.arg_dict[name]
+
+
+def executor_grad(w, name):
+    return w.exe.grad_dict[name]
+
+
+def executor_outputs(w):
+    return list(w.exe.outputs)

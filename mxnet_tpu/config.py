@@ -46,6 +46,12 @@ VARS = {
                                   "(maps to XLA deterministic flags)."),
     "MXNET_PROFILER_AUTOSTART": (bool, False,
                                  "Start the profiler at import."),
+    "MXNET_UPDATE_BUFFER_DONATION": (bool, True,
+                                     "Donate weight/state buffers in "
+                                     "optimizer update kernels (XLA "
+                                     "input->output aliasing = true "
+                                     "in-place updates, no double-"
+                                     "buffering)."),
 }
 
 

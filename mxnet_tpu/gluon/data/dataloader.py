@@ -4,11 +4,14 @@ Reference: python/mxnet/gluon/data/dataloader.py:55-112 (multiprocessing
 workers + shared-memory NDArray transport) and src/io/iter_prefetcher.h
 (engine-async double buffering).
 
-TPU-native design: workers batchify into **numpy** (host) arrays; the
-main thread converts to device arrays, so device transfer stays on the
-dispatch thread (PjRt requirement) while decode/augment parallelism comes
-from the worker pool. A prefetch queue of ready batches gives the
-double-buffering the reference gets from PrefetcherIter.
+TPU-native design: worker PROCESSES batchify into numpy and ship each
+batch through POSIX shared memory (one segment per array — the same
+zero-serialization transport the reference builds on rec_io sockets);
+the main process maps the segment, device_puts straight out of it, and
+unlinks. Decode/augment parallelism scales past the GIL while device
+transfer stays on the dispatch thread (PjRt requirement).
+``thread_pool=True`` falls back to threads (useful when the dataset is
+not fork-shareable).
 """
 from __future__ import annotations
 
@@ -44,8 +47,92 @@ def _as_device(batch):
     return batch
 
 
-class _Worker(threading.Thread):
-    """Prefetch worker: pulls index batches, produces numpy batches."""
+# -- nested-batch (de)construction for the shared-memory transport ---------
+
+def _flatten_np(batch, leaves):
+    if isinstance(batch, (list, tuple)):
+        return ["T", [_flatten_np(b, leaves) for b in batch]]
+    if isinstance(batch, _np.ndarray):
+        leaves.append(batch)
+        return ["L", len(leaves) - 1]
+    leaves.append(_np.asarray(batch))
+    return ["L", len(leaves) - 1]
+
+
+def _unflatten(tree, leaves):
+    tag, payload = tree
+    if tag == "T":
+        return [_unflatten(t, leaves) for t in payload]
+    return leaves[payload]
+
+
+def _worker_loop(dataset, batchify_fn, in_q, out_q):
+    """Process-worker body: index batch -> numpy batch -> shm segments.
+    (module-level so fork/spawn can reach it)."""
+    from multiprocessing import shared_memory, resource_tracker
+    while True:
+        item = in_q.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            leaves = []
+            tree = _flatten_np(batchify_fn([dataset[i] for i in indices]),
+                               leaves)
+            metas = []
+            for arr in leaves:
+                arr = _np.ascontiguousarray(arr)
+                shm = shared_memory.SharedMemory(
+                    create=True, size=max(1, arr.nbytes))
+                dst = _np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+                dst[...] = arr
+                metas.append((shm.name, arr.shape, str(arr.dtype)))
+                # the CONSUMER unlinks; unregister here so this process's
+                # resource tracker doesn't double-free at exit
+                try:
+                    resource_tracker.unregister(shm._name, "shared_memory")
+                except Exception:
+                    pass
+                shm.close()
+            out_q.put((seq, (tree, metas), None))
+        except Exception as e:  # propagate to the consumer
+            out_q.put((seq, None, repr(e)))
+
+
+def _unlink_payload(payload):
+    """Release the shm segments of a batch that will never be consumed."""
+    from multiprocessing import shared_memory
+    if not payload:
+        return
+    _tree, metas = payload
+    for name, _shape, _dtype in metas:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _load_shared(payload):
+    """Map each shm segment, copy to device, unlink."""
+    from multiprocessing import shared_memory
+    tree, metas = payload
+    leaves = []
+    for name, shape, dtype in metas:
+        shm = shared_memory.SharedMemory(name=name)
+        view = _np.ndarray(shape, _np.dtype(dtype), buffer=shm.buf)
+        leaves.append(array(view.copy()))
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    return _unflatten(tree, leaves)
+
+
+class _ThreadWorker(threading.Thread):
+    """Thread fallback: pulls index batches, produces numpy batches."""
 
     def __init__(self, dataset, batchify_fn, in_q, out_q):
         super().__init__(daemon=True)
@@ -64,21 +151,22 @@ class _Worker(threading.Thread):
                 batch = self._batchify_fn(
                     [self._dataset[i] for i in indices])
                 self._out_q.put((seq, batch, None))
-            except Exception as e:  # propagate to the consumer
+            except Exception as e:
                 self._out_q.put((seq, None, e))
 
 
 class DataLoader(object):
     """Loads batches from a Dataset (reference: dataloader.py DataLoader).
 
-    num_workers>0 uses a thread pool (image decode in numpy releases the
-    GIL for the hot loops; JAX device transfer must stay on one thread —
-    the reference's analogous constraint is engine-thread affinity).
+    ``num_workers>0`` forks worker PROCESSES that ship batches through
+    shared memory (reference parity: multiprocessing Pool + shm
+    NDArray); ``thread_pool=True`` keeps workers as threads instead.
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -100,8 +188,34 @@ class DataLoader(object):
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+
+    def _spawn(self):
+        if self._thread_pool:
+            in_q, out_q = _queue.Queue(), _queue.Queue()
+            workers = [
+                _ThreadWorker(self._dataset, self._batchify_fn, in_q, out_q)
+                for _ in range(self._num_workers)]
+            for w in workers:
+                w.start()
+            return workers, in_q, out_q, False
+        # fork shares the dataset copy-on-write (no pickling); fall back
+        # to spawn where fork doesn't exist (the worker loop is
+        # module-level picklable)
+        method = "fork" if "fork" in \
+            multiprocessing.get_all_start_methods() else "spawn"
+        ctx = multiprocessing.get_context(method)
+        in_q, out_q = ctx.Queue(), ctx.Queue()
+        workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(self._dataset, self._batchify_fn, in_q,
+                              out_q), daemon=True)
+            for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        return workers, in_q, out_q, True
 
     def __iter__(self):
         if self._num_workers == 0:
@@ -110,12 +224,8 @@ class DataLoader(object):
                     [self._dataset[i] for i in indices]))
             return
 
-        in_q = _queue.Queue()
-        out_q = _queue.Queue()
-        workers = [_Worker(self._dataset, self._batchify_fn, in_q, out_q)
-                   for _ in range(self._num_workers)]
-        for w in workers:
-            w.start()
+        workers, in_q, out_q, is_proc = self._spawn()
+        buffered = {}
         try:
             it = iter(self._batch_sampler)
             sent = 0
@@ -126,10 +236,17 @@ class DataLoader(object):
                 except StopIteration:
                     break
             received = 0
-            buffered = {}
             while received < sent:
                 while received not in buffered:
-                    seq, batch, err = out_q.get()
+                    try:
+                        seq, batch, err = out_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        if is_proc and not all(w.is_alive()
+                                               for w in workers):
+                            raise RuntimeError(
+                                "DataLoader worker died unexpectedly "
+                                "(killed / crashed in native code)")
+                        continue
                     buffered[seq] = (batch, err)
                 batch, err = buffered.pop(received)
                 received += 1
@@ -139,11 +256,30 @@ class DataLoader(object):
                 except StopIteration:
                     pass
                 if err is not None:
-                    raise err
-                yield _as_device(batch)
+                    raise RuntimeError("DataLoader worker failed: %s"
+                                       % (err,)) if is_proc else err
+                yield _load_shared(batch) if is_proc else _as_device(batch)
         finally:
             for _ in workers:
                 in_q.put(None)
+            if is_proc:
+                # reclaim any prefetched-but-unconsumed shm segments
+                # (abandoned iteration / error path) — the consumer is
+                # the only party that unlinks
+                for batch, _err in buffered.values():
+                    _unlink_payload(batch)
+                deadline = 20
+                while deadline > 0:
+                    try:
+                        _seq, batch, _err = out_q.get(timeout=0.25)
+                        _unlink_payload(batch)
+                    except _queue.Empty:
+                        break
+                    deadline -= 1
+                for w in workers:
+                    w.join(timeout=5)
+                    if w.is_alive():
+                        w.terminate()
 
     def __len__(self):
         return len(self._batch_sampler)

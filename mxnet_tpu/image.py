@@ -42,9 +42,25 @@ def _to_np(img):
     return _np.asarray(img)
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
+def _wrap_like(src, out):
+    """Return ``out`` in the same container family as ``src``: NDArray in
+    -> NDArray out; plain numpy passes through untouched. Keeping the
+    decode/augment hot path in numpy avoids a host->device transfer per
+    augmenter stage (the reference's augmenters are host-side cv::Mat for
+    the same reason, src/io/image_aug_default.cc)."""
+    if isinstance(src, NDArray):
+        return array(_np.ascontiguousarray(out), dtype=out.dtype)
+    return _np.ascontiguousarray(out)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None, to_ndarray=True):
     """Decode an image byte buffer to an HWC NDArray
-    (reference: image.py imdecode → cv2.imdecode)."""
+    (reference: image.py imdecode → cv2.imdecode).
+
+    ``to_ndarray=False`` returns host numpy — combined with the
+    numpy-passthrough augmenters this keeps the whole decode+augment
+    pipeline on the host with ZERO device round-trips per image (the
+    device sees only final batches)."""
     cv2 = _cv2()
     if isinstance(buf, (bytes, bytearray)):
         buf = _np.frombuffer(buf, dtype=_np.uint8)
@@ -56,7 +72,10 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         img = img[..., ::-1]
     if not flag:
         img = img[..., None]
-    return array(_np.ascontiguousarray(img), dtype=_np.uint8)
+    img = _np.ascontiguousarray(img)
+    if not to_ndarray:
+        return img
+    return array(img, dtype=_np.uint8)
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -75,7 +94,7 @@ def imresize(src, w, h, interp=1):
     out = cv2.resize(img, (w, h), interpolation=interp_map.get(interp, 1))
     if out.ndim == 2:
         out = out[..., None]
-    return array(out, dtype=out.dtype)
+    return _wrap_like(src, out)
 
 
 def resize_short(src, size, interp=2):
@@ -95,8 +114,9 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
     img = _to_np(src)
     out = img[y0:y0 + h, x0:x0 + w]
     if size is not None and (w, h) != size:
-        return imresize(array(out, dtype=out.dtype), size[0], size[1], interp)
-    return array(_np.ascontiguousarray(out), dtype=out.dtype)
+        return _wrap_like(src, _to_np(
+            imresize(out, size[0], size[1], interp)))
+    return _wrap_like(src, out)
 
 
 def center_crop(src, size, interp=2):
@@ -149,7 +169,7 @@ def color_normalize(src, mean, std=None):
     img = img - mean
     if std is not None:
         img = img / _np.asarray(_to_np(std), dtype=_np.float32)
-    return array(img)
+    return _wrap_like(src, img)
 
 
 # ---------------------------------------------------------------------------
@@ -223,8 +243,7 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if _pyrandom.random() < self.p:
-            return array(_np.ascontiguousarray(_to_np(src)[:, ::-1]),
-                         dtype=src.dtype)
+            return _wrap_like(src, _to_np(src)[:, ::-1])
         return src
 
 
@@ -253,7 +272,7 @@ class BrightnessJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
-        return array(_to_np(src).astype(_np.float32) * alpha)
+        return _wrap_like(src, _to_np(src).astype(_np.float32) * alpha)
 
 
 class ContrastJitterAug(Augmenter):
@@ -267,7 +286,7 @@ class ContrastJitterAug(Augmenter):
         alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
         img = _to_np(src).astype(_np.float32)
         gray = (img * self._coef).sum() * 3.0 / img.size
-        return array(img * alpha + gray * (1.0 - alpha))
+        return _wrap_like(src, img * alpha + gray * (1.0 - alpha))
 
 
 class SaturationJitterAug(Augmenter):
@@ -281,7 +300,7 @@ class SaturationJitterAug(Augmenter):
         alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
         img = _to_np(src).astype(_np.float32)
         gray = (img * self._coef).sum(axis=2, keepdims=True)
-        return array(img * alpha + gray * (1.0 - alpha))
+        return _wrap_like(src, img * alpha + gray * (1.0 - alpha))
 
 
 class LightingAug(Augmenter):
@@ -294,7 +313,7 @@ class LightingAug(Augmenter):
     def __call__(self, src):
         alpha = _np.random.normal(0, self.alphastd, size=(3,))
         rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
-        return array(_to_np(src).astype(_np.float32) + rgb)
+        return _wrap_like(src, _to_np(src).astype(_np.float32) + rgb)
 
 
 class ColorJitterAug(Augmenter):
@@ -456,10 +475,10 @@ class ImageIter(object):
         try:
             while i < self.batch_size:
                 label, s = self.next_sample()
-                img = imdecode(s, 1 if c == 3 else 0)
+                img = imdecode(s, 1 if c == 3 else 0, to_ndarray=False)
                 for aug in self.auglist:
                     img = aug(img)
-                arr = img.asnumpy()
+                arr = _to_np(img)
                 if arr.ndim == 3:
                     arr = arr.transpose(2, 0, 1)
                 batch_data[i] = arr
@@ -521,7 +540,7 @@ class DetHorizontalFlipAug(DetAugmenter):
             x1 = label[:, 1].copy()
             label[:, 1] = _np.where(valid, 1.0 - label[:, 3], label[:, 1])
             label[:, 3] = _np.where(valid, 1.0 - x1, label[:, 3])
-            return array(_np.ascontiguousarray(arr)), label
+            return _wrap_like(src, arr), label
         return src, label
 
 
@@ -590,8 +609,9 @@ class DetRandomCropAug(DetAugmenter):
             if cover.max() >= self.min_object_covered:
                 x0, y0 = int(cx * w), int(cy * h)
                 x1, y1 = int((cx + cw) * w), int((cy + ch) * h)
-                cropped = _np.ascontiguousarray(arr[y0:y1, x0:x1])
-                return array(cropped), _update_det_labels(label, box)
+                cropped = arr[y0:y1, x0:x1]
+                return _wrap_like(src, cropped), _update_det_labels(label,
+                                                                    box)
         return src, label
 
 
@@ -622,7 +642,7 @@ class DetRandomPadAug(DetAugmenter):
         canvas[y0:y0 + h, x0:x0 + w] = arr
         # pad box in ORIGINAL normalized coords is the inverse crop
         box = (-x0 / w, -y0 / h, (nw - x0) / w, (nh - y0) / h)
-        return array(canvas), _update_det_labels(label, box)
+        return _wrap_like(src, canvas), _update_det_labels(label, box)
 
 
 class DetForceResizeAug(DetAugmenter):
@@ -683,12 +703,17 @@ class ImageDetIter(ImageIter):
                  path_imglist=None, path_root="", imglist=None,
                  shuffle=False, aug_list=None, max_objects=None,
                  dtype="float32", **kwargs):
+        # iterator-level kwargs go to ImageIter (distributed sharding
+        # etc.); the rest parameterize the detection augmenter pipeline
+        iter_kwargs = {k: kwargs.pop(k) for k in
+                       ("num_parts", "part_index") if k in kwargs}
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **kwargs)
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, imglist=imglist,
-                         shuffle=shuffle, aug_list=[], dtype=dtype)
+                         shuffle=shuffle, aug_list=[], dtype=dtype,
+                         **iter_kwargs)
         from .io import DataDesc
         self.det_auglist = aug_list
         if max_objects is None:
@@ -724,13 +749,13 @@ class ImageDetIter(ImageIter):
         try:
             while i < self.batch_size:
                 raw_label, s = self.next_sample()
-                img = imdecode(s, 1 if c == 3 else 0)
+                img = imdecode(s, 1 if c == 3 else 0, to_ndarray=False)
                 objs = self._parse_det_label(raw_label)
                 padded = _np.full((self.max_objects, 5), -1.0, _np.float32)
                 padded[:len(objs)] = objs[:self.max_objects]
                 for aug in self.det_auglist:
                     img, padded = aug(img, padded)
-                arr = img.asnumpy()
+                arr = _to_np(img)
                 if arr.ndim == 3:
                     arr = arr.transpose(2, 0, 1)
                 data[i] = arr

@@ -347,9 +347,16 @@ class PrefetchingIter(DataIter):
     """Thread-based read-ahead over one or more iterators
     (reference: python/mxnet/io.py:658 — same double-buffer design; the
     reference uses it to overlap C++ decode with training; here it overlaps
-    host batch prep with device compute)."""
+    host batch prep with device compute).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    ``device_prefetch=True`` additionally stages each prefetched batch
+    onto the accelerator from INSIDE the worker thread, so the
+    host→device copy overlaps the previous step's compute — the TPU
+    analog of the reference's pinned-host staging buffers
+    (src/storage/ pinned memory + iter prefetcher)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 device_prefetch=False, ctx=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -358,6 +365,8 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self._device_prefetch = device_prefetch
+        self._stage_ctx = ctx
         self.batch_size = self.provide_data[0][1][0]
         self.data_ready = [threading.Event() for _ in range(self.n_iter)]
         self.data_taken = [threading.Event() for _ in range(self.n_iter)]
@@ -373,7 +382,10 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
+                    if self._device_prefetch and batch is not None:
+                        batch = self._stage(batch)
+                    self.next_batch[i] = batch
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -384,6 +396,26 @@ class PrefetchingIter(DataIter):
             for i in range(self.n_iter)]
         for t in self.prefetch_threads:
             t.start()
+
+    def _stage(self, batch):
+        """device_put every array of the batch from the worker thread
+        (async H2D; compute on the main thread proceeds meanwhile)."""
+        import jax
+        from .context import current_context
+        ctx = self._stage_ctx or current_context()
+        dev = ctx.jax_device() if hasattr(ctx, "jax_device") else ctx
+
+        def put(arrs):
+            out = []
+            for a in arrs or []:
+                if isinstance(a, NDArray):
+                    a._set_data(jax.device_put(a._data, dev))
+                out.append(a)
+            return out
+
+        batch.data = put(batch.data)
+        batch.label = put(batch.label)
+        return batch
 
     def __del__(self):
         self.started = False

@@ -134,6 +134,14 @@ class KVStore(object):
             if k not in self._store:
                 raise MXNetError("please init key %r before push" % (k,))
             from .ndarray.sparse import RowSparseNDArray
+            if any(isinstance(v, RowSparseNDArray) for v in vlist) and \
+                    not all(isinstance(v, RowSparseNDArray)
+                            for v in vlist):
+                # mixed dense/sparse slices for one key: densify and take
+                # the dense path (reference kvstore_local densifies when
+                # storage types disagree)
+                vlist = [v.todense() if isinstance(v, RowSparseNDArray)
+                         else v for v in vlist]
             if any(isinstance(v, RowSparseNDArray) for v in vlist):
                 # row_sparse gradient flow (reference: kvstore_local.h
                 # PushImpl kRowSparseStorage): concat per-device rows,
@@ -187,7 +195,10 @@ class KVStore(object):
                 self._store[k]._set_data(fresh)
             src = self._store[k]
             for o in olist:
-                o._set_data(src._data)
+                # copy, don't alias: a store-side updater may later run
+                # a buffer-donating update on src; an aliased out would
+                # be invalidated with it
+                o._set_data(src._data.copy())
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (reference: kvstore.py pushpull — on TPU this is

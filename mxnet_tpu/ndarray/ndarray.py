@@ -142,8 +142,12 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def detach(self):
-        out = NDArray(self._data, ctx=self._ctx)
-        return out
+        # a COPY, not a buffer alias: in this framework an alias never
+        # observes in-place updates anyway (ops rebind, reference:
+        # functional XLA semantics), and sharing the buffer would let a
+        # later donating optimizer update (ops/registry.py) invalidate
+        # the detached snapshot
+        return NDArray(self._data.copy(), ctx=self._ctx)
 
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):

@@ -128,8 +128,16 @@ _BINARY = {
     "arctan2": jnp.arctan2,
 }
 
+# indicator-valued ops: gradient is zero by contract (the reference
+# registers them without FGradient), so they are non-differentiable
+_INDICATOR = {"broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+              "broadcast_greater_equal", "broadcast_lesser",
+              "broadcast_lesser_equal", "broadcast_logical_and",
+              "broadcast_logical_or", "broadcast_logical_xor"}
+
 for _name, _f in _BINARY.items():
-    register(_name)(lambda a, b, _f=_f: _f(a, b))
+    register(_name, differentiable=_name not in _INDICATOR)(
+        lambda a, b, _f=_f: _f(a, b))
 
 for _ew, _bc in [("elemwise_add", "broadcast_add"), ("elemwise_sub", "broadcast_sub"),
                  ("elemwise_mul", "broadcast_mul"), ("elemwise_div", "broadcast_div"),
@@ -149,10 +157,11 @@ for _ew, _bc in [("elemwise_add", "broadcast_add"), ("elemwise_sub", "broadcast_
 # binary with scalar attr (reference: src/operator/tensor/elemwise_binary_scalar_op_*.cc)
 # ---------------------------------------------------------------------------
 
-def _scalar_op(name, f, defaults=None):
+def _scalar_op(name, f, defaults=None, differentiable=True):
     def _g(x, scalar=0.0):
         return f(x, jnp.asarray(scalar, dtype=x.dtype))
-    register(name, attr_defaults=(defaults or {"scalar": 0.0}))(_g)
+    register(name, attr_defaults=(defaults or {"scalar": 0.0}),
+             differentiable=differentiable)(_g)
 
 
 _scalar_op("_plus_scalar", jnp.add)
@@ -168,15 +177,15 @@ _scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
 _scalar_op("_maximum_scalar", jnp.maximum)
 _scalar_op("_minimum_scalar", jnp.minimum)
 _scalar_op("_hypot_scalar", jnp.hypot)
-_scalar_op("_equal_scalar", _cmp(jnp.equal))
-_scalar_op("_not_equal_scalar", _cmp(jnp.not_equal))
-_scalar_op("_greater_scalar", _cmp(jnp.greater))
-_scalar_op("_greater_equal_scalar", _cmp(jnp.greater_equal))
-_scalar_op("_lesser_scalar", _cmp(jnp.less))
-_scalar_op("_lesser_equal_scalar", _cmp(jnp.less_equal))
-_scalar_op("_logical_and_scalar", _cmp(jnp.logical_and))
-_scalar_op("_logical_or_scalar", _cmp(jnp.logical_or))
-_scalar_op("_logical_xor_scalar", _cmp(jnp.logical_xor))
+_scalar_op("_equal_scalar", _cmp(jnp.equal), differentiable=False)
+_scalar_op("_not_equal_scalar", _cmp(jnp.not_equal), differentiable=False)
+_scalar_op("_greater_scalar", _cmp(jnp.greater), differentiable=False)
+_scalar_op("_greater_equal_scalar", _cmp(jnp.greater_equal), differentiable=False)
+_scalar_op("_lesser_scalar", _cmp(jnp.less), differentiable=False)
+_scalar_op("_lesser_equal_scalar", _cmp(jnp.less_equal), differentiable=False)
+_scalar_op("_logical_and_scalar", _cmp(jnp.logical_and), differentiable=False)
+_scalar_op("_logical_or_scalar", _cmp(jnp.logical_or), differentiable=False)
+_scalar_op("_logical_xor_scalar", _cmp(jnp.logical_xor), differentiable=False)
 
 
 @register("clip", attr_defaults={"a_min": 0.0, "a_max": 0.0})

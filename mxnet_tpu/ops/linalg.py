@@ -144,19 +144,23 @@ def _extracttrian(A, offset=0, lower=True, **_ig):
 
 @register("_linalg_maketrian", attr_defaults={"offset": 0, "lower": True})
 def _maketrian(d, offset=0, lower=True, **_ig):
-    import math
+    import numpy as _onp
     m = d.shape[-1]
-    # solve n (n+1) / 2 adjusted by offset: brute-force smallest n
+    # solve n (n+1) / 2 adjusted by offset: smallest n whose triangle
+    # holds exactly m entries. The count is monotonic in n, so overshoot
+    # means no solution — fail fast instead of scanning to the cap.
     n = 1
     while True:
-        import numpy as _onp
         rows = _onp.tril_indices(n, k=offset) if lower \
             else _onp.triu_indices(n, k=offset)
         if len(rows[0]) == m:
             break
+        if len(rows[0]) > m or n > 4096:
+            raise MXNetError(
+                "cannot infer matrix size for maketrian: %d packed "
+                "entries is not a triangular count for offset %d"
+                % (m, offset))
         n += 1
-        if n > 4096:
-            raise MXNetError("cannot infer matrix size for maketrian")
     base = jnp.zeros(d.shape[:-1] + (n, n), dtype=d.dtype)
     return base.at[..., rows[0], rows[1]].set(d)
 

@@ -23,7 +23,7 @@ def _range_scale(min_r, max_r):
     return jnp.where(amax > 0, _INT8_MAX / amax, 1.0)
 
 
-@register("_contrib_quantize", num_outputs=3,
+@register("_contrib_quantize", num_outputs=3, differentiable=False,
           attr_defaults={"out_type": "int8"})
 def _quantize(data, min_range, max_range, out_type="int8", **_ig):
     """fp32 -> int8 with explicit range (reference: quantize.cc).
@@ -34,7 +34,7 @@ def _quantize(data, min_range, max_range, out_type="int8", **_ig):
     return q, min_range.reshape(()), max_range.reshape(())
 
 
-@register("_contrib_quantize_v2", num_outputs=3,
+@register("_contrib_quantize_v2", num_outputs=3, differentiable=False,
           attr_defaults={"out_type": "int8", "min_calib_range": None,
                          "max_calib_range": None})
 def _quantize_v2(data, out_type="int8", min_calib_range=None,
@@ -60,7 +60,7 @@ def _dequantize(data, min_range, max_range, out_type="float32", **_ig):
     return data.astype(jnp.float32) / scale
 
 
-@register("_contrib_requantize", num_outputs=3,
+@register("_contrib_requantize", num_outputs=3, differentiable=False,
           attr_defaults={"min_calib_range": None, "max_calib_range": None})
 def _requantize(data, min_range, max_range, min_calib_range=None,
                 max_calib_range=None, **_ig):
@@ -88,7 +88,7 @@ def _q_range_out(x_int32, min_a, max_a, min_b, max_b):
     return real
 
 
-@register("_contrib_quantized_fully_connected", num_outputs=3,
+@register("_contrib_quantized_fully_connected", num_outputs=3, differentiable=False,
           attr_defaults={"num_hidden": 0, "no_bias": False, "flatten": True})
 def _quantized_fc(*arrays, num_hidden=0, no_bias=False, flatten=True,
                   **_ig):
@@ -125,7 +125,7 @@ def _quantized_fc(*arrays, num_hidden=0, no_bias=False, flatten=True,
     return q32, mn.reshape(()), mx.reshape(())
 
 
-@register("_contrib_quantized_conv", num_outputs=3,
+@register("_contrib_quantized_conv", num_outputs=3, differentiable=False,
           attr_defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
                          "num_filter": 0, "num_group": 1, "no_bias": True,
                          "layout": None})
@@ -156,6 +156,7 @@ def _quantized_conv(data, weight, min_data, max_data, min_weight,
 
 
 @register("_contrib_quantized_pooling", num_outputs=3,
+          differentiable=False,
           attr_defaults={"kernel": (), "pool_type": "max",
                          "global_pool": False, "stride": (), "pad": (),
                          "pooling_convention": "valid"})
@@ -173,7 +174,7 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
         max_data.reshape(())
 
 
-@register("_contrib_quantized_flatten", num_outputs=3)
+@register("_contrib_quantized_flatten", num_outputs=3, differentiable=False)
 def _quantized_flatten(data, min_data, max_data):
     return data.reshape((data.shape[0], -1)), min_data.reshape(()), \
         max_data.reshape(())

@@ -112,7 +112,7 @@ def list_ops():
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _jitted(name, attr_key):
+def _jitted(name, attr_key, donate_ok=False):
     import jax
     op = _REGISTRY[name]
     attrs = dict(attr_key)
@@ -120,7 +120,29 @@ def _jitted(name, attr_key):
     def _call(*arrays):
         return op.fn(*arrays, **attrs)
 
-    return jax.jit(_call)
+    donate = ()
+    if donate_ok and op.mutate_inputs:
+        # in-place ops (optimizer updates): donate the mutated buffers so
+        # XLA aliases them input->output — a true on-device in-place
+        # update with no double-buffering, the analog of the reference's
+        # kWriteInplace (include/mxnet/op_attr_types.h OpReqType).
+        # The NDArray layer rebinds the same NDArray to the output;
+        # invoke_raw only passes donate_ok while no unfreed tape exists,
+        # so no stale backward can read the donated buffer.
+        shift = 1 if op.needs_rng else 0
+        donate = tuple(i + shift for i in op.mutate_inputs)
+
+    return jax.jit(_call, donate_argnums=donate)
+
+
+def _donation_allowed(op):
+    if not op.mutate_inputs:
+        return False
+    from ..config import get as _cfg
+    if not _cfg("MXNET_UPDATE_BUFFER_DONATION"):
+        return False
+    from .. import autograd
+    return not autograd.has_live_tape()
 
 
 def invoke_raw(op: OpDef, arrays, attrs):
@@ -129,7 +151,7 @@ def invoke_raw(op: OpDef, arrays, attrs):
     Inside an outer trace (jit / grad) this inlines; eagerly it hits the
     jit cache keyed on (name, attrs) + JAX's own shape/dtype cache.
     """
-    fn = _jitted(op.name, canonical_attrs(attrs))
+    fn = _jitted(op.name, canonical_attrs(attrs), _donation_allowed(op))
     out = fn(*arrays)
     if isinstance(out, (tuple, list)):
         return tuple(out)

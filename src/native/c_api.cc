@@ -1,0 +1,540 @@
+// General C ABI for mxnet_tpu (include/mxnet_tpu/c_api.h).
+//
+// Capability analog of the reference's src/c_api/c_api.cc +
+// c_api_ndarray.cc + c_api_executor.cc: NDArray CRUD/serialization, op
+// discovery, imperative invoke, autograd, symbol/executor — the surface
+// language bindings build on. The engine is XLA behind an embedded
+// CPython; every handle is a strong PyObject* to the Python-side object
+// (mxnet_tpu/capi_bridge.py holds the marshalling helpers), so handle
+// lifetime is plain reference counting.
+//
+// Build: make -C src/native  ->  build/native/libmxtpu_c_api.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../../include/mxnet_tpu/c_api.h"
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::mutex g_err_mutex;
+std::string g_last_error;
+
+void set_last_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_err_mutex);
+  g_last_error = msg;
+}
+
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_last_error(msg);
+}
+
+bool ensure_python(PyGILState_STATE* state) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      set_last_error("failed to initialize embedded python");
+      return false;
+    }
+    PyEval_SaveThread();
+  }
+  *state = PyGILState_Ensure();
+  return true;
+}
+
+// Call mxnet_tpu.capi_bridge.<fn>(*args). Steals nothing; returns a new
+// reference or nullptr (python error captured).
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+  if (mod == nullptr) { capture_py_error(); return nullptr; }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) { capture_py_error(); return nullptr; }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (out == nullptr) capture_py_error();
+  return out;
+}
+
+// RAII GIL scope.
+struct Gil {
+  PyGILState_STATE state;
+  bool ok;
+  Gil() : ok(ensure_python(&state)) {}
+  ~Gil() { if (ok) PyGILState_Release(state); }
+};
+
+// Per-thread string/array scratch so returned pointers stay valid until
+// the next call from the same thread (the reference uses the same
+// ret-buffer pattern in MXAPIThreadLocalEntry).
+thread_local std::vector<std::string> tl_strings;
+thread_local std::vector<const char*> tl_cstrs;
+thread_local std::vector<void*> tl_handles;
+
+const char** stash_strings(PyObject* list, uint32_t* out_num) {
+  tl_strings.clear();
+  tl_cstrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    tl_strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+  }
+  for (auto& s : tl_strings) tl_cstrs.push_back(s.c_str());
+  *out_num = static_cast<uint32_t>(n);
+  return tl_cstrs.data();
+}
+
+void** stash_handles(PyObject* list, uint32_t* out_num) {
+  tl_handles.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(list, i);
+    Py_INCREF(item);                      // handle = strong reference
+    tl_handles.push_back(item);
+  }
+  *out_num = static_cast<uint32_t>(n);
+  return tl_handles.data();
+}
+
+}  // namespace
+
+MXTPU_API const char* MXGetLastError(void) {
+  std::lock_guard<std::mutex> lock(g_err_mutex);
+  return g_last_error.c_str();
+}
+
+// ---------------------------------------------------------------- NDArray
+
+MXTPU_API int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                              int dtype, const char* dev_type, int dev_id,
+                              NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pshape = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(pshape, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* args = Py_BuildValue("(NisI)", pshape, dtype, dev_type,
+                                 (unsigned int)dev_id);
+  PyObject* r = bridge_call("nd_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;                                // strong ref = handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFree(NDArrayHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  Py_XDECREF(reinterpret_cast<PyObject*>(h));
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
+                                uint32_t* out_shape) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_ndim = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_shape[i] = (uint32_t)PyLong_AsUnsignedLong(PyList_GetItem(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetDType(NDArrayHandle h, int* out_dtype) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_dtype", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out_dtype = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                                       size_t nbytes) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), (Py_ssize_t)nbytes);
+  PyObject* args = Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(h),
+                                 buf);
+  PyObject* r = bridge_call("nd_copy_from_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data,
+                                     size_t nbytes) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &src, &n) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  if ((size_t)n > nbytes) {
+    set_last_error("destination buffer too small");
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, src, (size_t)n);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitToRead(NDArrayHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_wait", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySave(const char* fname, uint32_t num,
+                            NDArrayHandle* arrs, const char** names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* plist = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(arrs[i]);
+    Py_INCREF(o);
+    PyList_SetItem(plist, i, o);
+  }
+  PyObject* pnames;
+  if (names != nullptr) {
+    pnames = PyList_New(num);
+    for (uint32_t i = 0; i < num; ++i)
+      PyList_SetItem(pnames, i, PyUnicode_FromString(names[i]));
+  } else {
+    pnames = PyList_New(0);
+  }
+  PyObject* args = Py_BuildValue("(sNN)", fname, plist, pnames);
+  PyObject* r = bridge_call("nd_save", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoad(const char* fname, uint32_t* out_num,
+                            NDArrayHandle** out_arrs,
+                            uint32_t* out_name_num,
+                            const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* r = bridge_call("nd_load", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  PyObject* arrs = PyTuple_GetItem(r, 0);
+  PyObject* names = PyTuple_GetItem(r, 1);
+  *out_arrs = stash_handles(arrs, out_num);
+  *out_names = stash_strings(names, out_name_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+// --------------------------------------------------------------- operators
+
+MXTPU_API int MXListAllOpNames(uint32_t* out_num, const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("op_list", nullptr);
+  if (r == nullptr) return -1;
+  *out_names = stash_strings(r, out_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXOpGetInfo(const char* name, const char** out_doc,
+                          uint32_t* out_num_attrs,
+                          const char*** out_attr_names,
+                          const char*** out_attr_defaults,
+                          int* out_num_outputs) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* r = bridge_call("op_info", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  // (doc, names, defaults, n_out): stash doc + names + defaults into the
+  // thread-local scratch back to back
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(r, 0)));
+  PyObject* names = PyTuple_GetItem(r, 1);
+  PyObject* defaults = PyTuple_GetItem(r, 2);
+  Py_ssize_t n = PyList_Size(names);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(defaults, i)));
+  for (auto& s : tl_strings) tl_cstrs.push_back(s.c_str());
+  *out_doc = tl_cstrs[0];
+  *out_num_attrs = (uint32_t)n;
+  *out_attr_names = tl_cstrs.data() + 1;
+  *out_attr_defaults = tl_cstrs.data() + 1 + n;
+  *out_num_outputs = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXImperativeInvoke(const char* op_name, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SetItem(pins, i, o);
+  }
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNNN)", op_name, pins, pkeys, pvals);
+  PyObject* r = bridge_call("imperative_invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  uint32_t n = 0;
+  *outputs = stash_handles(r, &n);
+  *num_outputs = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+// --------------------------------------------------------------- autograd
+
+MXTPU_API int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", is_recording);
+  PyObject* r = bridge_call("autograd_set_recording", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradMarkVariables(uint32_t num, NDArrayHandle* vars) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* plist = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(vars[i]);
+    Py_INCREF(o);
+    PyList_SetItem(plist, i, o);
+  }
+  PyObject* args = Py_BuildValue("(N)", plist);
+  PyObject* r = bridge_call("autograd_mark", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradBackward(uint32_t num_heads, NDArrayHandle* heads) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* plist = PyList_New(num_heads);
+  for (uint32_t i = 0; i < num_heads; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(heads[i]);
+    Py_INCREF(o);
+    PyList_SetItem(plist, i, o);
+  }
+  PyObject* args = Py_BuildValue("(N)", plist);
+  PyObject* r = bridge_call("autograd_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradGetGrad(NDArrayHandle var, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(var));
+  PyObject* r = bridge_call("autograd_get_grad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// --------------------------------------------------- symbol + executor
+
+MXTPU_API int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* r = bridge_call("symbol_from_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_to_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+  tl_cstrs.push_back(tl_strings[0].c_str());
+  *out_json = tl_cstrs[0];
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_num,
+                                    const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_list_arguments", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out_names = stash_strings(r, out_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolFree(SymbolHandle sym) {
+  return MXNDArrayFree(sym);
+}
+
+MXTPU_API int MXExecutorSimpleBind(SymbolHandle sym, uint32_t num_inputs,
+                                   const char** input_names,
+                                   NDArrayHandle* input_examples,
+                                   ExecutorHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pnames = PyList_New(num_inputs);
+  PyObject* parrs = PyList_New(num_inputs);
+  for (uint32_t i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(pnames, i, PyUnicode_FromString(input_names[i]));
+    PyObject* o = reinterpret_cast<PyObject*>(input_examples[i]);
+    Py_INCREF(o);
+    PyList_SetItem(parrs, i, o);
+  }
+  PyObject* args = Py_BuildValue("(ONN)", reinterpret_cast<PyObject*>(sym),
+                                 pnames, parrs);
+  PyObject* r = bridge_call("executor_bind", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(exec),
+                                 is_train);
+  PyObject* r = bridge_call("executor_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorBackward(ExecutorHandle exec) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(exec));
+  PyObject* r = bridge_call("executor_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int exec_lookup(const char* fn, ExecutorHandle exec,
+                       const char* name, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(exec),
+                                 name);
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXExecutorGetArg(ExecutorHandle exec, const char* name,
+                               NDArrayHandle* out) {
+  return exec_lookup("executor_arg", exec, name, out);
+}
+
+MXTPU_API int MXExecutorGetGrad(ExecutorHandle exec, const char* name,
+                                NDArrayHandle* out) {
+  return exec_lookup("executor_grad", exec, name, out);
+}
+
+MXTPU_API int MXExecutorOutputs(ExecutorHandle exec, uint32_t* out_num,
+                                NDArrayHandle** outputs) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(exec));
+  PyObject* r = bridge_call("executor_outputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *outputs = stash_handles(r, out_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorFree(ExecutorHandle exec) {
+  return MXNDArrayFree(exec);
+}

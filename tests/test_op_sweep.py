@@ -1,0 +1,356 @@
+"""Registry-driven verification sweep over the whole operator surface.
+
+The reference gradient-checks its op surface with check_numeric_gradient
+and cross-backend check_consistency (reference:
+python/mxnet/test_utils.py:790, :1207). Here the registry IS the op
+surface: every registered differentiable op gets a central-finite-
+difference gradient check against jax.grad, and every probeable op gets
+a bf16-vs-fp32 consistency check (dtype variants play the role of the
+reference's cpu-vs-gpu backends). A coverage gate asserts the sweep
+actually covers >80% of the differentiable surface so newly-registered
+ops cannot silently skip verification.
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu  # noqa: F401  (populates the registry)
+from mxnet_tpu.ops import registry
+
+# ---------------------------------------------------------------------------
+# input synthesis
+
+_RNG = np.random.RandomState(0)
+
+
+def _f32(*shape):
+    return jnp.asarray(_RNG.uniform(0.25, 0.75, shape).astype(np.float32))
+
+
+def _i32(hi, *shape):
+    return jnp.asarray(_RNG.randint(0, hi, shape).astype(np.int32))
+
+
+# Ops whose generic probe fails: explicit inputs/attrs. ``diff``
+# restricts which inputs are gradient-checked (e.g. integer indices,
+# ROI coordinates with non-smooth dependence).
+def _spec_table():
+    return {
+        "BatchNorm": dict(ins=[_f32(2, 3, 4, 4), _f32(3), _f32(3),
+                               _f32(3), _f32(3) + 0.5], diff=(0, 1, 2)),
+        "SyncBatchNorm": dict(ins=[_f32(2, 3, 4, 4), _f32(3), _f32(3),
+                                   _f32(3), _f32(3) + 0.5], diff=(0, 1, 2)),
+        "LayerNorm": dict(ins=[_f32(3, 4), _f32(4), _f32(4)]),
+        "InstanceNorm": dict(ins=[_f32(2, 3, 4, 4), _f32(3), _f32(3)]),
+        "Convolution": dict(ins=[_f32(1, 3, 6, 6), _f32(4, 3, 3, 3),
+                                 _f32(4)],
+                            attrs={"kernel": (3, 3), "num_filter": 4}),
+        "Deconvolution": dict(ins=[_f32(1, 4, 4, 4), _f32(4, 3, 3, 3),
+                                   _f32(3)],
+                              attrs={"kernel": (3, 3), "num_filter": 3}),
+        "CTCLoss": dict(ins=[_f32(5, 2, 4), _i32(3, 2, 2).astype(
+            jnp.float32) + 1], diff=(0,)),
+        "_contrib_ROIAlign": dict(
+            ins=[_f32(1, 2, 8, 8),
+                 jnp.asarray([[0, 0, 0, 6, 6]], jnp.float32)],
+            attrs={"pooled_size": (2, 2), "spatial_scale": 1.0},
+            diff=(0,)),
+        "Pad": dict(ins=[_f32(2, 3, 4, 4)],
+                    attrs={"mode": "constant",
+                           "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+        "Reshape": dict(ins=[_f32(3, 4)], attrs={"shape": (4, 3)}),
+        "reshape": dict(ins=[_f32(3, 4)], attrs={"shape": (2, 6)}),
+        "_image_crop": dict(ins=[_f32(8, 8, 3)],
+                            attrs={"x": 1, "y": 1, "width": 4,
+                                   "height": 4}),
+        "_image_resize": dict(ins=[_f32(8, 8, 3)], attrs={"size": (4, 4)}),
+        "_linalg_maketrian": dict(ins=[_f32(2, 6)]),
+        "batch_take": dict(ins=[_f32(3, 4), _i32(4, 3)], diff=(0,)),
+        "broadcast_to": dict(ins=[_f32(1, 4)], attrs={"shape": (3, 4)}),
+        "pad": dict(ins=[_f32(2, 3, 4, 4)],
+                    attrs={"mode": "constant",
+                           "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+        "pick": dict(ins=[_f32(3, 4), _i32(4, 3)], diff=(0,)),
+        "scatter_nd": dict(ins=[_f32(3), _i32(3, 2, 3)],
+                           attrs={"shape": (4, 4)}, diff=(0,)),
+        "softmax_cross_entropy": dict(ins=[_f32(3, 4), _i32(4, 3)],
+                                      diff=(0,)),
+        # disjoint value ranges keep FD away from the min/max/mod kinks
+        "broadcast_minimum": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "broadcast_maximum": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "_maximum": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "_minimum": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "_mod_scalar": dict(ins=[_f32(3, 4)], attrs={"scalar": 10.0}),
+        "_div_scalar": dict(ins=[_f32(3, 4)], attrs={"scalar": 2.0}),
+        # scalar < all inputs: mod(s, x) == s, smooth on the whole range
+        "_rmod_scalar": dict(ins=[_f32(3, 4) + 0.5],
+                             attrs={"scalar": 0.3}),
+        "linalg_extracttrian": dict(ins=[_f32(2, 4, 4)]),
+        "_linalg_extracttrian": dict(ins=[_f32(2, 4, 4)]),
+        # well-separated entries: FD never crosses an argmin/argmax tie
+        "min": dict(ins=[_arange_input()]),
+        "max": dict(ins=[_arange_input()]),
+        "min_axis": dict(ins=[_arange_input()]),
+        "max_axis": dict(ins=[_arange_input()]),
+        # well-conditioned SPD matrices for the decompositions
+        "_linalg_inverse": dict(ins=[_spd(4)]),
+        "linalg_inverse": dict(ins=[_spd(4)]),
+        "_linalg_potrf": dict(ins=[_spd(4)]),
+        "linalg_potrf": dict(ins=[_spd(4)]),
+        "Softmax": dict(ins=[_f32(3, 4),
+                             jnp.asarray([0, 2, 1], jnp.float32)],
+                        diff=(0,)),
+        "SoftmaxOutput": dict(ins=[_f32(3, 4),
+                                   jnp.asarray([0, 2, 1], jnp.float32)],
+                              diff=(0,)),
+        # distinct cell values: FD never flips a pooled-max winner
+        "ROIPooling": dict(
+            ins=[jnp.arange(128, dtype=jnp.float32).reshape(
+                1, 2, 8, 8) * 0.01,
+                 jnp.asarray([[0, 0, 0, 6, 6], [0, 1, 1, 7, 7]],
+                             jnp.float32)],
+            attrs={"pooled_size": (2, 2), "spatial_scale": 1.0},
+            diff=(0,)),
+        "_linalg_slogdet": dict(ins=[_spd(4)]),
+        "linalg_slogdet": dict(ins=[_spd(4)]),
+        # b > a everywhere: floor(a/b) == 0, mod is smooth
+        "_mod": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "broadcast_mod": dict(ins=[_f32(3, 4), _f32(3, 4) + 1.0]),
+        "arccosh": dict(ins=[_f32(3, 4) + 1.5]),
+        "_contrib_box_iou": dict(
+            ins=[jnp.asarray([[0.1, 0.1, 0.52, 0.47],
+                              [0.3, 0.25, 0.83, 0.76]], jnp.float32),
+                 jnp.asarray([[0.22, 0.18, 0.61, 0.59],
+                              [0.55, 0.52, 0.94, 0.9]], jnp.float32)],
+            eps=1e-3, rtol=0.08, atol=0.02),
+    }
+
+
+def _arange_input():
+    return jnp.arange(12, dtype=jnp.float32).reshape(3, 4) * 0.137 + 0.2
+
+
+def _spd(n):
+    m = _RNG.randn(n, n).astype(np.float32) * 0.3
+    return jnp.asarray(m @ m.T + np.eye(n, dtype=np.float32) * 2.0)
+
+
+# bridge/meta ops that cannot be exercised without user registration;
+# their behavior is covered by dedicated tests
+_EXCLUDED = {
+    "Custom": "user custom-op bridge (tests/test_operator.py)",
+    "_subgraph": "subgraph container (tests/test_model_parallel_subgraph.py)",
+}
+
+# finite differences are mathematically wrong for these — analytic
+# gradients are still exercised (jax.grad runs), only the FD comparison
+# is skipped. They still count as checked for coverage because their
+# gradient CONTRACT (zero / custom) is what the reference registers too.
+_FD_EXCLUDED = {
+    "round": "piecewise-constant: gradient is zero by contract, FD "
+             "explodes across half-integer steps",
+    "rint": "piecewise-constant, zero gradient by contract",
+    "ceil": "piecewise-constant, zero gradient by contract",
+    "floor": "piecewise-constant, zero gradient by contract",
+    "trunc": "piecewise-constant, zero gradient by contract",
+    "fix": "piecewise-constant, zero gradient by contract",
+    "sign": "piecewise-constant, zero gradient by contract",
+    "stop_gradient": "gradient is zero BY DEFINITION; FD sees identity",
+    "linalg_syevd": "eigenvector gauge freedom makes the FD direction "
+                    "ill-defined (reference also skips syevd grad)",
+    "_linalg_syevd": "same as linalg_syevd",
+    "_linalg_gelqf": "LQ factor gauge freedom (sign of Q rows) makes "
+                     "the FD of sum(L)+sum(Q) ill-defined",
+    "linalg_gelqf": "same as _linalg_gelqf",
+    # these combine output with a HARD-CODED backward that ignores the
+    # head cotangent (reference: softmax_output-inl.h, regression ops) —
+    # FD sees the forward (identity/softmax), analytic sees the contract
+    "Softmax": "backward fixed to (softmax - one_hot(label)) by contract",
+    "SoftmaxOutput": "backward fixed to (softmax - one_hot(label))",
+    "LinearRegressionOutput": "backward fixed to (pred - label)",
+    "LogisticRegressionOutput": "backward fixed to (sigmoid - label)",
+    "MAERegressionOutput": "backward fixed to sign(pred - label)",
+    "make_loss": "head-gradient-replacing contract",
+}
+# aliases share the implementation of their target — checking one is
+# checking both; count them via their canonical op
+_ALIAS_OF = {"_contrib_CTCLoss": "CTCLoss", "ctc_loss": "CTCLoss",
+             "linalg_maketrian": "_linalg_maketrian",
+             "BlockGrad": "stop_gradient", "MakeLoss": "make_loss"}
+
+
+def _probe_arity(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) \
+                and p.default is p.empty and p.name != "key":
+            n += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            return max(n, 2)
+    return n
+
+
+def _build_case(name, op, specs):
+    """Return (inputs, attrs, diff_idx, fd_opts) or None."""
+    if name in specs:
+        s = specs[name]
+        ins = s["ins"]
+        fd = {k: s[k] for k in ("eps", "rtol", "atol") if k in s}
+        return ins, s.get("attrs", {}), s.get("diff",
+                                              tuple(range(len(ins)))), fd
+    # per-op deterministic inputs: adding a spec for one op must not
+    # reshuffle every other op's random draw
+    import zlib
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    n = _probe_arity(op.fn)
+    if not n:
+        return None
+    for shape in [(3, 4), (2, 3, 4, 4), (4, 4)]:
+        ins = [jnp.asarray(rng.uniform(0.25, 0.75, shape).astype(
+            np.float32)) for _ in range(n)]
+        try:
+            jax.eval_shape(lambda *a: op.fn(*a), *ins)
+            return ins, {}, tuple(range(n)), {}
+        except Exception:
+            continue
+    return None
+
+
+def _scalar_out(op, attrs):
+    def f(*arrs):
+        out = op.fn(*arrs, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        tot = 0.0
+        for o in outs:
+            if jnp.issubdtype(o.dtype, jnp.floating):
+                tot = tot + jnp.sum(o.astype(jnp.float32))
+        return tot
+    return f
+
+
+def _numeric_grad_ok(op, ins, attrs, diff_idx, eps=1e-2, rtol=0.06,
+                     atol=5e-3):
+    f = _scalar_out(op, attrs)
+    fd_idx = [i for i in diff_idx
+              if jnp.issubdtype(ins[i].dtype, jnp.floating)]
+    if not fd_idx:
+        return True
+    analytic = jax.grad(f, argnums=tuple(fd_idx))(*ins)
+    for slot, gi in zip(fd_idx, analytic):
+        x = np.asarray(ins[slot], np.float32)
+        num = np.zeros_like(x)
+        flat = x.ravel()
+        for j in range(flat.size):
+            for sgn in (+1, -1):
+                pert = flat.copy()
+                pert[j] += sgn * eps
+                args = list(ins)
+                args[slot] = jnp.asarray(pert.reshape(x.shape))
+                num.ravel()[j] += sgn * float(f(*args))
+        num /= (2 * eps)
+        np.testing.assert_allclose(np.asarray(gi), num, rtol=rtol,
+                                   atol=atol)
+    return True
+
+
+def _sweep_universe():
+    specs = _spec_table()
+    universe = []
+    for name in registry.list_ops():
+        op = registry.get_op(name)
+        if not op.differentiable or op.mutate_inputs or op.needs_rng:
+            continue
+        if name in _EXCLUDED or name in _ALIAS_OF:
+            continue
+        universe.append((name, op, specs))
+    return universe
+
+
+_UNIVERSE = _sweep_universe()
+
+
+@pytest.mark.parametrize("name,op,specs", _UNIVERSE,
+                         ids=[u[0] for u in _UNIVERSE])
+def test_numeric_gradient(name, op, specs):
+    case = _build_case(name, op, specs)
+    if case is None:
+        pytest.skip("no input spec for %s" % name)
+    ins, attrs, diff_idx, fd = case
+    if name in _FD_EXCLUDED:
+        # analytic gradient must still trace and evaluate finite
+        f = _scalar_out(op, attrs)
+        fd_idx = tuple(i for i in diff_idx
+                       if jnp.issubdtype(ins[i].dtype, jnp.floating))
+        if fd_idx:
+            gs = jax.grad(f, argnums=fd_idx)(*ins)
+            for g in gs:
+                assert np.isfinite(np.asarray(g)).all()
+        return
+    _numeric_grad_ok(op, ins, attrs, diff_idx, **fd)
+
+
+def test_gradient_sweep_coverage():
+    """>80% of the differentiable op surface must actually be gradient-
+    checked (VERDICT round-3 task 6; reference test_utils.py:790)."""
+    specs = _spec_table()
+    checked = sum(1 for name, op, _ in _UNIVERSE
+                  if _build_case(name, op, specs) is not None)
+    total = len(_UNIVERSE)
+    coverage = checked / total
+    assert coverage > 0.8, \
+        "gradient sweep covers %d/%d = %.0f%% (<80%%)" % (
+            checked, total, 100 * coverage)
+
+
+def test_bf16_consistency_sweep():
+    """Every probeable op family member must produce bf16 outputs within
+    bf16 tolerance of its fp32 outputs (the TPU analog of the
+    reference's cross-backend check_consistency, test_utils.py:1207)."""
+    specs = _spec_table()
+    failures, checked = [], 0
+    for name in registry.list_ops():
+        op = registry.get_op(name)
+        if op.needs_rng or op.mutate_inputs:
+            continue
+        if name in _EXCLUDED or name in _ALIAS_OF:
+            continue
+        case = _build_case(name, op, specs)
+        if case is None:
+            continue
+        ins, attrs, _, _fd = case
+        try:
+            ref = op.fn(*ins, **attrs)
+        except Exception:
+            continue
+        cast = [x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x
+                for x in ins]
+        try:
+            out = op.fn(*cast, **attrs)
+        except Exception:
+            # rejecting bf16 outright is a legitimate dtype contract
+            # (the reference restricts linalg/LAPACK ops to fp32/fp64,
+            # la_op.cc) — only VALUE mismatches fail the sweep
+            continue
+        refs = ref if isinstance(ref, (list, tuple)) else [ref]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        checked += 1
+        for r, o in zip(refs, outs):
+            if not jnp.issubdtype(np.asarray(r).dtype, np.floating):
+                continue
+            a = np.asarray(r, np.float32)
+            b = np.asarray(o, np.float32)
+            if not np.allclose(a, b, rtol=0.08, atol=0.08):
+                failures.append((name, "max err %.3f" % float(
+                    np.max(np.abs(a - b)))))
+                break
+    assert checked > 150, "bf16 sweep only reached %d ops" % checked
+    assert not failures, failures[:20]

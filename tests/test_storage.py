@@ -1,0 +1,292 @@
+"""Storage/memory component: accounting, per-step HBM profiling,
+optimizer buffer donation, tape freeing, device prefetch staging.
+
+Reference behavior: src/storage/pooled_storage_manager.h,
+src/profiler/storage_profiler.h, kWriteInplace optimizer requests.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, storage
+from mxnet_tpu.base import MXNetError
+
+
+def test_memory_stats_and_live_bytes():
+    a = nd.zeros((256, 256))          # 256 KB
+    a.wait_to_read()
+    lb = storage.live_bytes()
+    assert lb >= a.size * 4
+    rows = storage.largest_live(5)
+    assert rows and rows[0][0] >= 256 * 256 * 4
+    # memory_stats is backend-dependent; must be a dict either way
+    assert isinstance(storage.memory_stats(), dict)
+
+
+def test_step_memory_profiler_records():
+    smp = storage.StepMemoryProfiler()
+    x = nd.zeros((64, 64))
+    x.wait_to_read()
+    rec = smp.step()
+    assert rec["bytes_in_use"] > 0
+    assert smp.peak >= rec["bytes_in_use"] * 0  # peak tracked
+    assert smp.report()["steps"] == 1
+
+
+def test_update_donates_weight_buffer():
+    """sgd_update must alias weight input->output (no double-buffering):
+    the pre-update buffer is invalidated, the NDArray sees new data."""
+    gc.collect()        # drop any unfreed tape entries from other tests
+    w = nd.array(np.ones((8, 8), np.float32))
+    g = nd.array(np.full((8, 8), 0.5, np.float32))
+    w.wait_to_read()
+    old = w._data
+    nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    np.testing.assert_allclose(w.asnumpy(), 0.5)
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(old)            # donated buffer: deleted
+
+
+def test_update_donates_momentum_state_too():
+    gc.collect()
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.ones((4,), np.float32))
+    m = nd.zeros((4,))
+    m.wait_to_read()
+    old_m = m._data
+    nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, wd=0.0)
+    assert float(m.asnumpy()[0]) != 0.0
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(old_m)
+
+
+def test_training_loop_with_donation_is_safe():
+    """forward -> backward -> donated update -> next forward: the freed
+    tape guarantees no stale reference reads a donated buffer."""
+    w = nd.array(np.random.RandomState(0).randn(4, 1).astype(np.float32))
+    w.attach_grad()
+    x = nd.array(np.random.RandomState(1).randn(16, 4).astype(np.float32))
+    y = nd.dot(x, nd.array(np.array([[2.0], [0.0], [-1.0], [0.5]],
+                                    np.float32)))
+    first = prev = None
+    for _ in range(40):
+        with autograd.record():
+            loss = nd.sum((nd.dot(x, w) - y) ** 2) / 16
+        loss.backward()
+        nd.sgd_update(w, w.grad, lr=0.1, wd=0.0)
+        cur = float(loss.asscalar())
+        if prev is not None:
+            assert cur <= prev * 1.001
+        first = first if first is not None else cur
+        prev = cur
+    assert prev < first * 0.05
+
+
+def test_backward_frees_graph_second_backward_raises():
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = nd.sum(w * w)
+    loss.backward()
+    with pytest.raises(MXNetError):
+        loss.backward()
+
+
+def test_retain_graph_allows_second_backward():
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = nd.sum(w * w)
+    loss.backward(retain_graph=True)
+    g1 = w.grad.asnumpy().copy()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), g1)
+
+
+def test_module_update_path_donates():
+    """The Module/executor DP path (the CLI path) gets donation through
+    the same update kernels."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.module.Module(out, data_names=("data",),
+                           label_names=("softmax_label",))
+    from mxnet_tpu.io import NDArrayIter
+    rng = np.random.RandomState(0)
+    it = NDArrayIter(rng.randn(8, 6).astype(np.float32),
+                     rng.randint(0, 4, 8).astype(np.float32), batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(it)
+    mod.forward(batch)
+    mod.backward()
+    wname = "fc_weight"
+    old = mod._exec.arg_dict[wname]._data
+    old.block_until_ready()
+    gc.collect()
+    mod.update()
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(old)            # param buffer was donated
+    assert np.isfinite(mod._exec.arg_dict[wname].asnumpy()).all()
+
+
+def test_donation_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("MXNET_UPDATE_BUFFER_DONATION", "0")
+    from mxnet_tpu.ops import registry
+    registry._jitted.cache_clear()
+    try:
+        w = nd.array(np.ones((4,), np.float32))
+        g = nd.array(np.ones((4,), np.float32))
+        w.wait_to_read()
+        old = w._data
+        nd.sgd_update(w, g, lr=0.5, wd=0.0)
+        np.testing.assert_allclose(np.asarray(old), 1.0)   # still readable
+        np.testing.assert_allclose(w.asnumpy(), 0.5)
+    finally:
+        registry._jitted.cache_clear()
+
+
+def test_prefetching_iter_device_staging():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    rng = np.random.RandomState(0)
+    base = NDArrayIter(rng.randn(32, 3).astype(np.float32),
+                       rng.randn(32).astype(np.float32), batch_size=8)
+    it = PrefetchingIter(base, device_prefetch=True)
+    b = next(it)
+    arr = b.data[0]._data
+    import jax
+    assert list(arr.devices())[0] in jax.devices()
+    np.testing.assert_allclose(b.data[0].asnumpy().shape, (8, 3))
+
+
+def test_donation_suspended_with_retained_graph():
+    """retain_graph=True keeps the tape alive; an update in that state
+    must NOT donate (the second backward still reads the old weight)."""
+    gc.collect()
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = nd.sum(w * w)
+    loss.backward(retain_graph=True)
+    old = w._data
+    nd.sgd_update(w, w.grad, lr=0.1, wd=0.0)
+    np.testing.assert_allclose(np.asarray(old), 1.0)   # NOT donated
+    loss.backward()                                    # still works
+    assert np.isfinite(w.grad.asnumpy()).all()
+
+
+def test_grad_api_with_sparse_ct_returns_rsp():
+    from mxnet_tpu.ndarray import sparse
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    W = nd.array(np.ones((6, 2), np.float32))
+    W.attach_grad()
+    ids = nd.array(np.array([1, 4, 1], np.float32))
+    with autograd.record():
+        out = sparse.embedding(ids, W)
+        loss = nd.sum(out)
+    g = autograd.grad(loss, W)
+    assert isinstance(g, RowSparseNDArray)
+    dense = g.todense().asnumpy()
+    np.testing.assert_allclose(dense[1], 2.0)          # duplicate summed
+    np.testing.assert_allclose(dense[4], 1.0)
+    np.testing.assert_allclose(dense[0], 0.0)
+
+
+def test_kvstore_pull_does_not_alias_store():
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    gc.collect()
+    kv = mx.kvstore.create("local")
+    w = nd.array(np.ones((4,), np.float32))
+    kv.init(0, w)
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.1))
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    out.wait_to_read()
+    kv.push(0, nd.array(np.ones((4,), np.float32)))   # donating update
+    # the pulled copy must survive the store-side donation
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_dataloader_process_workers_shared_memory():
+    """Process workers ship batches through shared memory (reference:
+    gluon/data/dataloader.py multiprocessing + shm transport)."""
+    from mxnet_tpu.gluon import data as gdata
+    rng = np.random.RandomState(0)
+    ds = gdata.ArrayDataset(rng.rand(48, 5).astype(np.float32),
+                            np.arange(48, dtype=np.float32))
+    dl = gdata.DataLoader(ds, batch_size=12, num_workers=2)
+    seen = []
+    for x, y in dl:
+        assert x.shape == (12, 5)
+        seen.extend(y.asnumpy().tolist())
+    assert seen == list(range(48))           # order + completeness
+    # error propagation from a worker process
+    class Bad(gdata.Dataset):
+        def __len__(self):
+            return 4
+        def __getitem__(self, i):
+            raise ValueError("boom")
+    with pytest.raises(RuntimeError):
+        for _ in gdata.DataLoader(Bad(), batch_size=2, num_workers=1):
+            pass
+
+
+def test_detach_survives_donating_update():
+    gc.collect()
+    w = nd.array(np.ones((4,), np.float32))
+    snap = w.detach()
+    g = nd.array(np.ones((4,), np.float32))
+    nd.sgd_update(w, g, lr=0.5, wd=0.0)
+    np.testing.assert_allclose(snap.asnumpy(), 1.0)   # snapshot intact
+    np.testing.assert_allclose(w.asnumpy(), 0.5)
+
+
+def test_grad_frees_graph_by_default():
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = nd.sum(w * w)
+    autograd.grad(loss, w)
+    with pytest.raises(MXNetError):
+        autograd.grad(loss, w)            # freed, like backward()
+    with autograd.record():
+        loss2 = nd.sum(w * w * w)
+    autograd.grad(loss2, w, retain_graph=True)
+    g = autograd.grad(loss2, w)           # retained -> works again
+    np.testing.assert_allclose(g.asnumpy(), 3.0)
+
+
+def test_kvstore_mixed_dense_sparse_push_densifies():
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    kv = mx.kvstore.create("local")
+    kv.init(0, nd.zeros((4, 1)))
+    rsp = RowSparseNDArray(np.ones((1, 1), np.float32), np.array([2]),
+                           (4, 1))
+    dense = nd.array(np.full((4, 1), 2.0, np.float32))
+    kv.push(0, [rsp, dense])
+    out = nd.zeros((4, 1))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy().ravel(), [2, 2, 3, 2])
+
+
+def test_dataloader_abandoned_iteration_reclaims_shm():
+    from mxnet_tpu.gluon import data as gdata
+    import glob
+    rng = np.random.RandomState(0)
+    ds = gdata.ArrayDataset(rng.rand(64, 4).astype(np.float32),
+                            np.arange(64, dtype=np.float32))
+    before = set(glob.glob("/dev/shm/psm_*"))
+    dl = gdata.DataLoader(ds, batch_size=8, num_workers=2, prefetch=6)
+    it = iter(dl)
+    next(it)
+    it.close()                            # abandon mid-epoch
+    gc.collect()
+    leaked = set(glob.glob("/dev/shm/psm_*")) - before
+    assert not leaked, leaked

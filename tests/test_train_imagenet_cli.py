@@ -100,3 +100,50 @@ def test_image_iter_num_parts(tmp_path):
         assert len(labels) == 10
         seen.extend(labels)
     assert sorted(seen) == list(range(30))
+
+
+def test_cli_dist_tpu_sync_two_workers(tmp_path):
+    """The literal BASELINE config shape: tools/launch.py -n 2 local +
+    train_imagenet.py --kv-store dist_tpu_sync with num_parts data
+    sharding (reference: example/image-classification/train_imagenet.py
+    + tools/launch.py). Both ranks see DISJOINT data halves; sync
+    aggregation through the PS must leave both ranks with identical
+    final parameters."""
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "train.rec")
+    idx_path = str(tmp_path / "train.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(64):
+        img = rng.randint(0, 255, (36, 36, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), buf.tobytes()))
+    rec.close()
+
+    prefix = str(tmp_path / "ck" / "model")
+    launch = os.path.join(ROOT, "tools", "launch.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXNET_TPU_PS_URI", None)
+    r = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--launcher", "local",
+         "--sync-mode", "sync", "--",
+         sys.executable, CLI, "--network", "mlp",
+         "--image-shape", "3,32,32", "--num-classes", "10",
+         "--num-examples", "64", "--batch-size", "16",
+         "--num-epochs", "1", "--kv-store", "dist_tpu_sync",
+         "--data-train", rec_path, "--model-prefix", prefix],
+        capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+
+    from mxnet_tpu import model as mxmodel
+    _, args0, _ = mxmodel.load_checkpoint(prefix, 1)
+    _, args1, _ = mxmodel.load_checkpoint(prefix + "-1", 1)
+    assert set(args0) == set(args1)
+    for name in args0:
+        np.testing.assert_allclose(
+            args0[name].asnumpy(), args1[name].asnumpy(), rtol=1e-5,
+            atol=1e-6, err_msg="rank divergence in %s" % name)

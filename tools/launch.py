@@ -44,6 +44,8 @@ def main():
                     help="extra KEY=VALUE for children")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
     if not args.command:
         ap.error("no command given")
 
@@ -68,17 +70,22 @@ def main():
         [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=server_env)
     time.sleep(1.0)  # listener up
 
-    workers = []
-    for rank in range(args.num_workers):
-        wenv = dict(base_env, MXNET_TPU_ROLE="worker",
-                    MXNET_TPU_RANK=str(rank))
-        workers.append(subprocess.Popen(args.command, env=wenv))
-
+    # everything after the server exists runs under try/finally: an
+    # orphaned server would inherit the caller's stdout/stderr pipes and
+    # hang a capturing parent long after launch.py itself exits
     rc = 0
+    workers = []
     try:
+        for rank in range(args.num_workers):
+            wenv = dict(base_env, MXNET_TPU_ROLE="worker",
+                        MXNET_TPU_RANK=str(rank))
+            workers.append(subprocess.Popen(args.command, env=wenv))
         for w in workers:
             rc |= w.wait()
     finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
         server.send_signal(signal.SIGTERM)
         try:
             server.wait(timeout=5)
