@@ -60,7 +60,7 @@ class NDArray {
 
   std::vector<uint32_t> Shape() const {
     uint32_t ndim = 0;
-    uint32_t buf[8] = {0};
+    uint32_t buf[MXTPU_MAX_NDIM] = {0};
     Check(MXNDArrayGetShape(handle_, &ndim, buf));
     return std::vector<uint32_t>(buf, buf + ndim);
   }

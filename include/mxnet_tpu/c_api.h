@@ -45,8 +45,11 @@ const char* MXGetLastError(void);
 int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dtype,
                     const char* dev_type, int dev_id, NDArrayHandle* out);
 int MXNDArrayFree(NDArrayHandle h);
+/* Max tensor rank across the ABI; shape buffers must hold this many. */
+#define MXTPU_MAX_NDIM 32
+
 int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
-                      uint32_t* out_shape /* caller buf, >= 8 */);
+                      uint32_t* out_shape /* >= MXTPU_MAX_NDIM */);
 int MXNDArrayGetDType(NDArrayHandle h, int* out_dtype);
 int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
                              size_t nbytes);

@@ -1,51 +1,603 @@
-"""ONNX import/export.
+"""ONNX import/export with a vendored protobuf wire codec.
 
-Reference: python/mxnet/contrib/onnx/ (mx2onnx export_model,
-onnx2mx import_model).
+Reference: python/mxnet/contrib/onnx/ (mx2onnx/export_model,
+onnx2mx/import_model). The ``onnx`` package is not in this image, so
+this module carries its own minimal protobuf WRITER and READER for the
+ONNX wire format (onnx.proto3: ModelProto/GraphProto/NodeProto/
+TensorProto/...). Exported files are spec-compliant opset-13 models any
+ONNX runtime can load; import rebuilds a Symbol + params from the same
+subset.
 
-The ``onnx`` package is not in this image, so conversion to/from the
-protobuf format is gated: the API surface exists, checks for onnx at
-call time, and raises with guidance. Model interchange WITHIN the
-framework uses the native symbol-JSON + params format
-(Symbol.save / mx.nd.save, model.save_checkpoint), which round-trips
-losslessly and is what the serving path consumes.
+Supported op subset (the classification-model surface the reference's
+converter is exercised on): Conv, Gemm (FullyConnected), Relu/Sigmoid/
+Tanh/Softplus, MaxPool/AveragePool/Global*Pool, BatchNormalization,
+Flatten, Softmax, Dropout, Add/Mul/Sub/Div, Concat, Reshape,
+LeakyRelu.
 """
 from __future__ import annotations
+
+import struct
+
+import numpy as _np
 
 from ..base import MXNetError
 
 __all__ = ["export_model", "import_model", "get_model_metadata"]
 
+_OPSET = 13
+_IR_VERSION = 8
 
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError:
-        raise MXNetError(
-            "the onnx package is not installed in this environment; "
-            "use Symbol.save/load + mx.nd.save/load (or "
-            "model.save_checkpoint) for native model interchange") \
-            from None
+# ONNX TensorProto.DataType
+_DT_FLOAT = 1
+_DT_INT64 = 7
+_NP_TO_DT = {"float32": _DT_FLOAT, "int64": _DT_INT64}
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
 
 
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Export a symbol+params to ONNX (reference: mx2onnx/export_model).
-    Requires the optional onnx package."""
-    _require_onnx()
-    raise MXNetError("ONNX graph conversion requires the onnx package's "
-                     "helper builders, unavailable in this build")
+# ---------------------------------------------------------------------------
+# protobuf wire primitives
+# ---------------------------------------------------------------------------
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field, value):
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _f_float(field, value):
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _parse(buf):
+    """Decode one message into {field: [(wire_type, value), ...]}."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise MXNetError("unsupported protobuf wire type %d" % wire)
+        fields.setdefault(field, []).append((wire, val))
+    return fields
+
+
+def _one(fields, field, default=None):
+    vals = fields.get(field)
+    return vals[0][1] if vals else default
+
+
+def _all(fields, field):
+    return [v for _, v in fields.get(field, [])]
+
+
+def _as_str(v):
+    return v.decode("utf-8") if isinstance(v, (bytes, bytearray)) else v
+
+
+def _int_list(fields, field):
+    """Repeated int64 values, accepting BOTH encodings: unpacked varints
+    (one tag per value — what this writer emits) and proto3 PACKED
+    (one length-delimited blob — what official serializers emit)."""
+    out = []
+    for wire, v in fields.get(field, []):
+        if wire == 0:
+            out.append(_sint(v))
+        elif wire == 2:                                # packed blob
+            pos = 0
+            while pos < len(v):
+                val, pos = _read_varint(v, pos)
+                out.append(_sint(val))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ONNX message builders
+# ---------------------------------------------------------------------------
+
+def _attr_int(name, value):
+    return _f_bytes(1, name) + _f_varint(3, value) + _f_varint(20, 2)
+
+
+def _attr_float(name, value):
+    return _f_bytes(1, name) + _f_float(2, value) + _f_varint(20, 1)
+
+
+def _attr_ints(name, values):
+    body = _f_bytes(1, name)
+    for v in values:
+        body += _f_varint(8, v)
+    return body + _f_varint(20, 7)
+
+
+def _attr_str(name, value):
+    return _f_bytes(1, name) + _f_bytes(4, value) + _f_varint(20, 3)
+
+
+def _tensor(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    dt = _NP_TO_DT.get(str(arr.dtype))
+    if dt is None:
+        arr = arr.astype(_np.float32)
+        dt = _DT_FLOAT
+    body = b""
+    for d in arr.shape:
+        body += _f_varint(1, d)
+    body += _f_varint(2, dt)
+    body += _f_bytes(8, name)
+    body += _f_bytes(9, arr.tobytes())
+    return body
+
+
+def _value_info(name, shape, dt=_DT_FLOAT):
+    dims = b""
+    for d in shape:
+        dims += _f_bytes(1, _f_varint(1, d))          # Dimension.dim_value
+    shape_proto = dims
+    tensor_type = _f_varint(1, dt) + _f_bytes(2, shape_proto)
+    type_proto = _f_bytes(1, tensor_type)
+    return _f_bytes(1, name) + _f_bytes(2, type_proto)
+
+
+def _node(op_type, inputs, outputs, name, attrs_bytes=b""):
+    body = b""
+    for i in inputs:
+        body += _f_bytes(1, i)
+    for o in outputs:
+        body += _f_bytes(2, o)
+    body += _f_bytes(3, name)
+    body += _f_bytes(4, op_type)
+    body += attrs_bytes
+    return body
+
+
+def _wrap_attrs(attr_bodies):
+    return b"".join(_f_bytes(5, a) for a in attr_bodies)
+
+
+# ---------------------------------------------------------------------------
+# export: symbol JSON -> ONNX nodes
+# ---------------------------------------------------------------------------
+
+def _ints(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)]
+
+
+def _pads4(attrs):
+    p = _ints(attrs.get("pad", (0, 0)))
+    if len(p) == 1:
+        p = p * 2
+    return p + p                                     # [top,left,bot,right]
+
+
+def _export_node(node, in_names, out_name, params):
+    """Translate one symbol node to a list of ONNX node bytes."""
+    op = node["op"]
+    attrs = node.get("attrs") or {}
+    name = node["name"]
+    if op == "Convolution":
+        a = [_attr_ints("kernel_shape", _ints(attrs["kernel"])),
+             _attr_ints("strides", _ints(attrs.get("stride", (1, 1)))),
+             _attr_ints("pads", _pads4(attrs)),
+             _attr_ints("dilations", _ints(attrs.get("dilate", (1, 1)))),
+             _attr_int("group", int(attrs.get("num_group", 1)))]
+        return [_node("Conv", in_names, [out_name], name, _wrap_attrs(a))]
+    if op == "FullyConnected":
+        flatten = str(attrs.get("flatten", True)).lower() != "false" and \
+            attrs.get("flatten", True) is not False
+        if not flatten:
+            # flatten=False keeps leading dims: MatMul with a transposed
+            # weight initializer (+ Add for bias) instead of Gemm
+            wt_name = name + "_weight_T"
+            wsrc = in_names[1]
+            if wsrc in params:
+                params[wt_name] = _np.ascontiguousarray(params[wsrc].T)
+            mm_out = out_name if len(in_names) < 3 else name + "_mm"
+            nodes = [_node("MatMul", [in_names[0], wt_name], [mm_out],
+                           name)]
+            if len(in_names) >= 3:
+                nodes.append(_node("Add", [mm_out, in_names[2]],
+                                   [out_name], name + "_bias"))
+            return nodes
+        flat = name + "_flat"
+        nodes = [_node("Flatten", [in_names[0]], [flat], name + "_flatten",
+                       _wrap_attrs([_attr_int("axis", 1)]))]
+        gemm_in = [flat] + in_names[1:]
+        a = [_attr_int("transB", 1), _attr_float("alpha", 1.0),
+             _attr_float("beta", 1.0)]
+        nodes.append(_node("Gemm", gemm_in, [out_name], name,
+                           _wrap_attrs(a)))
+        return nodes
+    if op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus", "softsign": "Softsign"}[
+                   attrs.get("act_type", "relu")]
+        return [_node(act, in_names, [out_name], name)]
+    if op == "LeakyReLU":
+        a = [_attr_float("alpha", float(attrs.get("slope", 0.25)))]
+        return [_node("LeakyRelu", in_names, [out_name], name,
+                      _wrap_attrs(a))]
+    if op == "Pooling":
+        ptype = attrs.get("pool_type", "max")
+        if attrs.get("global_pool"):
+            onnx_op = "GlobalMaxPool" if ptype == "max" else \
+                "GlobalAveragePool"
+            return [_node(onnx_op, in_names, [out_name], name)]
+        onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+        a = [_attr_ints("kernel_shape", _ints(attrs["kernel"])),
+             _attr_ints("strides",
+                        _ints(attrs.get("stride", attrs["kernel"]))),
+             _attr_ints("pads", _pads4(attrs))]
+        return [_node(onnx_op, in_names, [out_name], name,
+                      _wrap_attrs(a))]
+    if op == "BatchNorm":
+        a = [_attr_float("epsilon", float(attrs.get("eps", 1e-3))),
+             _attr_float("momentum", float(attrs.get("momentum", 0.9)))]
+        return [_node("BatchNormalization", in_names, [out_name], name,
+                      _wrap_attrs(a))]
+    if op == "Flatten":
+        return [_node("Flatten", in_names, [out_name], name,
+                      _wrap_attrs([_attr_int("axis", 1)]))]
+    if op in ("softmax", "Softmax", "SoftmaxOutput"):
+        ins = in_names[:1]                           # drop label input
+        a = [_attr_int("axis", int(attrs.get("axis", -1)))]
+        return [_node("Softmax", ins, [out_name], name, _wrap_attrs(a))]
+    if op == "Dropout":
+        return [_node("Dropout", in_names[:1], [out_name], name)]
+    if op in ("elemwise_add", "broadcast_add", "_plus", "_add"):
+        return [_node("Add", in_names, [out_name], name)]
+    if op in ("elemwise_sub", "broadcast_sub"):
+        return [_node("Sub", in_names, [out_name], name)]
+    if op in ("elemwise_mul", "broadcast_mul"):
+        return [_node("Mul", in_names, [out_name], name)]
+    if op in ("elemwise_div", "broadcast_div"):
+        return [_node("Div", in_names, [out_name], name)]
+    if op == "Concat":
+        a = [_attr_int("axis", int(attrs.get("dim", 1)))]
+        return [_node("Concat", in_names, [out_name], name,
+                      _wrap_attrs(a))]
+    if op == "Reshape":
+        shape_name = name + "_shape"
+        params[shape_name] = _np.asarray(_ints(attrs["shape"]), _np.int64)
+        return [_node("Reshape", in_names + [shape_name], [out_name],
+                      name)]
+    raise MXNetError("ONNX export: unsupported op %r (supported subset "
+                     "documented in contrib/onnx.py)" % op)
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False,
+                 aux_params=None):
+    """Export a Symbol + params to an ONNX file (reference:
+    mx2onnx/export_model). ``params`` may carry ``arg:``/``aux:``
+    prefixes (save_checkpoint convention) or be plain name->NDArray.
+    input_shape: one shape tuple, or a list with one entry per data
+    input. Returns the file path."""
+    import json as _json
+
+    flat_params = {}
+    for k, v in dict(params or {}).items():
+        flat_params[k.split(":", 1)[-1]] = _np.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+    for k, v in dict(aux_params or {}).items():
+        flat_params[k.split(":", 1)[-1]] = _np.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    graph = _json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    heads = [h[0] for h in graph["heads"]]
+    shapes = input_shape if isinstance(input_shape, list) else \
+        [input_shape]
+
+    out_names = {}
+    onnx_nodes = []
+    inputs = []
+    data_idx = 0
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            out_names[i] = node["name"]
+            if node["name"] not in flat_params:
+                if node["name"].endswith("_label"):
+                    continue                         # training-only input
+                inputs.append((node["name"],
+                               shapes[min(data_idx, len(shapes) - 1)]))
+                data_idx += 1
+            continue
+        out_names[i] = node["name"] + "_out" if i not in heads \
+            else node["name"] + "_output"
+        in_names = []
+        for (src, _out_i, *_rest) in node["inputs"]:
+            nm = out_names.get(src)
+            if nm is not None:
+                in_names.append(nm)
+        onnx_nodes += _export_node(node, in_names, out_names[i],
+                                   flat_params)
+
+    body = b"".join(_f_bytes(1, n) for n in onnx_nodes)
+    body += _f_bytes(2, "mxnet_tpu")
+    for pname, arr in flat_params.items():
+        body += _f_bytes(5, _tensor(pname, arr))
+    for iname, shape in inputs:
+        body += _f_bytes(11, _value_info(iname, shape))
+    for h in heads:
+        body += _f_bytes(12, _value_info(out_names[h], ()))
+    graph_bytes = body
+
+    model = _f_varint(1, _IR_VERSION)
+    model += _f_bytes(2, "mxnet_tpu")
+    model += _f_bytes(7, graph_bytes)
+    opset = _f_bytes(1, "") + _f_varint(2, _OPSET)
+    model += _f_bytes(8, opset)
+
+    if onnx_file_path:
+        with open(onnx_file_path, "wb") as f:
+            f.write(model)
+    return onnx_file_path if onnx_file_path else model
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> Symbol + params
+# ---------------------------------------------------------------------------
+
+def _sint(v):
+    """Interpret a decoded varint as two's-complement int64 (protobuf
+    int64 fields encode negatives as 10-byte varints)."""
+    v = int(v)
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_attrs(node_fields):
+    out = {}
+    for raw in _all(node_fields, 5):
+        f = _parse(raw)
+        name = _as_str(_one(f, 1))
+        atype = _one(f, 20)
+        if atype == 2:
+            out[name] = _sint(_one(f, 3))
+        elif atype == 1:
+            out[name] = _one(f, 2)
+        elif atype == 3:
+            out[name] = _as_str(_one(f, 4))
+        elif atype == 7:
+            out[name] = _int_list(f, 8)
+    return out
+
+
+def _decode_tensor(raw):
+    f = _parse(raw)
+    dims = tuple(_int_list(f, 1))
+    dt = _one(f, 2, _DT_FLOAT)
+    name = _as_str(_one(f, 8))
+    raw_data = _one(f, 9)
+    np_dt = _np.dtype(_DT_TO_NP.get(dt, "float32"))
+    if raw_data is not None:
+        arr = _np.frombuffer(raw_data, dtype=np_dt).reshape(dims).copy()
+    else:                                            # float_data fallback
+        arr = _np.asarray(_all(f, 4), dtype=np_dt).reshape(dims)
+    return name, arr
 
 
 def import_model(model_file):
-    """Import an ONNX model (reference: onnx2mx/import_model)."""
-    _require_onnx()
-    raise MXNetError("ONNX graph conversion requires the onnx package's "
-                     "helper builders, unavailable in this build")
+    """Import an ONNX file (this module's supported subset) back into
+    (sym, arg_params, aux_params) (reference: onnx2mx/import_model)."""
+    import mxnet_tpu as mx
+
+    if isinstance(model_file, (bytes, bytearray)):
+        blob = bytes(model_file)
+    else:
+        with open(model_file, "rb") as f:
+            blob = f.read()
+    model = _parse(blob)
+    graph = _parse(_one(model, 7))
+
+    inits = {}
+    for raw in _all(graph, 5):
+        name, arr = _decode_tensor(raw)
+        inits[name] = arr
+
+    env = {}
+    for raw in _all(graph, 11):                      # graph inputs
+        f = _parse(raw)
+        name = _as_str(_one(f, 1))
+        if name not in inits:
+            env[name] = mx.sym.Variable(name)
+
+    arg_params, aux_params = {}, {}
+    last = None
+    for raw in _all(graph, 1):                       # nodes, topo order
+        f = _parse(raw)
+        op_type = _as_str(_one(f, 4))
+        name = _as_str(_one(f, 3)) or op_type.lower()
+        ins = [_as_str(v) for v in _all(f, 1)]
+        outs = [_as_str(v) for v in _all(f, 2)]
+        attrs = _decode_attrs(f)
+
+        def arg(i):
+            nm = ins[i]
+            if nm in env:
+                return env[nm]
+            if nm in inits:
+                # carry the initializer's shape so shape inference works
+                # for ops that cannot derive it (e.g. a broadcast Add
+                # bias from the MatMul path)
+                v = mx.sym.Variable(nm, shape=inits[nm].shape)
+                env[nm] = v
+                arg_params[nm] = mx.nd.array(inits[nm])
+                return v
+            raise MXNetError("ONNX import: undefined input %r" % nm)
+
+        if op_type == "Conv":
+            pads = attrs.get("pads", [0, 0, 0, 0])
+            num_filter = inits[ins[1]].shape[0]
+            kw = dict(kernel=tuple(attrs["kernel_shape"]),
+                      stride=tuple(attrs.get("strides", [1, 1])),
+                      dilate=tuple(attrs.get("dilations", [1, 1])),
+                      pad=tuple(pads[:len(pads) // 2]),
+                      num_group=int(attrs.get("group", 1)),
+                      num_filter=num_filter, name=name)
+            args = [arg(0), arg(1)]
+            if len(ins) > 2:
+                args.append(arg(2))
+            else:
+                kw["no_bias"] = True
+            out = mx.sym.Convolution(*args, **kw)
+        elif op_type == "Gemm":
+            num_hidden = inits[ins[1]].shape[0]
+            args = [arg(0), arg(1)]
+            kw = dict(num_hidden=num_hidden, name=name)
+            if len(ins) > 2:
+                args.append(arg(2))
+            else:
+                kw["no_bias"] = True
+            out = mx.sym.FullyConnected(*args, **kw)
+        elif op_type == "Flatten":
+            out = mx.sym.Flatten(arg(0), name=name)
+        elif op_type in ("Relu", "Sigmoid", "Tanh", "Softplus",
+                         "Softsign"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softplus": "softrelu", "Softsign": "softsign"}
+            out = mx.sym.Activation(arg(0), act_type=act[op_type],
+                                    name=name)
+        elif op_type == "LeakyRelu":
+            out = mx.sym.LeakyReLU(arg(0),
+                                   slope=float(attrs.get("alpha", 0.01)),
+                                   name=name)
+        elif op_type in ("MaxPool", "AveragePool"):
+            pads = attrs.get("pads", [0, 0, 0, 0])
+            out = mx.sym.Pooling(
+                arg(0), kernel=tuple(attrs["kernel_shape"]),
+                stride=tuple(attrs.get("strides", attrs["kernel_shape"])),
+                pad=tuple(pads[:len(pads) // 2]),
+                pool_type="max" if op_type == "MaxPool" else "avg",
+                name=name)
+        elif op_type in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = mx.sym.Pooling(
+                arg(0), global_pool=True, kernel=(1, 1),
+                pool_type="max" if op_type == "GlobalMaxPool" else "avg",
+                name=name)
+        elif op_type == "BatchNormalization":
+            out = mx.sym.BatchNorm(
+                arg(0), arg(1), arg(2), arg(3), arg(4),
+                eps=float(attrs.get("epsilon", 1e-5)),
+                momentum=float(attrs.get("momentum", 0.9)), name=name)
+        elif op_type == "MatMul":
+            # flatten=False FullyConnected export path: weight arrives
+            # transposed (C, H)
+            w_np = inits[ins[1]]
+            wname = name + "_weight"
+            wvar = mx.sym.Variable(wname)
+            env[ins[1]] = wvar
+            arg_params[wname] = mx.nd.array(
+                _np.ascontiguousarray(w_np.T))
+            out = mx.sym.FullyConnected(arg(0), wvar,
+                                        num_hidden=w_np.shape[1],
+                                        flatten=False, no_bias=True,
+                                        name=name)
+        elif op_type == "Softmax":
+            out = mx.sym.softmax(arg(0),
+                                 axis=int(attrs.get("axis", -1)),
+                                 name=name)
+        elif op_type == "Dropout":
+            out = mx.sym.Dropout(arg(0), name=name)
+        elif op_type in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": mx.sym.broadcast_add,
+                  "Sub": mx.sym.broadcast_sub,
+                  "Mul": mx.sym.broadcast_mul,
+                  "Div": mx.sym.broadcast_div}[op_type]
+            out = fn(arg(0), arg(1), name=name)
+        elif op_type == "Concat":
+            out = mx.sym.Concat(*[arg(i) for i in range(len(ins))],
+                                dim=int(attrs.get("axis", 1)), name=name)
+        elif op_type == "Reshape":
+            shape = tuple(int(v) for v in inits[ins[1]].ravel())
+            out = mx.sym.Reshape(arg(0), shape=shape, name=name)
+        else:
+            raise MXNetError("ONNX import: unsupported op %r" % op_type)
+        env[outs[0]] = out
+        last = out
+    # split initializers by how the rebuilt symbol classifies them
+    # (moving BN stats are auxiliary states, everything else args)
+    aux_names = set(last.list_auxiliary_states()) if last is not None \
+        else set()
+    for n in list(arg_params):
+        if n in aux_names:
+            aux_params[n] = arg_params.pop(n)
+    return last, arg_params, aux_params
 
 
 def get_model_metadata(model_file):
-    _require_onnx()
-    raise MXNetError("ONNX metadata requires the onnx package, "
-                     "unavailable in this build")
+    """Input/output names + shapes of an ONNX file
+    (reference: onnx2mx get_model_metadata)."""
+    if isinstance(model_file, (bytes, bytearray)):
+        blob = bytes(model_file)
+    else:
+        with open(model_file, "rb") as f:
+            blob = f.read()
+    model = _parse(blob)
+    graph = _parse(_one(model, 7))
+
+    def _vi(raw):
+        f = _parse(raw)
+        name = _as_str(_one(f, 1))
+        shape = []
+        tp = _one(f, 2)
+        if tp:
+            tt = _one(_parse(tp), 1)
+            if tt:
+                sh = _one(_parse(tt), 2)
+                if sh:
+                    for draw in _all(_parse(sh), 1):
+                        shape.append(_one(_parse(draw), 1, 0))
+        return name, tuple(shape)
+
+    return {
+        "input_tensor_data": [_vi(r) for r in _all(graph, 11)],
+        "output_tensor_data": [_vi(r) for r in _all(graph, 12)],
+    }

@@ -265,21 +265,30 @@ class DataLoader(object):
             if is_proc:
                 # reclaim any prefetched-but-unconsumed shm segments
                 # (abandoned iteration / error path) — the consumer is
-                # the only party that unlinks
+                # the only party that unlinks. Drain while workers wind
+                # down AND after they exit, so a batch that lands
+                # mid-shutdown is still reclaimed.
                 for batch, _err in buffered.values():
                     _unlink_payload(batch)
-                deadline = 20
-                while deadline > 0:
+                import time as _time
+                deadline = _time.time() + 10.0
+                while _time.time() < deadline and \
+                        any(w.is_alive() for w in workers):
                     try:
-                        _seq, batch, _err = out_q.get(timeout=0.25)
+                        _s, batch, _e = out_q.get(timeout=0.25)
                         _unlink_payload(batch)
                     except _queue.Empty:
-                        break
-                    deadline -= 1
+                        pass
                 for w in workers:
                     w.join(timeout=5)
                     if w.is_alive():
                         w.terminate()
+                while True:          # final sweep: queue is now quiet
+                    try:
+                        _s, batch, _e = out_q.get(timeout=0.1)
+                        _unlink_payload(batch)
+                    except _queue.Empty:
+                        break
 
     def __len__(self):
         return len(self._batch_sampler)

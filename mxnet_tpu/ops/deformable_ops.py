@@ -1,0 +1,202 @@
+"""Deformable / position-sensitive / spectral contrib operators.
+
+Reference: src/operator/contrib/deformable_convolution.cc (deformable
+conv v1), psroi_pooling.cc (position-sensitive ROI pooling for R-FCN),
+fft.cc + ifft.cc (cuFFT C2C batched transform), count_sketch.cc
+(hash-based dimensionality reduction for compact bilinear pooling).
+
+TPU formulations: deformable conv is a bilinear-gather im2col followed
+by one MXU matmul (instead of the reference's custom CUDA im2col);
+PSROIPooling is a vmapped masked average over the bin's dedicated
+channel slice; FFT uses jnp.fft with the reference's interleaved
+real/imag layout; count_sketch is a scatter-add over hashed columns.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def _bilinear_chw(img, y, x):
+    """img (C, H, W); y/x arbitrary equal shapes -> (C,) per position.
+    Out-of-range samples contribute zero (reference border behavior)."""
+    H, W = img.shape[1], img.shape[2]
+    inb = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def at(yy, xx):
+        ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return img[:, yc, xc] * ok
+
+    v = (at(y0, x0) * (1 - wy) * (1 - wx) + at(y0, x0 + 1) * (1 - wy) * wx
+         + at(y0 + 1, x0) * wy * (1 - wx) + at(y0 + 1, x0 + 1) * wy * wx)
+    return v * inb
+
+
+@register("_contrib_DeformableConvolution",
+          attr_defaults={"kernel": (), "stride": (1, 1), "dilate": (1, 1),
+                         "pad": (0, 0), "num_filter": 0, "num_group": 1,
+                         "num_deformable_group": 1, "no_bias": False})
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=0, num_group=1,
+                            num_deformable_group=1, no_bias=False, **_ig):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc):
+    per output position the kernel taps sample at learned fractional
+    offsets via bilinear interpolation; the gathered columns feed one
+    grouped matmul. data (N,C,H,W); offset (N, 2*dg*kh*kw, Ho, Wo);
+    weight (F, C/groups, kh, kw)."""
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride if len(stride) == 2 else (1, 1)
+    dh, dw = dilate if len(dilate) == 2 else (1, 1)
+    ph, pw = pad if len(pad) == 2 else (0, 0)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    cpg = C // dg                                     # channels per dg
+
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+
+    def one_image(img, off):
+        # off (2*dg*kh*kw, Ho, Wo) -> (dg, kh, kw, 2, Ho, Wo)
+        off = off.reshape(dg, kh * kw, 2, Ho, Wo).reshape(
+            dg, kh, kw, 2, Ho, Wo)
+
+        def sample(g, i, j):
+            y = oy[:, None] + ky[i] + off[g, i, j, 0]   # (Ho, Wo)
+            x = ox[None, :] + kx[j] + off[g, i, j, 1]
+            grp = jax.lax.dynamic_slice_in_dim(img, g * cpg, cpg, axis=0)
+            return _bilinear_chw(grp, y, x)             # (cpg, Ho, Wo)
+
+        cols = jnp.stack([
+            jnp.concatenate([sample(g, i, j) for g in range(dg)], axis=0)
+            for i in range(kh) for j in range(kw)])     # (kh*kw, C, Ho, Wo)
+        return cols.transpose(1, 0, 2, 3)               # (C, kh*kw, Ho, Wo)
+
+    cols = jax.vmap(one_image)(data, offset)            # (N,C,khkw,Ho,Wo)
+    cols = cols.reshape(N, num_group, C // num_group * kh * kw, Ho * Wo)
+    wmat = weight.reshape(num_group, num_filter // num_group, -1)
+    out = jnp.einsum("ngkp,gfk->ngfp", cols, wmat,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, num_filter, Ho, Wo).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (R-FCN)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_PSROIPooling",
+          attr_defaults={"spatial_scale": 1.0, "output_dim": 0,
+                         "pooled_size": 0, "group_size": 0})
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0, **_ig):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cc):
+    bin (i, j) of the output averages over channel slice
+    [(c*ps + i)*ps + j] only — each spatial bin reads its dedicated
+    score map. data (N, output_dim*ps*ps, H, W); rois (R, 5)."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    N, CT, H, W = data.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ps
+        bin_w = rw / ps
+        img = data[b].reshape(output_dim, gs * gs, H, W)
+
+        def cell(ci, py, px):
+            hstart = y1 + py * bin_h
+            hend = y1 + (py + 1) * bin_h
+            wstart = x1 + px * bin_w
+            wend = x1 + (px + 1) * bin_w
+            mask = ((ys[:, None] >= jnp.floor(hstart))
+                    & (ys[:, None] < jnp.ceil(hend))
+                    & (xs[None, :] >= jnp.floor(wstart))
+                    & (xs[None, :] < jnp.ceil(wend)))
+            # scale the bin coordinate into the group grid (reference:
+            # psroi_pooling.cc gh = floor(ph * group_size / pooled_size))
+            gy = (py * gs) // ps
+            gx = (px * gs) // ps
+            gidx = (gy * gs + gx).astype(jnp.int32)
+            plane = img[ci, gidx]                       # (H, W)
+            cnt = jnp.maximum(jnp.sum(mask), 1)
+            return jnp.sum(plane * mask) / cnt
+
+        grid = jax.vmap(lambda ci: jax.vmap(lambda py: jax.vmap(
+            lambda px: cell(ci, py, px))(jnp.arange(ps)))(
+                jnp.arange(ps)))(jnp.arange(output_dim))
+        return grid                                     # (out_dim, ps, ps)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (interleaved real-imag layout, reference fft-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", attr_defaults={"compute_size": 128})
+def _fft(data, compute_size=128, **_ig):
+    """Batched complex FFT of the last dim; real input (..., d) ->
+    interleaved real/imag output (..., 2d) (reference: contrib/fft.cc
+    cufftExecC2C with zero imaginary input)."""
+    spec = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        data.dtype)
+
+
+@register("_contrib_ifft", attr_defaults={"compute_size": 128})
+def _ifft(data, compute_size=128, **_ig):
+    """Inverse of _contrib_fft: interleaved (..., 2d) -> real (..., d).
+    Matches the reference's unnormalized cufft inverse (caller divides
+    by d, see contrib/ifft.cc docs)."""
+    d = data.shape[-1] // 2
+    ri = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    spec = jax.lax.complex(ri[..., 0], ri[..., 1])
+    out = jnp.fft.ifft(spec, axis=-1).real * d        # unnormalized
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (compact bilinear pooling)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_count_sketch", attr_defaults={"out_dim": 0,
+                                                  "processing_batch_size": 32})
+def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32, **_ig):
+    """Count sketch projection (reference: contrib/count_sketch.cc):
+    out[n, h[i]] += s[i] * data[n, i] — a signed scatter-add onto hashed
+    output columns. data (N, in_dim); h (1, in_dim) column ids; s
+    (1, in_dim) +-1 signs; out (N, out_dim)."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((data.shape[0], int(out_dim)), dtype=data.dtype)
+    return out.at[:, idx].add(data * sign[None, :])

@@ -159,6 +159,11 @@ MXTPU_API int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
   Py_DECREF(args);
   if (r == nullptr) return -1;
   Py_ssize_t n = PyList_Size(r);
+  if (n > MXTPU_MAX_NDIM) {
+    set_last_error("tensor rank exceeds MXTPU_MAX_NDIM");
+    Py_DECREF(r);
+    return -1;
+  }
   *out_ndim = static_cast<uint32_t>(n);
   for (Py_ssize_t i = 0; i < n; ++i)
     out_shape[i] = (uint32_t)PyLong_AsUnsignedLong(PyList_GetItem(r, i));

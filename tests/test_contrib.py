@@ -161,6 +161,9 @@ def test_tensorboard_fallback_jsonl(tmp_path):
         assert "accuracy" in open(logged).read()
 
 
-def test_onnx_gated():
+def test_onnx_unsupported_op_raises_cleanly():
+    d = mx.sym.Variable("data")
+    bad = mx.sym.arccos(d)          # outside the converter subset
     with pytest.raises(MXNetError):
-        mx.contrib.onnx.export_model(None, None, None)
+        mx.contrib.onnx.export_model(bad, {}, (1, 4),
+                                     onnx_file_path=None)

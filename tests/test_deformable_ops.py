@@ -1,0 +1,117 @@
+"""Deformable conv, PSROIPooling, FFT/IFFT, count_sketch.
+
+Reference behavior: src/operator/contrib/deformable_convolution.cc,
+psroi_pooling.cc, fft.cc, ifft.cc, count_sketch.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_deformable_conv_zero_offsets_match_standard_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(4).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out_d = nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), mx.nd.array(b),
+        kernel=(3, 3), num_filter=4, pad=(1, 1))
+    out_c = nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                           kernel=(3, 3), num_filter=4, pad=(1, 1))
+    np.testing.assert_allclose(out_d.asnumpy(), out_c.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    # kernel 1x1: an integer offset of (0, +1) samples the pixel to the
+    # right, i.e. the output equals the input shifted left
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[0, 1] = 1.0                       # x-offset +1
+    out = nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+    expect = np.zeros_like(x)
+    expect[..., :, :3] = x[..., :, 1:]    # shifted; border samples 0
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+
+
+def test_deformable_conv_differentiable_wrt_offsets():
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    w = mx.nd.array(rng.randn(2, 2, 3, 3).astype(np.float32) * 0.2)
+    off = mx.nd.array(rng.uniform(-0.4, 0.4, (1, 18, 6, 6)).astype(
+        np.float32))
+    off.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=2, pad=(1, 1),
+            no_bias=True)
+        loss = nd.sum(y * y)
+    loss.backward()
+    g = off.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_psroi_pooling_reads_dedicated_channel():
+    ps, od = 2, 3
+    N, H, W = 1, 6, 6
+    data = np.zeros((N, od * ps * ps, H, W), np.float32)
+    # give each (c, bin) plane a distinct constant
+    for c in range(od):
+        for g in range(ps * ps):
+            data[0, c * ps * ps + g] = 10 * c + g
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=od, pooled_size=ps).asnumpy()
+    assert out.shape == (1, od, ps, ps)
+    for c in range(od):
+        for py in range(ps):
+            for px in range(ps):
+                assert out[0, c, py, px] == 10 * c + (py * ps + px)
+
+
+def test_fft_ifft_roundtrip_and_numpy_match():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype(np.float32)
+    spec = nd.contrib.fft(mx.nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(spec[:, 0::2], ref.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(spec[:, 1::2], ref.imag, rtol=1e-4,
+                               atol=1e-4)
+    back = nd.contrib.ifft(mx.nd.array(spec)).asnumpy() / 8.0
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch_scatter_add_with_signs():
+    data = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    h = np.array([[0, 1, 0, 1]], np.float32)
+    s = np.array([[1, -1, 1, 1]], np.float32)
+    out = nd.contrib.count_sketch(
+        mx.nd.array(data), mx.nd.array(h), mx.nd.array(s),
+        out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[1 + 3, -2 + 4]])
+
+
+def test_psroi_pooling_group_size_differs_from_pooled_size():
+    # ps=4 bins but gs=2 score-map groups: bins map to groups by
+    # floor(p * gs / ps) (reference psroi_pooling.cc)
+    ps, gs, od = 4, 2, 1
+    data = np.zeros((1, od * gs * gs, 8, 8), np.float32)
+    for g in range(gs * gs):
+        data[0, g] = g
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=od, pooled_size=ps, group_size=gs).asnumpy()
+    for py in range(ps):
+        for px in range(ps):
+            expect = (py * gs // ps) * gs + (px * gs // ps)
+            assert out[0, 0, py, px] == expect, (py, px)
