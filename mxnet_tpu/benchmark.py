@@ -132,16 +132,31 @@ def persist(metric, value, unit, extra=None):
 # ---------------------------------------------------------------------------
 # timing helper
 
-def _timeit(fn, *args, warmup=3, iters=20, sync=None):
+def _fetch(x):
+    """Force a real D2H read of one element per leaf of ``x``. Stronger
+    than block_until_ready: a degrading async transport can mark a buffer
+    "ready" early, but it cannot deliver bytes before the producing
+    program actually ran. Indexes on device first so only a scalar
+    crosses the wire."""
     import jax
+    out = []
+    for l in jax.tree_util.tree_leaves(x):
+        if hasattr(l, "ndim"):
+            out.append(np.asarray(l if l.ndim == 0 else l.ravel()[0]))
+        else:
+            out.append(l)
+    return out
+
+
+def _timeit(fn, *args, warmup=3, iters=20, sync=None):
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(sync(out) if sync else out)
+    _fetch(sync(out) if sync else out)
     t0 = time.time()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(sync(out) if sync else out)
+    _fetch(sync(out) if sync else out)
     return (time.time() - t0) / iters
 
 
@@ -163,6 +178,9 @@ def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
     rng = np.random.RandomState(0)
     data = rng.randn(batch, *image).astype(np.float32)
     label = rng.randint(0, 1000, size=(batch,)).astype(np.float32)
+    # one H2D copy up front (reference --benchmark mode semantics); the
+    # measured loop then times compute, not the host tunnel
+    data, label = trainer.stage(data, label)
 
     state = [params, moms, aux]
 
@@ -171,8 +189,14 @@ def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
             state[0], state[1], state[2], data, label)
         return loss
 
+    # sync on the loss AND an updated-parameter element: the final
+    # step's optimizer update must have physically completed
+    def _sync(loss):
+        p = state[0]
+        return (loss, p[next(iter(p))])
+
     t0 = time.time()
-    dt = _timeit(step, warmup=3, iters=iters)
+    dt = _timeit(step, warmup=3, iters=iters, sync=_sync)
     log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
     img_s = batch / dt
     pk = peak_flops(dtype)
@@ -271,6 +295,7 @@ def train_mlp(batch=64, iters=50):
     rng = np.random.RandomState(0)
     data = rng.randn(batch, 784).astype(np.float32)
     label = rng.randint(0, 10, size=(batch,)).astype(np.float32)
+    data, label = trainer.stage(data, label)
     state = [params, moms, aux]
 
     def step():
@@ -278,7 +303,11 @@ def train_mlp(batch=64, iters=50):
             state[0], state[1], state[2], data, label)
         return loss
 
-    dt = _timeit(step, warmup=5, iters=iters)
+    def _sync(loss):
+        p = state[0]
+        return (loss, p[next(iter(p))])
+
+    dt = _timeit(step, warmup=5, iters=iters, sync=_sync)
     return batch / dt, {"ms_per_step": round(dt * 1e3, 2), "batch": batch}
 
 
@@ -327,7 +356,9 @@ def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
             # this output, so a non-blocking transport cannot overlap
             # or drop iterations
             feed = x + out.reshape((-1,))[0:1] * 0
-        jax.block_until_ready(out._data)
+        # force a real D2H read (see _fetch) — the whole chain must
+        # have physically executed to deliver these bytes
+        _fetch(out._data)
         return out
 
     chain(3)                                     # warmup / compile

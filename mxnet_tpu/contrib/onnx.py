@@ -175,11 +175,14 @@ def _tensor(name, arr):
 
 
 def _value_info(name, shape, dt=_DT_FLOAT):
-    dims = b""
-    for d in shape:
-        dims += _f_bytes(1, _f_varint(1, d))          # Dimension.dim_value
-    shape_proto = dims
-    tensor_type = _f_varint(1, dt) + _f_bytes(2, shape_proto)
+    """``shape=None`` omits TensorShapeProto entirely (unknown rank);
+    a present-but-empty shape would declare a scalar per the spec."""
+    tensor_type = _f_varint(1, dt)
+    if shape is not None:
+        dims = b""
+        for d in shape:
+            dims += _f_bytes(1, _f_varint(1, d))      # Dimension.dim_value
+        tensor_type += _f_bytes(2, dims)
     type_proto = _f_bytes(1, tensor_type)
     return _f_bytes(1, name) + _f_bytes(2, type_proto)
 
@@ -271,15 +274,26 @@ def _export_node(node, in_names, out_name, params):
                 "GlobalAveragePool"
             return [_node(onnx_op, in_names, [out_name], name)]
         onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
-        a = [_attr_ints("kernel_shape", _ints(attrs["kernel"])),
+        kernel = _ints(attrs["kernel"])
+        # default stride is 1 in both this framework and the ONNX spec
+        a = [_attr_ints("kernel_shape", kernel),
              _attr_ints("strides",
-                        _ints(attrs.get("stride", attrs["kernel"]))),
+                        _ints(attrs.get("stride", [1] * len(kernel)))),
              _attr_ints("pads", _pads4(attrs))]
         return [_node(onnx_op, in_names, [out_name], name,
                       _wrap_attrs(a))]
     if op == "BatchNorm":
         a = [_attr_float("epsilon", float(attrs.get("eps", 1e-3))),
              _attr_float("momentum", float(attrs.get("momentum", 0.9)))]
+        in_names = list(in_names)
+        if str(attrs.get("fix_gamma", "True")).lower() in ("true", "1") \
+                and in_names[1] in params:
+            # the op ignores gamma under fix_gamma; ONNX has no such
+            # flag, so export a ones scale initializer instead
+            gname = name + "_fixed_gamma"
+            if gname not in params:
+                params[gname] = _np.ones_like(params[in_names[1]])
+            in_names[1] = gname
         return [_node("BatchNormalization", in_names, [out_name], name,
                       _wrap_attrs(a))]
     if op == "Flatten":
@@ -367,7 +381,7 @@ def export_model(sym, params, input_shape, input_type="float32",
     for iname, shape in inputs:
         body += _f_bytes(11, _value_info(iname, shape))
     for h in heads:
-        body += _f_bytes(12, _value_info(out_names[h], ()))
+        body += _f_bytes(12, _value_info(out_names[h], None))
     graph_bytes = body
 
     model = _f_varint(1, _IR_VERSION)
@@ -473,27 +487,73 @@ def import_model(model_file):
                 return v
             raise MXNetError("ONNX import: undefined input %r" % nm)
 
+        def split_pads(data_sym, pad_value=0.0, tag="_pad"):
+            """ONNX pads = [b1..bn, e1..en]. Symmetric → usable as the
+            op's ``pad``; asymmetric → explicit Pad on the spatial dims
+            (NC leading) and a zero op-level pad."""
+            pads = [int(v) for v in attrs.get("pads", [0, 0, 0, 0])]
+            n = len(pads) // 2
+            begin, end = pads[:n], pads[n:]
+            if begin == end:
+                return data_sym, tuple(begin)
+            pw = (0, 0, 0, 0)
+            for b, e in zip(begin, end):
+                pw += (b, e)
+            padded = mx.sym.pad(data_sym, mode="constant", pad_width=pw,
+                                constant_value=pad_value,
+                                name=name + tag)
+            return padded, (0,) * n
+
         if op_type == "Conv":
-            pads = attrs.get("pads", [0, 0, 0, 0])
             num_filter = inits[ins[1]].shape[0]
+            data, pad = split_pads(arg(0))
             kw = dict(kernel=tuple(attrs["kernel_shape"]),
                       stride=tuple(attrs.get("strides", [1, 1])),
                       dilate=tuple(attrs.get("dilations", [1, 1])),
-                      pad=tuple(pads[:len(pads) // 2]),
+                      pad=pad,
                       num_group=int(attrs.get("group", 1)),
                       num_filter=num_filter, name=name)
-            args = [arg(0), arg(1)]
+            args = [data, arg(1)]
             if len(ins) > 2:
                 args.append(arg(2))
             else:
                 kw["no_bias"] = True
             out = mx.sym.Convolution(*args, **kw)
         elif op_type == "Gemm":
-            num_hidden = inits[ins[1]].shape[0]
-            args = [arg(0), arg(1)]
+            alpha = float(attrs.get("alpha", 1.0))
+            beta = float(attrs.get("beta", 1.0))
+            if int(attrs.get("transA", 0)):
+                raise MXNetError("ONNX import: Gemm transA=1 unsupported")
+            w_np = inits.get(ins[1])
+            if w_np is None:
+                raise MXNetError(
+                    "ONNX import: Gemm weight must be an initializer")
+            # FullyConnected computes x·W^T with W (num_hidden, K); an
+            # ONNX weight with transB=0 (the spec default) is (K, N)
+            if not int(attrs.get("transB", 0)):
+                w_np = _np.ascontiguousarray(w_np.T)
+            if alpha != 1.0:
+                w_np = w_np * alpha
+            num_hidden = w_np.shape[0]
+            # bind the transformed weight under a per-node name; do NOT
+            # rebind env[ins[1]] — other consumers of a shared
+            # initializer must keep seeing the raw tensor
+            wname = name + "_weight"
+            wvar = mx.sym.Variable(wname, shape=w_np.shape)
+            arg_params[wname] = mx.nd.array(w_np)
+            args = [arg(0), wvar]
             kw = dict(num_hidden=num_hidden, name=name)
             if len(ins) > 2:
-                args.append(arg(2))
+                b_np = inits.get(ins[2])
+                if b_np is None:
+                    raise MXNetError(
+                        "ONNX import: Gemm bias must be an initializer")
+                if beta != 1.0:
+                    b_np = b_np * beta
+                bname = name + "_bias"
+                bvar = mx.sym.Variable(bname, shape=b_np.shape)
+                arg_params[bname] = mx.nd.array(b_np)
+                args.append(bvar)
             else:
                 kw["no_bias"] = True
             out = mx.sym.FullyConnected(*args, **kw)
@@ -509,31 +569,66 @@ def import_model(model_file):
             out = mx.sym.LeakyReLU(arg(0),
                                    slope=float(attrs.get("alpha", 0.01)),
                                    name=name)
-        elif op_type in ("MaxPool", "AveragePool"):
-            pads = attrs.get("pads", [0, 0, 0, 0])
-            out = mx.sym.Pooling(
-                arg(0), kernel=tuple(attrs["kernel_shape"]),
-                stride=tuple(attrs.get("strides", attrs["kernel_shape"])),
-                pad=tuple(pads[:len(pads) // 2]),
-                pool_type="max" if op_type == "MaxPool" else "avg",
-                name=name)
+        elif op_type == "MaxPool":
+            kernel = tuple(attrs["kernel_shape"])
+            # ONNX spec default strides is 1 (NOT kernel_shape)
+            stride = tuple(attrs.get("strides", [1] * len(kernel)))
+            data, pad = split_pads(arg(0), pad_value=-3.4e38)
+            out = mx.sym.Pooling(data, kernel=kernel, stride=stride,
+                                 pad=pad, pool_type="max", name=name)
+        elif op_type == "AveragePool":
+            kernel = tuple(attrs["kernel_shape"])
+            stride = tuple(attrs.get("strides", [1] * len(kernel)))
+            incl = bool(int(attrs.get("count_include_pad", 0)))
+            pads = [int(v) for v in attrs.get("pads", [0, 0, 0, 0])]
+            n = len(pads) // 2
+            begin, end = tuple(pads[:n]), tuple(pads[n:])
+            if begin == end:
+                # the op computes the excluded-pad denominator natively
+                out = mx.sym.Pooling(
+                    arg(0), kernel=kernel, stride=stride, pad=begin,
+                    pool_type="avg", count_include_pad=incl, name=name)
+            else:
+                d0, pad = split_pads(arg(0))
+                if incl:
+                    out = mx.sym.Pooling(
+                        d0, kernel=kernel, stride=stride, pad=pad,
+                        pool_type="avg", count_include_pad=True,
+                        name=name)
+                else:
+                    # excluded-pad average over an asymmetric pad:
+                    # sum-pool the padded data and a padded ones mask,
+                    # divide — the mask counts only original elements
+                    ones = arg(0) * 0.0 + 1.0
+                    ones_p, _ = split_pads(ones, tag="_maskpad")
+                    s = mx.sym.Pooling(d0, kernel=kernel, stride=stride,
+                                       pad=pad, pool_type="sum",
+                                       name=name + "_sum")
+                    c = mx.sym.Pooling(ones_p, kernel=kernel,
+                                       stride=stride, pad=pad,
+                                       pool_type="sum",
+                                       name=name + "_count")
+                    out = mx.sym.broadcast_div(s, c, name=name)
         elif op_type in ("GlobalMaxPool", "GlobalAveragePool"):
             out = mx.sym.Pooling(
                 arg(0), global_pool=True, kernel=(1, 1),
                 pool_type="max" if op_type == "GlobalMaxPool" else "avg",
                 name=name)
         elif op_type == "BatchNormalization":
+            # fix_gamma=False: the imported scale initializer must be
+            # honored (the op default fix_gamma=True would replace
+            # gamma with ones)
             out = mx.sym.BatchNorm(
                 arg(0), arg(1), arg(2), arg(3), arg(4),
                 eps=float(attrs.get("epsilon", 1e-5)),
-                momentum=float(attrs.get("momentum", 0.9)), name=name)
+                momentum=float(attrs.get("momentum", 0.9)),
+                fix_gamma=False, name=name)
         elif op_type == "MatMul":
             # flatten=False FullyConnected export path: weight arrives
             # transposed (C, H)
             w_np = inits[ins[1]]
             wname = name + "_weight"
             wvar = mx.sym.Variable(wname)
-            env[ins[1]] = wvar
             arg_params[wname] = mx.nd.array(
                 _np.ascontiguousarray(w_np.T))
             out = mx.sym.FullyConnected(arg(0), wvar,

@@ -241,14 +241,20 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
+    # mixed precision: stats/affine in fp32, output in the input dtype
+    # (stats params stay fp32 under net.cast — reference fp16 BN policy)
+    in_dtype = data.dtype
+    x = data.astype(jnp.float32)
     if train_mode and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
     else:
         mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps).reshape(bshape)
-    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
-        + beta.reshape(bshape)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).reshape(bshape)
+    out = (x - mean.astype(jnp.float32).reshape(bshape)) * inv \
+        * gamma.astype(jnp.float32).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    out = out.astype(in_dtype)
     if output_mean_var:
         return out, mean, var
     return out
@@ -280,18 +286,22 @@ def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                    for i in range(data.ndim))
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
+    in_dtype = data.dtype
+    x = data.astype(jnp.float32)
     if train_mode and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        meansq = jnp.mean(jnp.square(data), axis=red)
+        mean = jnp.mean(x, axis=red)
+        meansq = jnp.mean(jnp.square(x), axis=red)
         if axis_name:
             mean = lax.pmean(mean, axis_name)
             meansq = lax.pmean(meansq, axis_name)
         var = meansq - jnp.square(mean)
     else:
         mean, var = moving_mean, moving_var
-    out = (data - mean.reshape(bshape)) * lax.rsqrt(
-        var.reshape(bshape) + eps) * gamma.reshape(bshape) \
-        + beta.reshape(bshape)
+    out = (x - mean.astype(jnp.float32).reshape(bshape)) * lax.rsqrt(
+        var.astype(jnp.float32).reshape(bshape) + eps) \
+        * gamma.astype(jnp.float32).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    out = out.astype(in_dtype)
     if output_mean_var:
         return out, mean, var
     return out

@@ -169,3 +169,124 @@ def test_onnx_fc_flatten_false_roundtrip(tmp_path):
     exe2.arg_dict["data"][:] = mx.nd.array(x)
     np.testing.assert_allclose(exe2.forward(is_train=False)[0].asnumpy(),
                                ref, rtol=1e-5, atol=1e-6)
+
+def test_onnx_default_stride_pool_and_trained_gamma_roundtrip(tmp_path):
+    """Regression: (a) Pooling with no explicit stride must round-trip
+    as stride-1 (overlapping) pooling, not stride=kernel; (b) a
+    BatchNorm with fix_gamma=False and a trained (non-one) gamma must
+    keep that gamma through export+import; (c) a default BatchNorm
+    (fix_gamma=True) must export a ones scale so external runtimes see
+    the effective gamma."""
+    rng = np.random.RandomState(5)
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="c1")
+    b = mx.sym.BatchNorm(c, fix_gamma=False, name="bn1")
+    p = mx.sym.Pooling(b, kernel=(2, 2), pool_type="max", name="p1")
+    b2 = mx.sym.BatchNorm(p, name="bn2")          # fix_gamma default True
+    f = mx.sym.FullyConnected(mx.sym.Flatten(b2), num_hidden=3, name="fc")
+    shape = (2, 3, 8, 8)
+    exe = _bind_with_params(f, shape, rng)
+    # trained, clearly-non-one gammas on BOTH bns
+    exe.arg_dict["bn1_gamma"][:] = mx.nd.array(
+        2.0 + rng.rand(4).astype(np.float32))
+    exe.arg_dict["bn2_gamma"][:] = mx.nd.array(
+        3.0 + rng.rand(4).astype(np.float32))
+    x = rng.randn(*shape).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    path = str(tmp_path / "g.onnx")
+    mxonnx.export_model(
+        f, {n: a for n, a in exe.arg_dict.items() if n != "data"},
+        shape, onnx_file_path=path, aux_params=dict(exe.aux_dict))
+    # exported scale for the fix_gamma=True bn must be ones
+    blob = open(path, "rb").read()
+    graph = mxonnx._parse(mxonnx._one(mxonnx._parse(blob), 7))
+    tensors = dict(mxonnx._decode_tensor(t) for t in mxonnx._all(graph, 5))
+    np.testing.assert_array_equal(tensors["bn2_fixed_gamma"],
+                                  np.ones(4, np.float32))
+
+    sym2, args2, aux2 = mxonnx.import_model(path)
+    exe2 = _bind_with_params(sym2, shape, rng, args2, aux2)
+    exe2.arg_dict["data"][:] = mx.nd.array(x)
+    np.testing.assert_allclose(exe2.forward(is_train=False)[0].asnumpy(),
+                               ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_import_gemm_transb0_and_asymmetric_pads():
+    """Regression: spec-default Gemm (transB=0, weight (K,N)),
+    asymmetric Conv pads, and excluded-pad AveragePool must import
+    correctly."""
+    import os
+    import tempfile
+    from mxnet_tpu.contrib.onnx import (_f_bytes, _f_varint, _node,
+                                        _tensor, _value_info, _wrap_attrs,
+                                        _attr_ints, _attr_int, _IR_VERSION,
+                                        _OPSET)
+
+    def import_single(node_bytes, tensors, in_shape):
+        body = _f_bytes(1, node_bytes)
+        for tname, arr in tensors.items():
+            body += _f_bytes(5, _tensor(tname, arr))
+        body += _f_bytes(11, _value_info("data", in_shape))
+        body += _f_bytes(12, _value_info("y", None))
+        model = _f_varint(1, _IR_VERSION) + _f_bytes(7, body) + \
+            _f_bytes(8, _f_bytes(1, "") + _f_varint(2, _OPSET))
+        fd, path = tempfile.mkstemp(suffix=".onnx")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(model)
+            return mxonnx.import_model(path)
+        finally:
+            os.unlink(path)
+
+    def run(sym, args, in_shape, x):
+        exe = sym.simple_bind(data=in_shape)
+        for n, a in args.items():
+            exe.arg_dict[n][:] = a
+        exe.arg_dict["data"][:] = mx.nd.array(x)
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    rng = np.random.RandomState(6)
+    w = rng.randn(4, 3).astype(np.float32)           # (K, N) transB=0
+    x = rng.randn(2, 4).astype(np.float32)
+    gemm = _node("Gemm", ["data", "W"], ["y"], "g1")
+    sym, args, _aux = import_single(gemm, {"W": w}, (2, 4))
+    np.testing.assert_allclose(run(sym, args, (2, 4), x), x @ w,
+                               rtol=1e-5, atol=1e-6)
+
+    # asymmetric pads on a conv: pads=[1,0,0,1] (top,left=1,0 bot,right=0,1)
+    k = np.ones((1, 1, 2, 2), np.float32)
+    conv = _node("Conv", ["data", "K"], ["y"], "c1", _wrap_attrs(
+        [_attr_ints("kernel_shape", [2, 2]),
+         _attr_ints("strides", [1, 1]),
+         _attr_ints("pads", [1, 0, 0, 1]),
+         _attr_int("group", 1)]))
+    sym, args, _aux = import_single(conv, {"K": k}, (1, 1, 3, 3))
+    xin = rng.randn(1, 1, 3, 3).astype(np.float32)
+    out = run(sym, args, (1, 1, 3, 3), xin)
+    # manual reference: pad top=1,left=0, bottom=0,right=1 then valid 2x2 sum
+    xp = np.pad(xin, ((0, 0), (0, 0), (1, 0), (0, 1)))
+    ref = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = xp[0, 0, i:i + 2, j:j + 2].sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # excluded-pad AveragePool (count_include_pad absent -> spec default
+    # 0) with asymmetric pads: border denominators count only original
+    # elements
+    ap = _node("AveragePool", ["data"], ["y"], "ap1", _wrap_attrs(
+        [_attr_ints("kernel_shape", [2, 2]),
+         _attr_ints("strides", [1, 1]),
+         _attr_ints("pads", [1, 0, 0, 1])]))
+    sym, args, _aux = import_single(ap, {}, (1, 1, 3, 3))
+    out = run(sym, args, (1, 1, 3, 3), xin)
+    xp = np.pad(xin, ((0, 0), (0, 0), (1, 0), (0, 1)))
+    mask = np.pad(np.ones_like(xin), ((0, 0), (0, 0), (1, 0), (0, 1)))
+    ref = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = (xp[0, 0, i:i + 2, j:j + 2].sum()
+                               / mask[0, 0, i:i + 2, j:j + 2].sum())
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

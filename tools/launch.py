@@ -68,7 +68,23 @@ def main():
     server_env = dict(base_env, MXNET_TPU_ROLE="server")
     server = subprocess.Popen(
         [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=server_env)
-    time.sleep(1.0)  # listener up
+    # wait until the listener actually accepts (a fixed sleep flakes on
+    # loaded hosts where interpreter startup alone can take seconds)
+    deadline = time.time() + 120.0
+    while True:
+        if server.poll() is not None:
+            sys.exit("kvstore server exited rc=%d before binding"
+                     % server.returncode)
+        try:
+            probe = socket.create_connection(("127.0.0.1", port),
+                                             timeout=1.0)
+            probe.close()
+            break
+        except OSError:
+            if time.time() > deadline:
+                server.kill()
+                sys.exit("kvstore server failed to bind within 120s")
+            time.sleep(0.2)
 
     # everything after the server exists runs under try/finally: an
     # orphaned server would inherit the caller's stdout/stderr pipes and
