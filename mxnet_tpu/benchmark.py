@@ -99,15 +99,24 @@ def _platform():
         return "unknown"
 
 
+# timing-harness generation: 2 = fetch-based sync (_fetch: the result is
+# proven delivered D2H), 1 = the older block_until_ready sync, which the
+# axon transport can satisfy early. Higher generation supersedes any
+# value measured by a lower one.
+HARNESS_GEN = 2
+
+
 def persist(metric, value, unit, extra=None):
     """Merge a measurement into the store, keeping the best per metric.
     TPU measurements always supersede CPU ones (the judged number is the
-    TPU one; a CPU number is only a last-resort fallback)."""
+    TPU one; a CPU number is only a last-resort fallback), and a newer
+    timing-harness generation supersedes older ones even at a lower
+    value — trustworthy beats flattering."""
     os.makedirs(BENCH_DIR, exist_ok=True)
     results = load_results()
     prev = results.get(metric)
     rec = {"metric": metric, "value": round(float(value), 2), "unit": unit,
-           "platform": _platform(),
+           "platform": _platform(), "harness": HARNESS_GEN,
            "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
     base = BASELINES.get(metric)
     if base:
@@ -115,10 +124,10 @@ def persist(metric, value, unit, extra=None):
     if extra:
         rec.update(extra)
     rank = {"tpu": 2, "cpu": 1}.get
-    prev_rank = rank(prev.get("platform", "cpu"), 0) if prev else -1
-    new_rank = rank(rec["platform"], 0)
-    if (prev is None or new_rank > prev_rank
-            or (new_rank == prev_rank and rec["value"] > prev["value"])):
+    prev_key = (rank(prev.get("platform", "cpu"), 0),
+                prev.get("harness", 1), prev["value"]) if prev else None
+    new_key = (rank(rec["platform"], 0), rec["harness"], rec["value"])
+    if (prev is None or new_key > prev_key):
         results[metric] = rec
         tmp = RESULTS_PATH + ".tmp"
         with open(tmp, "w") as f:

@@ -546,14 +546,18 @@ def import_model(model_file):
             if len(ins) > 2:
                 b_np = inits.get(ins[2])
                 if b_np is None:
-                    raise MXNetError(
-                        "ONNX import: Gemm bias must be an initializer")
-                if beta != 1.0:
-                    b_np = b_np * beta
-                bname = name + "_bias"
-                bvar = mx.sym.Variable(bname, shape=b_np.shape)
-                arg_params[bname] = mx.nd.array(b_np)
-                args.append(bvar)
+                    if beta != 1.0:
+                        raise MXNetError(
+                            "ONNX import: Gemm beta=%s requires the bias "
+                            "to be an initializer" % beta)
+                    args.append(arg(2))   # graph-input / node-output bias
+                else:
+                    if beta != 1.0:
+                        b_np = b_np * beta
+                    bname = name + "_bias"
+                    bvar = mx.sym.Variable(bname, shape=b_np.shape)
+                    arg_params[bname] = mx.nd.array(b_np)
+                    args.append(bvar)
             else:
                 kw["no_bias"] = True
             out = mx.sym.FullyConnected(*args, **kw)

@@ -253,8 +253,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
         dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk)
         return dq_acc, (dk, dv)
 
-    dq, (dks, dvs) = jax.lax.scan(
-        kblock, jnp.zeros((b, h, s_q, d), jnp.float32), jnp.arange(nk))
+    # init carry derives from qf so its varying-across-mesh axes match
+    # the body output under an enclosing shard_map (scan rejects a
+    # non-varying init against a varying carry)
+    dq, (dks, dvs) = jax.lax.scan(kblock, qf * 0.0, jnp.arange(nk))
     dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, nk * block_k, d)[:, :, :s_k]
     dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, nk * block_k, d)[:, :, :s_k]
     dq = dq * sm_scale

@@ -9,3 +9,5 @@ collectives that the reference's KVStore/NCCL code performs by hand.
 """
 from .mesh import make_mesh, current_mesh, set_mesh, data_parallel_sharding
 from .trainer import make_train_step, ShardedTrainer
+from .ring_attention import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention

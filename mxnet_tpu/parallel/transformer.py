@@ -53,6 +53,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     capacity_factor: float = 2.0
     dtype: object = jnp.float32
+    sp_attn: str = "ring"         # "ring" (ppermute) | "ulysses" (a2a)
 
 
 # ---------------------------------------------------------------------------
@@ -161,8 +162,14 @@ def _attention_local(lp, x, cfg, heads_local):
     def split(t):
         return t.reshape(b, s, heads_local, hd).transpose(0, 2, 1, 3)
 
-    o = _ring_attention_local(split(q), split(k), split(v), "sp",
-                              causal=True, sm_scale=1.0 / np.sqrt(hd))
+    if cfg.sp_attn == "ulysses":
+        from .ulysses import _ulysses_local
+        o = _ulysses_local(split(q), split(k), split(v), "sp",
+                           causal=True, sm_scale=1.0 / np.sqrt(hd),
+                           impl="auto", interpret=None)
+    else:
+        o = _ring_attention_local(split(q), split(k), split(v), "sp",
+                                  causal=True, sm_scale=1.0 / np.sqrt(hd))
     o = o.transpose(0, 2, 1, 3).reshape(b, s, heads_local * hd)
     return o @ lp["wo"]                                   # partial (b, s, d)
 
@@ -326,6 +333,14 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         if ax not in mesh.axis_names:
             raise ValueError("mesh is missing axis %r" % ax)
     mesh_shape = {a: mesh.shape[a] for a in AXES}
+    if cfg.sp_attn == "ulysses":
+        heads_local = cfg.n_heads // mesh_shape["tp"]
+        if heads_local % mesh_shape["sp"]:
+            raise ValueError(
+                "sp_attn='ulysses': local heads %d (n_heads=%d / tp=%d) "
+                "not divisible by sp=%d — use sp_attn='ring' for "
+                "few-head layouts" % (heads_local, cfg.n_heads,
+                                      mesh_shape["tp"], mesh_shape["sp"]))
     M = num_microbatches or max(1, mesh_shape["pp"])
     specs = _param_specs(cfg, mesh_shape["pp"])
 

@@ -193,3 +193,13 @@ def test_transformer_moe_pp_ep():
                             n_layers=4, d_ff=64, max_len=64, num_experts=2,
                             capacity_factor=8.0)
     _compare_step(cfg, (1, 1, 1, 2, 2), tol=3e-4, check_loss=False)
+
+
+def test_transformer_ulysses_sp():
+    """Same 5-axis step with the all-to-all (Ulysses) sequence-parallel
+    attention instead of the ring — must match the single-device
+    trajectory identically (heads_local=2 split over sp=2)."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_len=64,
+                            sp_attn="ulysses")
+    _compare_step(cfg, (2, 2, 2, 1, 1))
