@@ -63,19 +63,22 @@ class Context:
 
         Accelerator contexts pick from accelerator devices when present,
         otherwise fall back to host devices (so ``tpu(i)`` works as a cheap
-        fake under the forced-CPU test configuration).
-        """
+        fake under the forced-CPU test configuration). Only THIS process's
+        devices are eligible (reference semantics: mx.gpu(i) is a local
+        device; under multi-host JAX the global list spans processes and
+        remote devices are not addressable)."""
         import jax
 
         if self.device_type == "tpu":
-            devs = _accel_devices()
+            devs = [d for d in _accel_devices()
+                    if d.process_index == jax.process_index()]
             if not devs:
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

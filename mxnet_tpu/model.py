@@ -13,9 +13,9 @@ from .base import MXNetError
 from .ndarray import save as nd_save, load as nd_load
 from .ndarray.ndarray import NDArray
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "_create_kvstore", "_initialize_kvstore", "_update_params",
-           "_update_params_on_kvstore"]
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
+           "load_checkpoint", "_create_kvstore", "_initialize_kvstore",
+           "_update_params", "_update_params_on_kvstore"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -113,3 +113,171 @@ def load_checkpoint(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+class FeedForward(object):
+    """Legacy estimator-style training API (reference: model.py:451
+    FeedForward — deprecated there in favor of Module, provided here for
+    surface parity). Accepts numpy/NDArray X,y directly; internally a
+    thin shell over :class:`mxnet_tpu.module.Module`, which owns the
+    compiled train step."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn("FeedForward is deprecated; use mxnet_tpu.module."
+                      "Module", DeprecationWarning, stacklevel=2)
+        from .initializer import Uniform
+        self.symbol = symbol
+        if allow_extra_params:
+            if arg_params:
+                names = set(symbol.list_arguments())
+                arg_params = {k: v for k, v in arg_params.items()
+                              if k in names}
+            if aux_params:
+                names = set(symbol.list_auxiliary_states())
+                aux_params = {k: v for k, v in aux_params.items()
+                              if k in names}
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs.copy()
+        self._module = None
+
+    # -- data plumbing -----------------------------------------------------
+    def _as_iter(self, X, y=None, shuffle=False):
+        """numpy/NDArray → NDArrayIter; DataIter passes through
+        (reference: model.py _init_iter)."""
+        from . import io as _io
+        if isinstance(X, _io.DataIter):
+            return X
+        if isinstance(X, NDArray):
+            X = X.asnumpy()
+        if y is not None and isinstance(y, NDArray):
+            y = y.asnumpy()
+        X = np.asarray(X)
+        if y is not None:
+            y = np.asarray(y)
+        batch = min(self.numpy_batch_size, X.shape[0])
+        return _io.NDArrayIter(X, y, batch_size=batch, shuffle=shuffle)
+
+    def _make_module(self):
+        from .module import Module
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("label")] or None
+        return Module(self.symbol, label_names=label_names, context=self.ctx)
+
+    # -- estimator surface -------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        assert self.num_epoch is not None, "num_epoch must be set"
+        if work_load_list is not None:
+            import warnings
+            warnings.warn("work_load_list is ignored: XLA shards the "
+                          "batch uniformly across the mesh", stacklevel=2)
+        train = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        self._module = self._make_module()
+        if logger is not None:
+            self._module.logger = logger
+        opt_params = dict(self.kwargs)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=tuple(opt_params.items()),
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params, allow_missing=True,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._as_iter(X)
+        mod = self._ensure_pred_module(data)
+        outs = mod.predict(data, num_batch=num_batch, reset=reset)
+        out_np = outs.asnumpy() if isinstance(outs, NDArray) else \
+            [o.asnumpy() for o in outs]
+        if return_data:
+            data.reset()
+            xs, ys = [], []
+            for b in data:
+                pad = b.pad
+                xs.append(b.data[0][0:b.data[0].shape[0] - pad].asnumpy())
+                if b.label:
+                    ys.append(
+                        b.label[0][0:b.label[0].shape[0] - pad].asnumpy())
+            return (out_np, np.concatenate(xs),
+                    np.concatenate(ys) if ys else None)
+        return out_np
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        data = self._as_iter(X)
+        mod = self._ensure_pred_module(data)
+        res = mod.score(data, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback, reset=reset)
+        return res[0][1]
+
+    def _ensure_pred_module(self, data):
+        if self._module is None:
+            if self.arg_params is None:
+                raise MXNetError("model has not been trained or loaded")
+            self._module = self._make_module()
+        if not self._module.binded:
+            self._module.bind(data_shapes=data.provide_data,
+                              label_shapes=data.provide_label,
+                              for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {},
+                                    allow_missing=False)
+        return self._module
+
+    # -- persistence (save_checkpoint format) ------------------------------
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a new model from data (reference: model.py:949)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -1,0 +1,57 @@
+"""FeedForward legacy estimator API (reference: model.py:451)."""
+import warnings
+import numpy as np
+import pytest
+import mxnet_tpu as mx
+from mxnet_tpu.model import FeedForward
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    f = mx.sym.FullyConnected(data, num_hidden=2, name="out")
+    return mx.sym.SoftmaxOutput(f, name="softmax")
+
+
+def _toy(n=128):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    return X, y
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    X, y = _toy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = FeedForward(_mlp_sym(), num_epoch=12, learning_rate=0.2,
+                            numpy_batch_size=32)
+    model.fit(X, y)
+    probs = model.predict(X)
+    assert probs.shape == (128, 2)
+    pred = probs.argmax(axis=1)
+    assert (pred == y).mean() > 0.9
+
+    # score via an iterator with labels
+    import mxnet_tpu.io as mio
+    it = mio.NDArrayIter(X, y, batch_size=32)
+    acc = model.score(it)
+    assert acc > 0.9
+
+    # save/load round trip keeps predictions
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=12)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        loaded = FeedForward.load(prefix, 12)
+    probs2 = loaded.predict(X)
+    np.testing.assert_allclose(probs2, probs, rtol=1e-5, atol=1e-6)
+
+
+def test_feedforward_create_and_return_data():
+    X, y = _toy(64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = FeedForward.create(_mlp_sym(), X, y, num_epoch=5,
+                                   learning_rate=0.2, numpy_batch_size=32)
+    probs, xs, ys = model.predict(X, return_data=True)
+    assert xs.shape == (64, 8) and probs.shape == (64, 2)

@@ -1,0 +1,59 @@
+"""Multi-host kvstore allreduce: two REAL processes joined via
+jax.distributed, aggregating through the device-side global-array psum
+(reference analog: dist_sync push/aggregate across ps-lite workers —
+tests/nightly/dist_sync_kvstore.py pattern)."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.distributed.initialize(
+    coordinator_address=os.environ["COORD"],
+    num_processes=2, process_id=int(sys.argv[1]))
+sys.path.insert(0, %r)
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+assert jax.process_count() == 2, jax.process_count()
+kv = mx.kvstore.create("dist_tpu_sync")
+assert kv.num_workers == 2, kv.num_workers
+rank = jax.process_index()
+kv.init(3, nd.zeros((4, 5)))
+kv.push(3, nd.ones((4, 5)) * (rank + 1))
+out = nd.zeros((4, 5))
+kv.pull(3, out=out)
+np.testing.assert_allclose(out.asnumpy(), 3.0)
+print("rank", rank, "OK", flush=True)
+""" % (REPO,)
+
+
+def test_two_process_device_side_allreduce(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % port.getsockname()[1]
+    port.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", COORD=coord)
+    env.pop("MXNET_TPU_PS_URI", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER)
+    procs = [subprocess.Popen([sys.executable, script, str(r)], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert "OK" in out
