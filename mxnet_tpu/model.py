@@ -183,10 +183,14 @@ class FeedForward(object):
             monitor=None, eval_end_callback=None,
             eval_batch_end_callback=None):
         assert self.num_epoch is not None, "num_epoch must be set"
+        import warnings
         if work_load_list is not None:
-            import warnings
             warnings.warn("work_load_list is ignored: XLA shards the "
                           "batch uniformly across the mesh", stacklevel=2)
+        if self.epoch_size is not None:
+            warnings.warn("epoch_size is ignored: epochs run the full "
+                          "iterator (resize the iterator instead)",
+                          stacklevel=2)
         train = self._as_iter(X, y, shuffle=True)
         if eval_data is not None and not hasattr(eval_data, "provide_data"):
             eval_data = self._as_iter(eval_data[0], eval_data[1])

@@ -15,6 +15,7 @@ from . import image_ops    # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import deformable_ops  # noqa: F401
+from . import sampler_ops  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import sparse_ops   # noqa: F401
 

@@ -227,3 +227,10 @@ def _cast(x, dtype="float32"):
     return x.astype(np_dtype(dtype))
 
 alias("cast", "Cast")
+
+
+@register("hard_sigmoid", attr_defaults={"alpha": 0.2, "beta": 0.5})
+def _hard_sigmoid(x, alpha=0.2, beta=0.5, **_ig):
+    """y = max(0, min(1, alpha*x + beta)) (reference:
+    tensor/elemwise_unary_op_basic.cc:109)."""
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)

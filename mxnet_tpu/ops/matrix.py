@@ -478,3 +478,31 @@ def decode_index_key(enc):
 def _getitem(data, key=()):
     """Basic indexing (differentiable; vjp is the scatter of the slice)."""
     return data[decode_index_key(key)]
+
+
+@register("_ravel_multi_index", differentiable=False,
+          attr_defaults={"shape": ()})
+def _ravel_multi_index(data, shape=(), **_ig):
+    """Multi-indices (ndim, N) -> flat indices (N,), numpy convention:
+    one multi-index per COLUMN (reference: tensor/ravel.cc:32)."""
+    shape = tuple(int(s) for s in shape)
+    flat = jnp.ravel_multi_index(
+        tuple(data[i].astype(jnp.int32) for i in range(len(shape))),
+        shape, mode="clip")
+    return flat.astype(data.dtype)
+
+
+alias("ravel_multi_index", "_ravel_multi_index")
+
+
+@register("_unravel_index", differentiable=False,
+          attr_defaults={"shape": ()})
+def _unravel_index(data, shape=(), **_ig):
+    """Flat indices (N,) -> multi-indices (ndim, N), one multi-index per
+    column (reference: tensor/ravel.cc:56)."""
+    shape = tuple(int(s) for s in shape)
+    rows = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack(rows, axis=0).astype(data.dtype)
+
+
+alias("unravel_index", "_unravel_index")

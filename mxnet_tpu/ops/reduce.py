@@ -150,3 +150,43 @@ def _l2_normalization(x, eps=1e-10, mode="instance"):
         axes = tuple(range(2, x.ndim))
     denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
     return x / denom
+
+
+@register("_histogram", num_outputs=2, differentiable=False,
+          attr_defaults={"bin_cnt": None, "range": None})
+def _histogram(data, bins=None, bin_cnt=None, range=None, **_ig):
+    """Histogram (reference: tensor/histogram.cc). Two forms:
+    explicit ``bins`` edge array (second input), or uniform bins via
+    ``bin_cnt`` + ``range`` attrs (range defaults to data min/max).
+    Returns (counts int64, bin_edges)."""
+    from ..base import MXNetError
+    flat = data.reshape(-1)
+    if bins is not None:
+        # non-uniform edges: bin by binary search, not uniform width
+        edges = bins
+        n = edges.shape[0] - 1
+        lo, hi = edges[0], edges[-1]
+        idx = jnp.searchsorted(edges, flat, side="right") - 1
+    else:
+        if bin_cnt is None:
+            raise MXNetError("_histogram needs bins input or bin_cnt attr")
+        n = int(bin_cnt)
+        if range is not None:
+            lo = jnp.asarray(range[0], flat.dtype)
+            hi = jnp.asarray(range[1], flat.dtype)
+        else:
+            lo = jnp.min(flat)
+            hi = jnp.max(flat)
+        edges = lo + (hi - lo) * jnp.arange(n + 1, dtype=flat.dtype) / n
+        width = (hi - lo) / n
+        idx = jnp.floor((flat - lo)
+                        / jnp.maximum(width, 1e-30)).astype(jnp.int32)
+    # right edge of the last bin is inclusive (numpy/reference semantics)
+    idx = jnp.where(flat == hi, n - 1, idx.astype(jnp.int32))
+    valid = (flat >= lo) & (flat <= hi)
+    idx = jnp.where(valid, idx, n)      # overflow bucket, dropped below
+    counts = jnp.zeros((n + 1,), jnp.int32).at[idx].add(1)[:n]
+    return counts, edges
+
+
+alias("histogram", "_histogram")

@@ -1,0 +1,105 @@
+"""Spatial sampling ops: BilinearSampler, GridGenerator,
+SpatialTransformer.
+
+Reference: src/operator/bilinear_sampler.cc, grid_generator.cc,
+spatial_transformer.cc (the STN stack). TPU-native formulation: the
+per-pixel bilinear gather is expressed as four batched gathers +
+weights, which XLA fuses into one kernel; everything is pure jnp so the
+whole stack is differentiable through both data and grid (the reference
+hand-writes both backward kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _bilinear_gather(data, xs, ys):
+    """Sample data (N,C,H,W) at fractional pixel coords xs/ys (N,oh,ow)
+    with zero padding outside the boundary."""
+    N, C, H, W = data.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = xs - x0
+    wy = ys - y0
+
+    def tap(yi, xi):
+        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        # (N,oh,ow) index into (N,C,H,W) -> (N,C,oh,ow)
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(data, yc, xc)
+        return v * inb[:, None].astype(data.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wx = wx[:, None].astype(data.dtype)
+    wy = wy[:, None].astype(data.dtype)
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy) +
+            v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+@register("BilinearSampler", attr_defaults={"cudnn_off": False})
+def _bilinear_sampler(data, grid, cudnn_off=False, **_ig):
+    """data (N,C,H,W), grid (N,2,oh,ow) with normalized coords in
+    [-1,1] (grid[:,0]=x, grid[:,1]=y); zero padding outside
+    (reference: bilinear_sampler.cc)."""
+    N, C, H, W = data.shape
+    xs = (grid[:, 0] + 1.0) * (W - 1) / 2.0          # (N,oh,ow)
+    ys = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return _bilinear_gather(data, xs, ys)
+
+
+@register("GridGenerator",
+          attr_defaults={"transform_type": "affine", "target_shape": (0, 0)})
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0),
+                    **_ig):
+    """Generate a normalized sampling grid (reference: grid_generator.cc).
+
+    affine: data (N,6) row-major 2x3 affine applied to normalized
+    target coords. warp: data (N,2,h,w) optical flow in pixels added to
+    the identity pixel grid, then normalized."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        if h <= 0 or w <= 0:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        theta = data.reshape(-1, 2, 3)
+        yt, xt = jnp.meshgrid(jnp.linspace(-1.0, 1.0, h),
+                              jnp.linspace(-1.0, 1.0, w), indexing="ij")
+        ones = jnp.ones_like(xt)
+        src = jnp.stack([xt, yt, ones], 0).reshape(3, h * w)   # (3, hw)
+        grid = jnp.einsum("nij,jk->nik", theta, src)           # (N,2,hw)
+        return grid.reshape(-1, 2, h, w)
+    if transform_type == "warp":
+        N, two, h, w = data.shape
+        yt, xt = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                              jnp.arange(w, dtype=data.dtype),
+                              indexing="ij")
+        x = data[:, 0] + xt
+        y = data[:, 1] + yt
+        # normalize to [-1, 1] (reference grid_generator.cc warp kernel)
+        xn = x * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+        yn = y * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+        return jnp.stack([xn, yn], 1)
+    raise MXNetError("GridGenerator: unknown transform_type %r"
+                     % transform_type)
+
+
+@register("SpatialTransformer",
+          attr_defaults={"target_shape": (0, 0),
+                         "transform_type": "affine",
+                         "sampler_type": "bilinear", "cudnn_off": False})
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False, **_ig):
+    """STN: grid from ``loc``, bilinear-sample ``data`` on it
+    (reference: spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine+bilinear "
+                         "(reference parity)")
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)

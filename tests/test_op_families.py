@@ -291,3 +291,99 @@ def test_quantized_fc_matches_fp32():
                                   abs(float(omx.asscalar())))
     approx = q32.asnumpy().astype(np.float64) / scale
     np.testing.assert_allclose(approx, x @ w.T, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# histogram / ravel / hard_sigmoid (reference: tensor/histogram.cc,
+# tensor/ravel.cc, elemwise_unary_op_basic.cc:109)
+# ---------------------------------------------------------------------------
+
+def test_ravel_unravel_reference_examples():
+    A = mx.nd.array(np.array([[3, 6, 6], [4, 5, 1]], np.float32))
+    r = mx.nd.ravel_multi_index(A, shape=(7, 6))
+    np.testing.assert_array_equal(r.asnumpy(), [22, 41, 37])
+    u = mx.nd.unravel_index(mx.nd.array(np.array([22, 41, 37], np.float32)),
+                            shape=(7, 6))
+    np.testing.assert_array_equal(u.asnumpy(), A.asnumpy())
+
+
+def test_histogram_uniform_and_explicit_bins():
+    x = mx.nd.array(np.array([[0, 1], [2, 2], [3, 4]], np.float32))
+    cnt, edges = mx.nd.histogram(x, bin_cnt=5, range=(0, 5))
+    np.testing.assert_array_equal(cnt.asnumpy(), [1, 1, 2, 1, 1])
+    np.testing.assert_allclose(edges.asnumpy(), [0, 1, 2, 3, 4, 5])
+    ref_cnt, ref_edges = np.histogram(x.asnumpy(),
+                                      bins=np.array([0., 2., 4., 5.]))
+    cnt2, edges2 = mx.nd.histogram(x, mx.nd.array(np.array([0., 2., 4., 5.],
+                                                           np.float32)))
+    np.testing.assert_array_equal(cnt2.asnumpy(), ref_cnt)
+    np.testing.assert_allclose(edges2.asnumpy(), ref_edges)
+    # NON-uniform explicit edges must bin by search, not uniform width
+    y = mx.nd.array(np.array([1.5, 0.5, 3.5], np.float32))
+    cu, _eu = mx.nd.histogram(y, mx.nd.array(np.array([0., 1., 4.],
+                                                      np.float32)))
+    np.testing.assert_array_equal(cu.asnumpy(),
+                                  np.histogram([1.5, 0.5, 3.5],
+                                               bins=[0, 1, 4])[0])
+
+
+def test_hard_sigmoid_matches_definition():
+    x = np.linspace(-4, 4, 21).astype(np.float32)
+    out = mx.nd.hard_sigmoid(mx.nd.array(x), alpha=0.25, beta=0.4)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.clip(0.25 * x + 0.4, 0, 1), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# STN stack (reference: bilinear_sampler.cc, grid_generator.cc,
+# spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+def test_bilinear_sampler_identity_and_flip():
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(2, 3, 6, 6).astype(np.float32))
+    ident = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = mx.nd.GridGenerator(mx.nd.array(ident),
+                               transform_type="affine",
+                               target_shape=(6, 6))
+    out = mx.nd.BilinearSampler(data, grid)
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    # x-flip affine mirrors the width axis
+    flip = np.tile(np.array([-1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    gf = mx.nd.GridGenerator(mx.nd.array(flip), transform_type="affine",
+                             target_shape=(6, 6))
+    np.testing.assert_allclose(
+        mx.nd.BilinearSampler(data, gf).asnumpy(),
+        data.asnumpy()[:, :, :, ::-1], rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_warp_shifts_pixels():
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.randn(1, 1, 5, 5).astype(np.float32))
+    flow = np.zeros((1, 2, 5, 5), np.float32)
+    flow[:, 0] = 1.0                     # shift sampling +1px in x
+    g = mx.nd.GridGenerator(mx.nd.array(flow), transform_type="warp")
+    out = mx.nd.BilinearSampler(data, g).asnumpy()
+    # column j samples source column j+1; last column falls outside -> 0
+    np.testing.assert_allclose(out[0, 0, :, :-1],
+                               data.asnumpy()[0, 0, :, 1:], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, :, -1], 0.0, atol=1e-6)
+
+
+def test_spatial_transformer_downscale_shape_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(2)
+    data = jnp.asarray(rng.randn(1, 2, 8, 8).astype(np.float32))
+    theta = jnp.asarray([[0.5, 0, 0.1, 0, 0.5, -0.1]], jnp.float32)
+    fn = get_op("SpatialTransformer").fn
+    out = fn(data, theta, target_shape=(4, 4))
+    assert out.shape == (1, 2, 4, 4)
+    # differentiable through data AND localisation params
+    g = jax.grad(lambda d, t: jnp.sum(
+        fn(d, t, target_shape=(4, 4)) ** 2), (0, 1))(data, theta)
+    assert np.isfinite(np.asarray(g[0])).all()
+    assert np.isfinite(np.asarray(g[1])).all() and np.abs(g[1]).sum() > 0

@@ -127,7 +127,27 @@ def _spec_table():
                  jnp.asarray([[0.22, 0.18, 0.61, 0.59],
                               [0.55, 0.52, 0.94, 0.9]], jnp.float32)],
             eps=1e-3, rtol=0.08, atol=0.02),
+        # grid points centered between pixel-grid lines so FD never
+        # crosses a floor() cell boundary (gradient w.r.t. grid is
+        # piecewise-smooth in each cell)
+        "BilinearSampler": dict(ins=[_f32(1, 2, 5, 5), _mid_cell_grid()]),
+        "GridGenerator": dict(
+            ins=[jnp.asarray([[1.02, 0.03, 0.01, -0.02, 0.97, 0.04]],
+                             jnp.float32)],
+            attrs={"transform_type": "affine", "target_shape": (4, 4)}),
+        "SpatialTransformer": dict(
+            ins=[_f32(1, 2, 5, 5),
+                 jnp.asarray([[0.71, 0.03, 0.015, -0.02, 0.68, 0.035]],
+                             jnp.float32)],
+            attrs={"target_shape": (4, 4)}, eps=1e-3, rtol=0.08,
+            atol=0.02),
     }
+
+
+def _mid_cell_grid():
+    base = _RNG.choice([-0.75, -0.25, 0.25, 0.75], (1, 2, 3, 3))
+    jitter = _RNG.uniform(-0.04, 0.04, (1, 2, 3, 3))
+    return jnp.asarray((base + jitter).astype(np.float32))
 
 
 def _arange_input():
