@@ -158,7 +158,8 @@ def _histogram(data, bins=None, bin_cnt=None, range=None, **_ig):
     """Histogram (reference: tensor/histogram.cc). Two forms:
     explicit ``bins`` edge array (second input), or uniform bins via
     ``bin_cnt`` + ``range`` attrs (range defaults to data min/max).
-    Returns (counts int64, bin_edges)."""
+    Returns (counts int32 — JAX default-x64-off config; the
+    reference emits int64 — bin_edges)."""
     from ..base import MXNetError
     flat = data.reshape(-1)
     if bins is not None:

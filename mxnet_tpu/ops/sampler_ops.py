@@ -18,29 +18,11 @@ from .registry import register
 
 def _bilinear_gather(data, xs, ys):
     """Sample data (N,C,H,W) at fractional pixel coords xs/ys (N,oh,ow)
-    with zero padding outside the boundary."""
-    N, C, H, W = data.shape
-    x0 = jnp.floor(xs)
-    y0 = jnp.floor(ys)
-    wx = xs - x0
-    wy = ys - y0
-
-    def tap(yi, xi):
-        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
-        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
-        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
-        # (N,oh,ow) index into (N,C,H,W) -> (N,C,oh,ow)
-        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(data, yc, xc)
-        return v * inb[:, None].astype(data.dtype)
-
-    v00 = tap(y0, x0)
-    v01 = tap(y0, x0 + 1)
-    v10 = tap(y0 + 1, x0)
-    v11 = tap(y0 + 1, x0 + 1)
-    wx = wx[:, None].astype(data.dtype)
-    wy = wy[:, None].astype(data.dtype)
-    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy) +
-            v10 * (1 - wx) * wy + v11 * wx * wy)
+    with zero padding outside the boundary. One shared interpolation
+    kernel for the whole ops package: this is the deformable-conv
+    gather (_bilinear_chw) vmapped over the batch."""
+    from .deformable_ops import _bilinear_chw
+    return jax.vmap(_bilinear_chw)(data, ys, xs)
 
 
 @register("BilinearSampler", attr_defaults={"cudnn_off": False})

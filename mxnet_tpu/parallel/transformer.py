@@ -54,6 +54,7 @@ class TransformerConfig:
     capacity_factor: float = 2.0
     dtype: object = jnp.float32
     sp_attn: str = "ring"         # "ring" (ppermute) | "ulysses" (a2a)
+    remat: bool = False           # jax.checkpoint each block (long-seq)
 
 
 # ---------------------------------------------------------------------------
@@ -228,9 +229,19 @@ def _stage_local(stage_params, x, cfg, heads_local, ep_size):
     x = _pvary(x, ("pp",))
     aux0 = _pvary(jnp.zeros((), x.dtype), ("dp", "sp", "pp"))
 
+    block = _block_local
+    if cfg.remat:
+        # rematerialize each block on the backward pass: activation
+        # memory drops from O(layers * s_local * d) to O(s_local * d)
+        # per stage at ~1/3 extra FLOPs — the TPU long-context trade
+        # (HBM is the bottleneck, MXU FLOPs are cheap)
+        block = jax.checkpoint(
+            _block_local, static_argnums=(2, 3, 4),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
     def body(carry, lp):
         x, aux = carry
-        x, a = _block_local(lp, x, cfg, heads_local, ep_size)
+        x, a = block(lp, x, cfg, heads_local, ep_size)
         return (x, aux + a), None
 
     (x, aux), _ = jax.lax.scan(body, (x, aux0), stage_params)

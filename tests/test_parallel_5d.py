@@ -203,3 +203,11 @@ def test_transformer_ulysses_sp():
                             n_layers=2, d_ff=64, max_len=64,
                             sp_attn="ulysses")
     _compare_step(cfg, (2, 2, 2, 1, 1))
+
+
+def test_transformer_remat_matches_exact():
+    """remat=True must reproduce the exact same training trajectory
+    (rematerialisation changes memory, not math)."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_len=64, remat=True)
+    _compare_step(cfg, (2, 2, 2, 1, 1))
