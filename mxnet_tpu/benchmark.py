@@ -45,6 +45,9 @@ BASELINES = {
     "vgg16_infer_img_per_sec": 708.43,
     "alexnet_infer_img_per_sec": 7906.09,
     "inception-v3_infer_img_per_sec": 814.59,
+    # latency (batch 1) + large batch rows of the same published table
+    "resnet50_infer_b1_img_per_sec": 162.15,       # perf.md:147-159
+    "resnet50_infer_b128_img_per_sec": 1233.15,
 }
 
 # Peak MXU throughput per chip for MFU estimates; overridable because the
@@ -172,6 +175,49 @@ def _timeit(fn, *args, warmup=3, iters=20, sync=None):
 # ---------------------------------------------------------------------------
 # training jobs
 
+def _measure_train(trainer, batch, image, num_classes, iters, dtype,
+                   fwd_gflop_per_img=None, warmup=3):
+    """Shared training-throughput harness: stage one synthetic batch on
+    device (reference --benchmark mode semantics — the loop times
+    compute, not the host tunnel), run fused steps, sync on the loss
+    AND an updated-parameter element (the final optimizer update must
+    have physically completed), and reject any reading implying more
+    FLOP/s than the chip's peak (a non-blocking transport must never
+    bank a number)."""
+    params, moms, aux = trainer.init((batch,) + image, (batch,))
+    rng = np.random.RandomState(0)
+    data, label = trainer.stage(
+        rng.randn(batch, *image).astype(np.float32),
+        rng.randint(0, num_classes, size=(batch,)).astype(np.float32))
+    state = [params, moms, aux]
+
+    def step():
+        state[0], state[1], state[2], loss = trainer.step(
+            state[0], state[1], state[2], data, label)
+        return loss
+
+    def _sync(loss):
+        p = state[0]
+        return (loss, p[next(iter(p))])
+
+    t0 = time.time()
+    dt = _timeit(step, warmup=warmup, iters=iters, sync=_sync)
+    log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
+    img_s = batch / dt
+    extra = {"ms_per_step": round(dt * 1e3, 1), "dtype": dtype,
+             "batch": batch}
+    if fwd_gflop_per_img:
+        pk = peak_flops(dtype)
+        mfu = (img_s * 3 * fwd_gflop_per_img * 1e9) / pk   # fwd + 2x bwd
+        if mfu > 1.05:
+            raise RuntimeError(
+                "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
+                "— transport not blocking, refusing to bank"
+                % (img_s, mfu))
+        extra.update(mfu_est=round(mfu, 4), peak_flops=pk)
+    return img_s, extra
+
+
 def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
                  image=(3, 224, 224)):
     import jax
@@ -183,42 +229,9 @@ def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
     cdt = None if dtype == "float32" else dtype
     trainer = ShardedTrainer(net, mesh, lr=0.05, momentum=0.9, dp_axis="dp",
                              compute_dtype=cdt)
-    params, moms, aux = trainer.init((batch,) + image, (batch,))
-    rng = np.random.RandomState(0)
-    data = rng.randn(batch, *image).astype(np.float32)
-    label = rng.randint(0, 1000, size=(batch,)).astype(np.float32)
-    # one H2D copy up front (reference --benchmark mode semantics); the
-    # measured loop then times compute, not the host tunnel
-    data, label = trainer.stage(data, label)
-
-    state = [params, moms, aux]
-
-    def step():
-        state[0], state[1], state[2], loss = trainer.step(
-            state[0], state[1], state[2], data, label)
-        return loss
-
-    # sync on the loss AND an updated-parameter element: the final
-    # step's optimizer update must have physically completed
-    def _sync(loss):
-        p = state[0]
-        return (loss, p[next(iter(p))])
-
-    t0 = time.time()
-    dt = _timeit(step, warmup=3, iters=iters, sync=_sync)
-    log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
-    img_s = batch / dt
-    pk = peak_flops(dtype)
-    mfu = (img_s * RESNET50_TRAIN_GFLOP_PER_IMG * 1e9) / pk \
-        if num_layers == 50 else None
-    if mfu and mfu > 1.05:
-        raise RuntimeError(
-            "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
-            "— transport not blocking, refusing to bank" % (img_s, mfu))
-    return img_s, {"ms_per_step": round(dt * 1e3, 1),
-                   "mfu_est": round(mfu, 4) if mfu else None,
-                   "peak_flops": pk,
-                   "dtype": dtype, "batch": batch}
+    gflop = RESNET50_GFLOP_PER_IMG if num_layers == 50 else None
+    return _measure_train(trainer, batch, image, 1000, iters, dtype,
+                          fwd_gflop_per_img=gflop)
 
 
 def data_pipeline(batch=128, n_images=512, size=224, iters=8,
@@ -297,6 +310,31 @@ def data_pipeline(batch=128, n_images=512, size=224, iters=8,
                    "decode": "jpeg256->aug%d" % size}
 
 
+def train_inception(batch=32, dtype="float32", iters=10):
+    """Inception-v3 training throughput (reference table row
+    docs/faq/perf.md:205-214, 214.48 img/s on V100). The gluon zoo model
+    is traced to a Symbol (nested-block symbol dispatch) and trained
+    through the same fused ShardedTrainer step as ResNet."""
+    import jax
+    from .gluon.model_zoo.vision import get_model
+    from .ndarray.ndarray import array as nd_array
+    from .parallel import make_mesh, ShardedTrainer
+
+    net = get_model("inceptionv3", classes=1000)
+    net.initialize()
+    net(nd_array(np.zeros((1, 3, 299, 299), np.float32)))
+    import mxnet_tpu as mx
+    sym = mx.sym.SoftmaxOutput(net._trace_symbol(), name="softmax")
+
+    mesh = make_mesh((jax.device_count(),), axis_names=("dp",))
+    cdt = None if dtype == "float32" else dtype
+    trainer = ShardedTrainer(sym, mesh, lr=0.05, momentum=0.9,
+                             dp_axis="dp", compute_dtype=cdt)
+    return _measure_train(
+        trainer, batch, (3, 299, 299), 1000, iters, dtype,
+        fwd_gflop_per_img=MODEL_GFLOP_PER_IMG["inception-v3"])
+
+
 def train_mlp(batch=64, iters=50):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run."""
@@ -306,24 +344,8 @@ def train_mlp(batch=64, iters=50):
     net = mlp()
     mesh = make_mesh((jax.device_count(),), axis_names=("dp",))
     trainer = ShardedTrainer(net, mesh, lr=0.1, momentum=0.9, dp_axis="dp")
-    params, moms, aux = trainer.init((batch, 784), (batch,))
-    rng = np.random.RandomState(0)
-    data = rng.randn(batch, 784).astype(np.float32)
-    label = rng.randint(0, 10, size=(batch,)).astype(np.float32)
-    data, label = trainer.stage(data, label)
-    state = [params, moms, aux]
-
-    def step():
-        state[0], state[1], state[2], loss = trainer.step(
-            state[0], state[1], state[2], data, label)
-        return loss
-
-    def _sync(loss):
-        p = state[0]
-        return (loss, p[next(iter(p))])
-
-    dt = _timeit(step, warmup=5, iters=iters, sync=_sync)
-    return batch / dt, {"ms_per_step": round(dt * 1e3, 2), "batch": batch}
+    return _measure_train(trainer, batch, (784,), 10, iters, "float32",
+                          warmup=5)
 
 
 # ---------------------------------------------------------------------------
@@ -428,24 +450,33 @@ def _job_mlp_train():
     return persist("mlp_train_img_per_sec", v, "img/s (batch 64, fp32)", x)
 
 
+def _job_inception_train():
+    v, x = train_inception(32, "float32")
+    return persist("inception-v3_train_img_per_sec", v,
+                   "img/s (batch 32, fp32, 1 chip)", x)
+
+
 def _job_data_pipeline():
     v, x = data_pipeline()
     return persist("data_pipeline_img_per_sec", v,
                    "img/s (jpeg decode+augment, host pipeline)", x)
 
 
-def _make_infer_job(model, dtype):
+def _make_infer_job(model, dtype, batch=32):
     def job():
-        v, x = infer_score(model, 32, dtype)
+        v, x = infer_score(model, batch, dtype)
         suffix = "_bf16" if dtype != "float32" else ""
+        if batch != 32:
+            suffix += "_b%d" % batch
         return persist("%s_infer%s_img_per_sec" % (model, suffix), v,
-                       "img/s (batch 32, %s, 1 chip)" % dtype, x)
+                       "img/s (batch %d, %s, 1 chip)" % (batch, dtype), x)
     return job
 
 
 JOBS = {
     "mlp_train": _job_mlp_train,
     "data_pipeline": _job_data_pipeline,
+    "inception-v3_train": _job_inception_train,
     "resnet50_train": _job_resnet50_train,
     "resnet50_train_bf16": _job_resnet50_train_bf16,
     "resnet50_train_b128": _job_resnet50_train_b128,
@@ -454,6 +485,9 @@ JOBS = {
 for _m in _SCORE_MODELS:
     JOBS["%s_infer" % _m] = _make_infer_job(_m, "float32")
     JOBS["%s_infer_bf16" % _m] = _make_infer_job(_m, "bfloat16")
+JOBS["resnet50_infer_b1"] = _make_infer_job("resnet50", "float32", batch=1)
+JOBS["resnet50_infer_b128"] = _make_infer_job("resnet50", "float32",
+                                              batch=128)
 
 # priority order for the daemon: cheapest/highest-value first
 JOB_PRIORITY = [
@@ -465,6 +499,9 @@ JOB_PRIORITY = [
     "resnet50_infer_bf16",
     "resnet50_train_b128",
     "resnet50_train_b128_bf16",
+    "inception-v3_train",
+    "resnet50_infer_b1",
+    "resnet50_infer_b128",
     "alexnet_infer",
     "vgg16_infer",
     "resnet152_infer",

@@ -52,6 +52,15 @@ VARS = {
                                      "input->output aliasing = true "
                                      "in-place updates, no double-"
                                      "buffering)."),
+    "MXNET_DATALOADER_START_METHOD": (str, "fork",
+                                      "Process start method for "
+                                      "DataLoader workers (fork/spawn/"
+                                      "forkserver). fork shares the "
+                                      "dataset copy-on-write but "
+                                      "inherits JAX's threads; use "
+                                      "spawn/forkserver if forked "
+                                      "workers crash (script then needs "
+                                      "the standard __main__ guard)."),
 }
 
 

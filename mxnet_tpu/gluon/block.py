@@ -465,8 +465,18 @@ class HybridBlock(Block):
 
     def forward(self, *args):
         """Gather registered params and dispatch to hybrid_forward; with
-        hybridize() active, route through the CachedOp."""
+        hybridize() active, route through the CachedOp. Symbol inputs
+        (export/_trace_symbol walking nested blocks) dispatch on the
+        symbol namespace with parameter variables instead."""
         from .. import ndarray as F
+        from ..symbol.symbol import Symbol as _Sym
+
+        if args and isinstance(args[0], _Sym):
+            from .. import symbol as symF
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            with _SymbolTraceScope():
+                return self.hybrid_forward(symF, *args, **params)
 
         if self._active and not _in_cached_trace() and not _in_shape_probe():
             if self._cached_op is None:
@@ -510,22 +520,16 @@ class HybridBlock(Block):
         return sym
 
     def _trace_symbol(self, n_inputs=1):
+        """Trace this block into a Symbol graph: calling the block with
+        Symbol inputs routes every (nested) forward() through the
+        symbol-dispatch branch above."""
         from .. import symbol as sym_mod
         inputs = [sym_mod.var("data%d" % i if i else "data")
                   for i in range(n_inputs)]
-        out = self._symbol_forward(*inputs)
+        out = self(*inputs)
         if isinstance(out, (list, tuple)):
             return sym_mod.Group(out)
         return out
-
-    def _symbol_forward(self, *inputs):
-        from .. import symbol as sym_mod
-
-        def walk(block, args):
-            params = {name: p.var() for name, p in block._reg_params.items()}
-            with _SymbolTraceScope():
-                return block.hybrid_forward(sym_mod, *args, **params)
-        return walk(self, inputs)
 
 
 _symbol_trace = threading.local()

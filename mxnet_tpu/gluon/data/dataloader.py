@@ -201,11 +201,18 @@ class DataLoader(object):
             for w in workers:
                 w.start()
             return workers, in_q, out_q, False
-        # fork shares the dataset copy-on-write (no pickling); fall back
-        # to spawn where fork doesn't exist (the worker loop is
-        # module-level picklable)
-        method = "fork" if "fork" in \
-            multiprocessing.get_all_start_methods() else "spawn"
+        # fork shares the dataset copy-on-write (no pickling) but
+        # inherits JAX's threads (fork-safety hazard); the start method
+        # is configurable for hosts where forked workers crash. spawn/
+        # forkserver need the worker loop picklable (it is,
+        # module-level).
+        from ... import config as _config
+        method = _config.get("MXNET_DATALOADER_START_METHOD")
+        valid = multiprocessing.get_all_start_methods()
+        if method not in valid:
+            raise ValueError(
+                "MXNET_DATALOADER_START_METHOD=%r is not a start method "
+                "on this platform (valid: %s)" % (method, ", ".join(valid)))
         ctx = multiprocessing.get_context(method)
         in_q, out_q = ctx.Queue(), ctx.Queue()
         workers = [

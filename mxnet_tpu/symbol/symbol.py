@@ -658,7 +658,14 @@ def _apply_op(op, args, attrs, name):
             sym = inputs[in_name]
             if len(sym._entries) != 1:
                 raise MXNetError("op inputs must be single-output symbols")
-            node_inputs.append(sym._entries[0])
+            ent = sym._entries[0]
+            if in_name in aux_names and ent[0].is_var:
+                # an explicit variable wired into an aux slot IS an aux
+                # state (mutable, not gradient-trained) — reference
+                # semantics; gluon's symbol trace passes running stats
+                # this way
+                ent[0].is_aux = True
+            node_inputs.append(ent)
             wired_names.append(in_name)
             continue
         # missing input: auto-create a variable (reference behavior), or

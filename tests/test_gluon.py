@@ -277,3 +277,54 @@ def test_gluon_utils_split_and_load():
     total = gluon.utils.clip_global_norm([mx.nd.ones((2,)) * 3,
                                           mx.nd.ones((2,)) * 4], 1.0)
     assert abs(total - np.sqrt(9 * 2 + 16 * 2)) < 1e-4
+
+
+def test_export_nested_block_roundtrip(tmp_path):
+    """export() on a NESTED HybridBlock (children dispatch on the symbol
+    namespace during tracing — regression: child forward() used to
+    hard-code the ndarray namespace) → SymbolBlock.imports serves the
+    same outputs; BN running stats classify as auxiliary states."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    ref = net(x)
+
+    prefix = str(tmp_path / "exported")
+    sym = net.export(prefix, epoch=3)
+    assert len(sym.list_auxiliary_states()) == 2      # BN moving stats
+    loaded = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                 prefix + "-0003.params")
+    out = loaded(x)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_zoo_model_traces(tmp_path):
+    """A deep zoo model (nested Sequentials + BN everywhere) traces to a
+    symbol whose executor reproduces the gluon forward exactly."""
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model("squeezenet1.0", classes=10)
+    net.initialize()
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(1, 3, 64, 64).astype(np.float32))
+    ref = net(x)
+    sym = net._trace_symbol()
+    exe = sym.simple_bind(data=(1, 3, 64, 64))
+    for n, p in net.collect_params().items():
+        if n in exe.arg_dict:
+            exe.arg_dict[n][:] = p.data()
+        else:
+            exe.aux_dict[n][:] = p.data()
+    exe.arg_dict["data"][:] = x
+    out = exe.forward(is_train=False)[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
