@@ -48,6 +48,8 @@ BASELINES = {
     # latency (batch 1) + large batch rows of the same published table
     "resnet50_infer_b1_img_per_sec": 162.15,       # perf.md:147-159
     "resnet50_infer_b128_img_per_sec": 1233.15,
+    "inception-bn_infer_img_per_sec": 1847.26,
+    "inception-bn_infer_bf16_img_per_sec": 1854.30,  # vs V100 fp16 row
 }
 
 # Peak MXU throughput per chip for MFU estimates; overridable because the
@@ -73,6 +75,7 @@ RESNET50_TRAIN_GFLOP_PER_IMG = 3 * RESNET50_GFLOP_PER_IMG
 MODEL_GFLOP_PER_IMG = {
     "alexnet": 1.43,
     "vgg16": 30.9,
+    "inception-bn": 3.6,
     "resnet50": RESNET50_GFLOP_PER_IMG,
     "resnet152": 23.1,
     "inception-v3": 11.4,
@@ -357,7 +360,29 @@ _SCORE_MODELS = {
     "resnet50": "resnet50_v1",
     "resnet152": "resnet152_v1",
     "inception-v3": "inceptionv3",
+    "inception-bn": None,            # symbolic (models/inception_bn.py)
 }
+
+
+def _score_net(model):
+    """A hybridizable gluon block for ``model``: zoo models directly;
+    symbolic-only topologies (inception-bn) via SymbolBlock."""
+    from .gluon.model_zoo.vision import get_model
+    zoo_name = _SCORE_MODELS[model]
+    if zoo_name is not None:
+        net = get_model(zoo_name, classes=1000)
+        net.initialize()
+        return net
+    from .gluon.block import SymbolBlock
+    from .models import inception_bn
+    from .symbol.symbol import var as sym_var
+    import mxnet_tpu as mx
+    full = inception_bn(num_classes=1000)
+    logits = full.get_internals()["fc1_output"]
+    out = mx.sym.softmax(logits, name="prob")
+    net = SymbolBlock(out, [sym_var("data")])
+    net.initialize()
+    return net
 
 
 def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
@@ -370,12 +395,10 @@ def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
     physics gate rejects any reading above the chip's peak FLOP/s.
     """
     import jax
-    from .gluon.model_zoo.vision import get_model
     from . import ndarray as nd
 
     size = 299 if model == "inception-v3" else 224
-    net = get_model(_SCORE_MODELS[model], classes=1000)
-    net.initialize()
+    net = _score_net(model)
     net.hybridize()
     x = nd.array(np.random.randn(batch, 3, size, size).astype(np.float32))
     # one eager call builds params; then trace through CachedOp
@@ -506,10 +529,12 @@ JOB_PRIORITY = [
     "vgg16_infer",
     "resnet152_infer",
     "inception-v3_infer",
+    "inception-bn_infer",
     "alexnet_infer_bf16",
     "vgg16_infer_bf16",
     "resnet152_infer_bf16",
     "inception-v3_infer_bf16",
+    "inception-bn_infer_bf16",
 ]
 
 

@@ -16,6 +16,7 @@ not fork-shareable).
 from __future__ import annotations
 
 import multiprocessing
+import os
 import threading
 import queue as _queue
 
@@ -210,9 +211,13 @@ class DataLoader(object):
         method = _config.get("MXNET_DATALOADER_START_METHOD")
         valid = multiprocessing.get_all_start_methods()
         if method not in valid:
-            raise ValueError(
-                "MXNET_DATALOADER_START_METHOD=%r is not a start method "
-                "on this platform (valid: %s)" % (method, ", ".join(valid)))
+            if "MXNET_DATALOADER_START_METHOD" in os.environ:
+                # an EXPLICIT bad value is an error the user should see
+                raise ValueError(
+                    "MXNET_DATALOADER_START_METHOD=%r is not a start "
+                    "method on this platform (valid: %s)"
+                    % (method, ", ".join(valid)))
+            method = valid[0]    # default 'fork' absent (Windows): spawn
         ctx = multiprocessing.get_context(method)
         in_q, out_q = ctx.Queue(), ctx.Queue()
         workers = [

@@ -9,3 +9,4 @@ from .mlp import get_symbol as mlp
 from .alexnet import get_symbol as alexnet
 from .vgg import get_symbol as vgg
 from .mobilenet import get_symbol as mobilenet
+from .inception_bn import get_symbol as inception_bn

@@ -147,3 +147,26 @@ def test_variable_shape_attr():
     arg_shapes, out_shapes, _ = fc.infer_shape()
     assert arg_shapes[0] == (4, 7)
     assert out_shapes == [(4, 2)]
+
+
+def test_inception_bn_symbol_builds_and_runs():
+    """Inception-BN topology (reference:
+    example/image-classification/symbols/inception-bn.py; the missing
+    column of the benchmark_score tables). Checks the module concat
+    widths and a finite forward."""
+    from mxnet_tpu.models import inception_bn
+    sym = inception_bn(num_classes=1000)
+    args, outs, auxs = sym.infer_shape(data=(2, 3, 224, 224),
+                                       softmax_label=(2,))
+    assert outs == [(2, 1000)]
+    assert len(auxs) == 138        # 69 BN layers x (mean, var)
+    exe = sym.simple_bind(data=(1, 3, 224, 224))
+    rng = np.random.RandomState(0)
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            a[:] = mx.nd.array(rng.randn(*a.shape).astype(np.float32) * .05)
+    exe.arg_dict["data"][:] = mx.nd.array(
+        rng.randn(1, 3, 224, 224).astype(np.float32))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
