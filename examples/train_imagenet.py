@@ -52,8 +52,10 @@ def get_network(args):
         return models.mobilenet(num_classes=args.num_classes)
     if name == "mlp":
         return models.mlp(num_classes=args.num_classes)
+    if name in ("inception-bn", "inception_bn"):
+        return models.inception_bn(num_classes=args.num_classes)
     raise ValueError("unknown --network %r (choose from resnet, alexnet, "
-                     "vgg, mobilenet, mlp)" % name)
+                     "vgg, mobilenet, mlp, inception-bn)" % name)
 
 
 def main():

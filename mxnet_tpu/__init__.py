@@ -43,7 +43,7 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "profiler", "parallel", "test_utils", "image", "recordio", "engine",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
-         "config")
+         "config", "rnn", "mod")
 
 
 def __getattr__(name):
@@ -52,6 +52,11 @@ def __getattr__(name):
         from .symbol import AttrScope
         globals()["AttrScope"] = AttrScope
         return AttrScope
+    if name == "mod":
+        mod = importlib.import_module(".module", __name__)
+        globals()["module"] = mod
+        globals()["mod"] = mod
+        return mod
     if name in ("sym", "symbol"):
         mod = importlib.import_module(".symbol", __name__)
         globals()["symbol"] = mod

@@ -364,25 +364,33 @@ _SCORE_MODELS = {
 }
 
 
+def _symbolic_score_net(builder):
+    """SymbolBlock wrapping a symbolic topology's logits + softmax."""
+    from .gluon.block import SymbolBlock
+    from .symbol.symbol import var as sym_var
+    import mxnet_tpu as mx
+    full = builder(num_classes=1000)
+    logits = full.get_internals()["fc1_output"]
+    out = mx.sym.softmax(logits, name="prob")
+    net = SymbolBlock(out, [sym_var("data")])
+    net.initialize()
+    return net
+
+
 def _score_net(model):
     """A hybridizable gluon block for ``model``: zoo models directly;
-    symbolic-only topologies (inception-bn) via SymbolBlock."""
+    symbolic-only topologies via an explicit per-name dispatch (an
+    unhandled symbolic model must raise, not silently substitute)."""
     from .gluon.model_zoo.vision import get_model
     zoo_name = _SCORE_MODELS[model]
     if zoo_name is not None:
         net = get_model(zoo_name, classes=1000)
         net.initialize()
         return net
-    from .gluon.block import SymbolBlock
-    from .models import inception_bn
-    from .symbol.symbol import var as sym_var
-    import mxnet_tpu as mx
-    full = inception_bn(num_classes=1000)
-    logits = full.get_internals()["fc1_output"]
-    out = mx.sym.softmax(logits, name="prob")
-    net = SymbolBlock(out, [sym_var("data")])
-    net.initialize()
-    return net
+    if model == "inception-bn":
+        from .models import inception_bn
+        return _symbolic_score_net(inception_bn)
+    raise KeyError("no symbolic score builder registered for %r" % model)
 
 
 def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):

@@ -1,0 +1,13 @@
+"""Legacy symbolic RNN API (reference: python/mxnet/rnn/ — the cell
+zoo + BucketSentenceIter the BucketingModule workflow is built on).
+
+TPU note: FusedRNNCell exists for API parity but builds the same
+unrolled graph as the unfused cells — under jit, XLA fuses the step
+math and the whole unrolled sequence compiles to one program, which is
+the TPU analog of the reference's cuDNN fused kernels."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell)
+from .io import BucketSentenceIter
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)

@@ -1,0 +1,168 @@
+"""Legacy mx.rnn API: symbolic cells + BucketSentenceIter +
+BucketingModule — the reference's classic bucketed LM workflow
+(reference: python/mxnet/rnn/, tests/python/train/test_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_lstm_cell_unroll_matches_numpy():
+    cell = mx.rnn.LSTMCell(num_hidden=8, prefix="l0_")
+    outputs, states = cell.unroll(5, mx.sym.var("data"),
+                                  merge_outputs=True, batch_size=2)
+    exe = outputs.simple_bind(data=(2, 5, 4))
+    rng = np.random.RandomState(0)
+    vals = {}
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            v = rng.randn(*a.shape).astype(np.float32) * 0.4
+            a[:] = mx.nd.array(v)
+            vals[n] = v
+    x = rng.randn(2, 5, 4).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    out = exe.forward(is_train=False)[0].asnumpy()
+
+    h = np.zeros((2, 8), np.float32)
+    c = np.zeros((2, 8), np.float32)
+    ref = []
+    for t in range(5):
+        g = (x[:, t] @ vals["l0_i2h_weight"].T + vals["l0_i2h_bias"] +
+             h @ vals["l0_h2h_weight"].T + vals["l0_h2h_bias"])
+        i, f, ct, o = np.split(g, 4, axis=1)
+        c = _sigmoid(f + 1.0) * c + _sigmoid(i) * np.tanh(ct)
+        h = _sigmoid(o) * np.tanh(c)
+        ref.append(h)
+    np.testing.assert_allclose(out, np.stack(ref, 1), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gru_cell_unroll_matches_numpy():
+    cell = mx.rnn.GRUCell(num_hidden=6, prefix="g0_")
+    outputs, _ = cell.unroll(3, mx.sym.var("data"), merge_outputs=True,
+                             batch_size=2)
+    exe = outputs.simple_bind(data=(2, 3, 5))
+    rng = np.random.RandomState(1)
+    vals = {}
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            v = rng.randn(*a.shape).astype(np.float32) * 0.4
+            a[:] = mx.nd.array(v)
+            vals[n] = v
+    x = rng.randn(2, 3, 5).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    out = exe.forward(is_train=False)[0].asnumpy()
+
+    h = np.zeros((2, 6), np.float32)
+    ref = []
+    for t in range(3):
+        gi = x[:, t] @ vals["g0_i2h_weight"].T + vals["g0_i2h_bias"]
+        gh = h @ vals["g0_h2h_weight"].T + vals["g0_h2h_bias"]
+        ir, iz, inn = np.split(gi, 3, axis=1)
+        hr, hz, hn = np.split(gh, 3, axis=1)
+        r = _sigmoid(ir + hr)
+        z = _sigmoid(iz + hz)
+        n = np.tanh(inn + r * hn)
+        h = z * h + (1 - z) * n
+        ref.append(h)
+    np.testing.assert_allclose(out, np.stack(ref, 1), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_stacked_bidirectional_fused_shapes():
+    # FusedRNNCell = stacked (+bidirectional) unfused cells on TPU
+    cell = mx.rnn.FusedRNNCell(num_hidden=4, num_layers=2, mode="lstm",
+                               bidirectional=True, prefix="f_")
+    outputs, states = cell.unroll(6, mx.sym.var("data"),
+                                  merge_outputs=True, batch_size=3)
+    exe = outputs.simple_bind(data=(3, 6, 5))
+    rng = np.random.RandomState(2)
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            a[:] = mx.nd.array(rng.randn(*a.shape).astype(np.float32) * .3)
+    exe.arg_dict["data"][:] = mx.nd.array(
+        rng.randn(3, 6, 5).astype(np.float32))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (3, 6, 8)          # 2 directions x num_hidden
+    assert len(states) == 8                # 2 layers x 2 dirs x (h, c)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(3)
+    sentences = [list(rng.randint(1, 20, rng.randint(2, 17)))
+                 for _ in range(80)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[8, 16], invalid_label=0)
+    assert it.default_bucket_key == 16
+    n = 0
+    for batch in it:
+        L = batch.bucket_key
+        assert L in (8, 16)
+        assert batch.data[0].shape == (4, L)
+        assert batch.provide_data[0].shape == (4, L)
+        # label is data shifted left one step
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        n += 1
+    assert n > 0
+
+
+def test_bucketing_module_lstm_lm_trains():
+    """The classic workflow end-to-end: BucketSentenceIter feeding a
+    shared-weight LSTM LM through BucketingModule.fit-style steps."""
+    vocab, nh = 20, 16
+    rng = np.random.RandomState(4)
+    # learnable structure: next token = (token + 1) % vocab
+    sentences = []
+    for _ in range(60):
+        start = rng.randint(0, vocab)
+        ln = rng.randint(3, 9)
+        sentences.append([(start + k) % vocab for k in range(ln)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[4, 8], invalid_label=-1)
+
+    cell = mx.rnn.LSTMCell(num_hidden=nh, prefix="lm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=nh,
+                                 name="embed")
+        cell.reset()
+        outputs, _ = cell.unroll(seq_len, embed, merge_outputs=True,
+                                 batch_size=4)
+        pred = mx.sym.Reshape(outputs, shape=(-1, nh))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="fc")
+        label_flat = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, label_flat, name="softmax",
+                                   use_ignore=True, ignore_label=-1)
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen,
+                                    default_bucket_key=it.default_bucket_key,
+                                    context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    first = None
+    for epoch in range(8):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        ppl = metric.get()[1]
+        if first is None:
+            first = ppl
+    assert ppl < first * 0.7, (first, ppl)
