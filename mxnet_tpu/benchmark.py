@@ -112,12 +112,14 @@ def _platform():
 HARNESS_GEN = 2
 
 
-def persist(metric, value, unit, extra=None):
+def persist(metric, value, unit, extra=None, host_metric=False):
     """Merge a measurement into the store, keeping the best per metric.
     TPU measurements always supersede CPU ones (the judged number is the
     TPU one; a CPU number is only a last-resort fallback), and a newer
     timing-harness generation supersedes older ones even at a lower
-    value — trustworthy beats flattering."""
+    value — trustworthy beats flattering. ``host_metric`` disables the
+    platform ranking for measurements of the HOST (input pipeline):
+    there the attached accelerator is irrelevant."""
     os.makedirs(BENCH_DIR, exist_ok=True)
     results = load_results()
     prev = results.get(metric)
@@ -129,7 +131,10 @@ def persist(metric, value, unit, extra=None):
         rec["vs_baseline"] = round(float(value) / base, 3)
     if extra:
         rec.update(extra)
-    rank = {"tpu": 2, "cpu": 1}.get
+    if host_metric:
+        rank = lambda p, d=0: 0                    # noqa: E731
+    else:
+        rank = {"tpu": 2, "cpu": 1}.get
     prev_key = (rank(prev.get("platform", "cpu"), 0),
                 prev.get("harness", 1), prev["value"]) if prev else None
     new_key = (rank(rec["platform"], 0), rec["harness"], rec["value"])
@@ -490,7 +495,8 @@ def _job_inception_train():
 def _job_data_pipeline():
     v, x = data_pipeline()
     return persist("data_pipeline_img_per_sec", v,
-                   "img/s (jpeg decode+augment, host pipeline)", x)
+                   "img/s (jpeg decode+augment, host pipeline)", x,
+                   host_metric=True)
 
 
 def _make_infer_job(model, dtype, batch=32):
