@@ -1,0 +1,108 @@
+"""Bucketed LSTM language model via the legacy mx.rnn API — the
+capability analog of the reference's example/rnn/lstm_bucketing.py
+(PTB LSTM with BucketSentenceIter + BucketingModule).
+
+With --data pointing at a tokenized text file (one sentence per line,
+space-separated tokens) it trains on that corpus; without it, a
+synthetic modular-arithmetic corpus is generated so the example runs
+self-contained.
+
+    python examples/lstm_bucketing.py --num-epochs 5 --num-hidden 64
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_vocab(lines):
+    vocab = {}
+    for line in lines:
+        for tok in line.split():
+            if tok not in vocab:
+                vocab[tok] = len(vocab) + 1       # 0 = padding
+    return vocab
+
+
+def encode(lines, vocab):
+    return [[vocab[t] for t in line.split()] for line in lines]
+
+
+def synthetic_corpus(n=400, vocab_size=30, seed=0):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        start = rng.randint(0, vocab_size)
+        ln = rng.randint(4, 17)
+        sents.append([(start + k) % vocab_size + 1 for k in range(ln)])
+    return sents, vocab_size + 1
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", type=str, default=None,
+                    help="tokenized text file; synthetic corpus if unset")
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--buckets", type=str, default="8,16,24,32")
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--disp-batches", type=int, default=50)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.data:
+        lines = [l.strip() for l in open(args.data) if l.strip()]
+        vocab = build_vocab(lines)
+        sentences = encode(lines, vocab)
+        vocab_size = len(vocab) + 1
+    else:
+        sentences, vocab_size = synthetic_corpus()
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                   buckets=buckets, invalid_label=-1)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, embed, merge_outputs=True,
+                                  batch_size=args.batch_size)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                   use_ignore=True, ignore_label=-1)
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.fit(it,
+            eval_metric=mx.metric.Perplexity(ignore_label=-1),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,7 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "profiler", "parallel", "test_utils", "image", "recordio", "engine",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
-         "config", "rnn", "mod")
+         "config", "rnn", "mod", "name", "attribute", "log", "libinfo")
 
 
 def __getattr__(name):

@@ -1,0 +1,7 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py AttrScope).
+
+Re-exports the symbol layer's AttrScope so ``mx.attribute.AttrScope``
+and ``mx.AttrScope`` both work, as in the reference."""
+from .symbol.symbol import AttrScope
+
+__all__ = ["AttrScope"]

@@ -105,6 +105,24 @@ def _platform():
         return "unknown"
 
 
+def probe_device(timeout=120):
+    """Enumerate the backend in a BOUNDED subprocess (a wedged
+    accelerator tunnel hangs jax.devices() forever in-process).
+    Returns the platform string, or None when unreachable. Shared by
+    the bench daemon's probe loop and bench.py's live-run gate."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout, cwd=_ROOT)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
 # timing-harness generation: 2 = fetch-based sync (_fetch: the result is
 # proven delivered D2H), 1 = the older block_until_ready sync, which the
 # axon transport can satisfy early. Higher generation supersedes any

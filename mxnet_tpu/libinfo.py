@@ -1,0 +1,21 @@
+"""Build/version info (reference: python/mxnet/libinfo.py). The
+reference locates libmxnet.so here; this build's native artifacts live
+under build/native/."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["__version__", "find_lib_path"]
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of the native libraries, if built (reference:
+    libinfo.py find_lib_path)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(root, "build", "native")
+    if not os.path.isdir(native):
+        return []
+    return sorted(os.path.join(native, f) for f in os.listdir(native)
+                  if f.endswith(".so"))

@@ -1,0 +1,44 @@
+"""Logging helpers (reference: python/mxnet/log.py — a thin veneer over
+the stdlib with a compact colored formatter)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING",
+           "ERROR", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A configured logger (reference: log.py:90). File handler when
+    ``filename`` is given, stderr stream handler otherwise; repeated
+    calls reuse the configured logger."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_configured", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxnet_tpu_configured = True
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias (reference: log.py:80)."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger",
+                  DeprecationWarning, stacklevel=2)
+    return get_logger(name, filename, filemode, level)

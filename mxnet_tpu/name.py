@@ -1,0 +1,49 @@
+"""Name manager (reference: python/mxnet/name.py — NameManager and the
+``with mx.name.Prefix("foo_")`` pattern used throughout the examples).
+
+The active manager is the symbol layer's thread-local auto-namer; these
+context managers scope its prefix."""
+from __future__ import annotations
+
+from .symbol.symbol import _name_mgr
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager(object):
+    """Scoped control of automatic symbol naming (reference:
+    name.py NameManager). Entering installs this manager's prefix;
+    exiting restores the previous one."""
+
+    def __init__(self):
+        self._prefix = ""
+        self._old = None
+
+    def get(self, name, hint):
+        """Resolve a name: explicit names pass through, anonymous
+        symbols get ``prefix + hint + counter``."""
+        if name is not None:
+            return name
+        return _name_mgr.get(hint)
+
+    def __enter__(self):
+        self._old = _name_mgr.prefix
+        _name_mgr.prefix = self._prefix
+        return self
+
+    def __exit__(self, *exc):
+        _name_mgr.prefix = self._old
+
+
+class Prefix(NameManager):
+    """Prepend ``prefix`` to every auto-generated symbol name inside the
+    scope (reference: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super(Prefix, self).__init__()
+        self._prefix = prefix
+
+
+def current():
+    """The active (thread-local) auto-namer."""
+    return _name_mgr

@@ -48,16 +48,8 @@ def log(msg):
 
 
 def probe():
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT, cwd=ROOT)
-        if r.returncode == 0 and r.stdout.strip():
-            return r.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    return None
+    from mxnet_tpu.benchmark import probe_device
+    return probe_device(timeout=PROBE_TIMEOUT)
 
 
 def run_job(job):
