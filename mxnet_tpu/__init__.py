@@ -35,7 +35,7 @@ from . import autograd
 from . import random
 from .random import seed
 
-__version__ = "0.1.0"
+from .libinfo import __version__  # single source of truth
 
 # Subpackages that may not exist yet early in the build are imported lazily.
 _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",

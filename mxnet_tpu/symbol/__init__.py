@@ -7,6 +7,12 @@ from . import _internal
 from . import register as _register
 _register.populate(__name__, __package__ + "._internal")
 
+# sub-namespaces over the generated ops (reference: symbol/{contrib,
+# linalg,random}.py) — imported AFTER populate so they can bind ops
+from . import contrib          # noqa: E402,F401
+from . import linalg           # noqa: E402,F401
+from . import random           # noqa: E402,F401
+
 
 def zeros(shape, dtype="float32", name=None):
     from . import _zeros

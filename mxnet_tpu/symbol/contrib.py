@@ -1,0 +1,8 @@
+"""sym.contrib namespace (reference: python/mxnet/symbol/contrib.py) —
+the ``_contrib_*`` ops under their public names, mirroring nd.contrib.
+"""
+from __future__ import annotations
+
+from .register import populate_prefixed
+
+__all__ = populate_prefixed(__name__, "_contrib_")

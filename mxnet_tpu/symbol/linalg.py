@@ -1,0 +1,7 @@
+"""sym.linalg namespace (reference: python/mxnet/symbol/linalg.py —
+wrappers over the _linalg_* ops), mirroring nd.linalg."""
+from __future__ import annotations
+
+from .register import populate_prefixed
+
+__all__ = populate_prefixed(__name__, "_linalg_")

@@ -1,0 +1,73 @@
+"""sym.random namespace (reference: python/mxnet/symbol/random.py) —
+sampler symbols whose PRNG keys the executor threads per step,
+mirroring nd.random."""
+from __future__ import annotations
+
+from ..base import np_dtype
+
+__all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
+           "randint", "negative_binomial", "multinomial"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _sample(opname, attrs, name):
+    import mxnet_tpu.symbol as S      # generated op functions
+    return getattr(S, opname)(name=name, **attrs)
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", name=None):
+    return _sample("_random_uniform",
+                   {"low": low, "high": high, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", name=None):
+    return _sample("_random_normal",
+                   {"loc": loc, "scale": scale, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", name=None):
+    return _sample("_random_gamma",
+                   {"alpha": alpha, "beta": beta, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def exponential(scale=1.0, shape=(), dtype="float32", name=None):
+    # the op takes the RATE lam (reference op convention); the frontend
+    # exposes the SCALE, as nd.random.exponential does
+    return _sample("_random_exponential",
+                   {"lam": 1.0 / scale, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def poisson(lam=1.0, shape=(), dtype="float32", name=None):
+    return _sample("_random_poisson",
+                   {"lam": lam, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def randint(low, high, shape=(), dtype="int32", name=None):
+    return _sample("_random_randint",
+                   {"low": low, "high": high, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype="float32", name=None):
+    return _sample("_random_negative_binomial",
+                   {"k": k, "p": p, "shape": _shape(shape),
+                    "dtype": np_dtype(dtype).name}, name)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", name=None):
+    import mxnet_tpu.symbol as S
+    return S._sample_multinomial(data, shape=_shape(shape),
+                                 get_prob=get_prob,
+                                 dtype=np_dtype(dtype).name, name=name)

@@ -46,3 +46,19 @@ def populate(target_module_name, internal_module_name=None):
             setattr(target, name, fn)
         else:
             setattr(target, name, fn)
+
+
+def populate_prefixed(target_module_name, prefix):
+    """Bind every registered op named ``prefix + X`` onto the target
+    module as ``X`` (the sym.contrib / sym.linalg namespace pattern).
+    Returns the public names bound."""
+    target = sys.modules[target_module_name]
+    names = []
+    for name in _reg.list_ops():
+        if name.startswith(prefix):
+            pub = name[len(prefix):]
+            fn = make_op_func(_reg.get_op(name))
+            fn.__name__ = pub
+            setattr(target, pub, fn)
+            names.append(pub)
+    return names

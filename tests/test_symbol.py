@@ -170,3 +170,32 @@ def test_inception_bn_symbol_builds_and_runs():
     out = exe.forward(is_train=False)[0].asnumpy()
     assert np.isfinite(out).all()
     np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
+
+
+def test_symbol_sub_namespaces():
+    """sym.contrib / sym.linalg / sym.random mirror the nd namespaces
+    (reference: python/mxnet/symbol/{contrib,linalg,random}.py)."""
+    import mxnet_tpu.symbol as S
+    # contrib exposes every _contrib_ op under its public name
+    for n in ("ROIAlign", "box_nms", "MultiBoxPrior", "CTCLoss",
+              "flash_attention", "BilinearResize2D"):
+        assert callable(getattr(S.contrib, n)), n
+    for n in ("gemm2", "potrf", "trsm", "syrk", "inverse", "slogdet"):
+        assert callable(getattr(S.linalg, n)), n
+
+    # linalg numeric check through the executor
+    A = mx.sym.var("A")
+    out = S.linalg.potrf(A)
+    exe = out.simple_bind(A=(1, 3, 3))
+    m = np.array([[[4., 2, 0], [2, 5, 1], [0, 1, 6]]], np.float32)
+    exe.arg_dict["A"][:] = mx.nd.array(m)
+    L = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(L @ np.swapaxes(L, 1, 2), m, rtol=1e-4,
+                               atol=1e-4)
+
+    # random symbols draw fresh values per executor step
+    r = S.random.normal(0, 1, shape=(64,))
+    exe2 = r.simple_bind()
+    a = exe2.forward(is_train=True)[0].asnumpy().copy()
+    b = exe2.forward(is_train=True)[0].asnumpy().copy()
+    assert not np.allclose(a, b)
