@@ -3,6 +3,312 @@ the ``_contrib_*`` ops under their public names, mirroring nd.contrib.
 """
 from __future__ import annotations
 
-from .register import populate_prefixed
+from .register import populate_prefixed, prefixed_getattr
 
 __all__ = populate_prefixed(__name__, "_contrib_")
+__getattr__ = prefixed_getattr("_contrib_")
+
+
+# ---------------------------------------------------------------------------
+# symbolic control flow (reference: python/mxnet/symbol/contrib.py:215+
+# foreach / while_loop / cond over nnvm subgraphs; src/operator/
+# control_flow.cc). TPU-native lowering: the traced body is serialized
+# into the node's attrs and evaluated under lax.scan / lax.cond at
+# graph-eval time — one compiled step reused across iterations.
+# ---------------------------------------------------------------------------
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _group(outs):
+    from .symbol import Group
+    return Group(outs)
+
+
+# unique per-trace placeholder prefix: nested control flow must never
+# reuse an enclosing trace's bound names, or the inner free-input scan
+# would silently capture the wrong variable
+_trace_counter = [0]
+
+
+def _fresh_prefix(kind):
+    _trace_counter[0] += 1
+    return "_cf%d_%s_" % (_trace_counter[0], kind)
+
+
+def _free_inputs(sub, bound_names):
+    """The subgraph's unbound variables, as symbols wrapping the SAME
+    var nodes the body closed over — rebuilding fresh vars by name
+    would duplicate arguments shared with the enclosing graph (the
+    executor rejects duplicate argument names on backward)."""
+    from .symbol import Symbol
+    from .symbol import _topo
+    frees, syms, seen = [], [], set()
+    for node in _topo(sub._entries):
+        if node.is_var and node.name not in bound_names \
+                and node.name not in seen:
+            seen.add(node.name)
+            frees.append(node.name)
+            syms.append(Symbol([(node, 0)]))
+    return frees, syms
+
+
+def _register_cf_ops():
+    from ..ops.registry import register, get_op
+
+    try:
+        get_op("_sym_foreach")
+        return
+    except Exception:
+        pass
+
+    def _foreach_fn(key, data, *rest, graph_json=None, data_name="",
+                    state_names=(), free_names=(), n_outputs=1,
+                    train_mode=False, **_ig):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from .symbol import load_json
+        from .symbol import _graph_eval_fn
+        n_states = len(state_names)
+        states = rest[:n_states]
+        frees = dict(zip(free_names, rest[n_states:]))
+        fn = _graph_eval_fn(load_json(graph_json),
+                            is_train=bool(train_mode))
+
+        def step(carry, xt):
+            st, i = carry
+            env = {data_name: xt}
+            env.update(zip(state_names, st))
+            env.update(frees)
+            k = None if key is None else jax.random.fold_in(key, i)
+            outs, _aux = fn(env, k)
+            return ((tuple(outs[n_outputs:]), i + 1),
+                    tuple(outs[:n_outputs]))
+
+        (final_states, _), ys = lax.scan(
+            step, (tuple(states), jnp.int32(0)), data)
+        result = tuple(ys) + tuple(final_states)
+        return result if len(result) > 1 else result[0]
+
+    register("_sym_foreach", needs_rng=True,
+             num_outputs=lambda a: (int(a.get("n_outputs", 1)) +
+                                    len(a.get("state_names", ()))),
+             attr_defaults={"graph_json": None, "data_name": "",
+                            "state_names": (), "free_names": (),
+                            "n_outputs": 1, "train_mode": False})(
+                 _foreach_fn)
+
+    def _while_fn(key, *rest, cond_json=None, body_json=None,
+                  state_names=(), cond_free_names=(), body_free_names=(),
+                  n_outputs=1, max_iterations=0, train_mode=False, **_ig):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from .symbol import load_json, _graph_eval_fn
+        n_states = len(state_names)
+        states = tuple(rest[:n_states])
+        frees = rest[n_states:]
+        cf = dict(zip(cond_free_names, frees[:len(cond_free_names)]))
+        bf = dict(zip(body_free_names, frees[len(cond_free_names):]))
+        cond_fn = _graph_eval_fn(load_json(cond_json), is_train=False)
+        body_fn = _graph_eval_fn(load_json(body_json),
+                                 is_train=bool(train_mode))
+
+        def pred(st):
+            env = dict(zip(state_names, st))
+            env.update(cf)
+            (p,), _ = cond_fn(env, None)
+            return p.reshape(()).astype(bool)
+
+        def step(carry, i):
+            st, active = carry
+            env = dict(zip(state_names, st))
+            env.update(bf)
+            k = None if key is None else jax.random.fold_in(key, i)
+            outs, _aux = body_fn(env, k)
+            new_st = tuple(
+                jnp.where(active, n, o) for n, o in
+                zip(outs[n_outputs:], st))
+            ys = tuple(jnp.where(active, o, jnp.zeros_like(o))
+                       for o in outs[:n_outputs])
+            nxt_active = jnp.logical_and(active, pred(new_st))
+            return (new_st, nxt_active), ys
+
+        active0 = pred(states)
+        (final, _a), ys = lax.scan(
+            step, (states, active0),
+            jnp.arange(int(max_iterations)))
+        result = tuple(ys) + tuple(final)
+        return result if len(result) > 1 else result[0]
+
+    register("_sym_while_loop", needs_rng=True,
+             num_outputs=lambda a: (int(a.get("n_outputs", 1)) +
+                                    len(a.get("state_names", ()))),
+             attr_defaults={"cond_json": None, "body_json": None,
+                            "state_names": (), "cond_free_names": (),
+                            "body_free_names": (), "n_outputs": 1,
+                            "max_iterations": 0, "train_mode": False})(
+                 _while_fn)
+
+    def _cond_fn(key, *rest, pred_json=None, then_json=None,
+                 else_json=None, input_names=(), pred_free_names=(),
+                 then_free_names=(), else_free_names=(), n_outputs=1,
+                 train_mode=False, **_ig):
+        import jax
+        from jax import lax
+        from .symbol import load_json, _graph_eval_fn
+        n_in = len(input_names)
+        ins = dict(zip(input_names, rest[:n_in]))
+        frees = rest[n_in:]
+        np_, nt = len(pred_free_names), len(then_free_names)
+        pf = dict(zip(pred_free_names, frees[:np_]))
+        tf = dict(zip(then_free_names, frees[np_:np_ + nt]))
+        ef = dict(zip(else_free_names, frees[np_ + nt:]))
+        pred_fn = _graph_eval_fn(load_json(pred_json), is_train=False)
+        then_fn = _graph_eval_fn(load_json(then_json),
+                                 is_train=bool(train_mode))
+        else_fn = _graph_eval_fn(load_json(else_json),
+                                 is_train=bool(train_mode))
+        env_p = dict(ins)
+        env_p.update(pf)
+        (p,), _ = pred_fn(env_p, None)
+
+        def _then(_):
+            env = dict(ins)
+            env.update(tf)
+            outs, _aux = then_fn(env, key)
+            return tuple(outs)
+
+        def _else(_):
+            env = dict(ins)
+            env.update(ef)
+            outs, _aux = else_fn(env, key)
+            return tuple(outs)
+
+        result = lax.cond(p.reshape(()).astype(bool), _then, _else,
+                          operand=None)
+        return result if len(result) > 1 else result[0]
+
+    register("_sym_cond", needs_rng=True,
+             num_outputs=lambda a: int(a.get("n_outputs", 1)),
+             attr_defaults={"pred_json": None, "then_json": None,
+                            "else_json": None, "input_names": (),
+                            "pred_free_names": (), "then_free_names": (),
+                            "else_free_names": (), "n_outputs": 1,
+                            "train_mode": False})(
+                 _cond_fn)
+
+
+_register_cf_ops()
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body(data_t, states) -> (outputs, new_states)`` over axis
+    0 of ``data`` symbolically (reference: symbol/contrib.py:215).
+    Returns (outputs, final_states): outputs stacked on axis 0."""
+    from .symbol import var as _var
+    from .register import make_op_func
+    from ..ops.registry import get_op
+    pre = _fresh_prefix("foreach")
+    states, states_list = _as_list(init_states)
+    dvar = _var(pre + "data")
+    svars = [_var(pre + "state%d" % i) for i in range(len(states))]
+    outs, new_states = body(dvar, svars if states_list else svars[0])
+    outs, outs_list = _as_list(outs)
+    new_states, _ = _as_list(new_states)
+    assert len(new_states) == len(states), \
+        "body must return as many states as it was given"
+    sub = _group(outs + new_states)
+    bound = [pre + "data"] + [pre + "state%d" % i
+                              for i in range(len(states))]
+    free_names, free_syms = _free_inputs(sub, set(bound))
+    node = make_op_func(get_op("_sym_foreach"))(
+        data, *states, *free_syms, name=name,
+        graph_json=sub.tojson(), data_name=bound[0],
+        state_names=tuple(bound[1:]), free_names=tuple(free_names),
+        n_outputs=len(outs))
+    outputs = [node[i] for i in range(len(outs))]
+    finals = [node[len(outs) + i] for i in range(len(states))]
+    return (outputs if outs_list else outputs[0],
+            finals if states_list else finals[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations, name="while_loop"):
+    """``while cond(states): outputs, states = func(states)`` with a
+    static iteration bound (reference: symbol/contrib.py while_loop).
+    Outputs are padded with zeros past termination; lowers to a masked
+    lax.scan so the loop stays differentiable."""
+    from .symbol import var as _var
+    from .register import make_op_func
+    from ..ops.registry import get_op
+    pre = _fresh_prefix("while")
+    states, states_list = _as_list(loop_vars)
+    svars = [_var(pre + "state%d" % i) for i in range(len(states))]
+    packed = svars if states_list else svars[0]
+    pred = cond(packed)
+    outs, new_states = func(packed)
+    outs, outs_list = _as_list(outs)
+    new_states, _ = _as_list(new_states)
+    assert len(new_states) == len(states)
+    bound = set(pre + "state%d" % i for i in range(len(states)))
+    csub = _group([pred])
+    bsub = _group(outs + new_states)
+    c_free, c_syms = _free_inputs(csub, bound)
+    b_free, b_syms = _free_inputs(bsub, bound)
+    node = make_op_func(get_op("_sym_while_loop"))(
+        *states, *c_syms, *b_syms, name=name,
+        cond_json=csub.tojson(), body_json=bsub.tojson(),
+        state_names=tuple(pre + "state%d" % i
+                          for i in range(len(states))),
+        cond_free_names=tuple(c_free), body_free_names=tuple(b_free),
+        n_outputs=len(outs), max_iterations=int(max_iterations))
+    outputs = [node[i] for i in range(len(outs))]
+    finals = [node[len(outs) + i] for i in range(len(states))]
+    return (outputs if outs_list else outputs[0],
+            finals if states_list else finals[0])
+
+
+def cond(pred, then_func, else_func, inputs=None, name="cond"):
+    """Symbolic if/else (reference: symbol/contrib.py cond). ``pred``,
+    ``then_func``, ``else_func`` are nullary callables over closed-over
+    symbols (or over ``inputs`` symbols when given); both branches must
+    produce matching shapes."""
+    from .symbol import var as _var
+    from .register import make_op_func
+    from ..ops.registry import get_op
+    pre = _fresh_prefix("cond")
+    inputs, _ = _as_list(inputs if inputs is not None else [])
+    in_names = [pre + "in%d" % i for i in range(len(inputs))]
+    in_vars = [_var(n) for n in in_names]
+
+    def run(f):
+        out = f(*in_vars) if inputs else f()
+        return _as_list(out)
+
+    p_outs, _ = run(pred)
+    t_outs, t_list = run(then_func)
+    e_outs, _ = run(else_func)
+    assert len(t_outs) == len(e_outs), \
+        "then/else branches must produce the same number of outputs"
+    bound = set(in_names)
+    psub = _group(p_outs)
+    tsub = _group(t_outs)
+    esub = _group(e_outs)
+    p_free, p_syms = _free_inputs(psub, bound)
+    t_free, t_syms = _free_inputs(tsub, bound)
+    e_free, e_syms = _free_inputs(esub, bound)
+    node = make_op_func(get_op("_sym_cond"))(
+        *inputs, *p_syms, *t_syms, *e_syms, name=name,
+        pred_json=psub.tojson(), then_json=tsub.tojson(),
+        else_json=esub.tojson(), input_names=tuple(in_names),
+        pred_free_names=tuple(p_free), then_free_names=tuple(t_free),
+        else_free_names=tuple(e_free), n_outputs=len(t_outs))
+    outs = [node[i] for i in range(len(t_outs))]
+    return outs if t_list else outs[0]
+
+
+__all__ = list(__all__) + ["foreach", "while_loop", "cond"]

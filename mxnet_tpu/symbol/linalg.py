@@ -2,6 +2,7 @@
 wrappers over the _linalg_* ops), mirroring nd.linalg."""
 from __future__ import annotations
 
-from .register import populate_prefixed
+from .register import populate_prefixed, prefixed_getattr
 
 __all__ = populate_prefixed(__name__, "_linalg_")
+__getattr__ = prefixed_getattr("_linalg_")

@@ -62,3 +62,17 @@ def populate_prefixed(target_module_name, prefix):
             setattr(target, pub, fn)
             names.append(pub)
     return names
+
+
+def prefixed_getattr(prefix):
+    """A PEP 562 module __getattr__ resolving ops registered AFTER the
+    namespace module was imported (mirrors nd.contrib's late binding)."""
+    def _getattr(name):
+        try:
+            op = _reg.get_op(prefix + name)
+        except Exception:
+            raise AttributeError(name) from None
+        fn = make_op_func(op)
+        fn.__name__ = name
+        return fn
+    return _getattr

@@ -467,6 +467,21 @@ class Symbol(object):
     def __neg__(self):
         return _apply_op(_reg.get_op("negative"), (self,), {}, None)
 
+    # comparisons build graph nodes (reference: symbol.py __gt__ etc.;
+    # __eq__/__ne__ stay identity — symbols live in dicts/sets)
+    def __gt__(self, other):
+        return self._binop(other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "_greater_equal",
+                           "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "_lesser_equal", "_lesser_equal_scalar")
+
     def reshape(self, shape, **kw):
         return _apply_op(_reg.get_op("Reshape"), (self,),
                          {"shape": tuple(shape), **kw}, None)

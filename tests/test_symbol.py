@@ -199,3 +199,125 @@ def test_symbol_sub_namespaces():
     a = exe2.forward(is_train=True)[0].asnumpy().copy()
     b = exe2.forward(is_train=True)[0].asnumpy().copy()
     assert not np.allclose(a, b)
+
+
+def test_symbolic_control_flow():
+    """sym.contrib.foreach / while_loop / cond build subgraph nodes and
+    lower to lax.scan / masked-scan / lax.cond at eval (reference:
+    symbol/contrib.py:215+, src/operator/control_flow.cc)."""
+    import mxnet_tpu.symbol as S
+    rng = np.random.RandomState(0)
+
+    # foreach: cumulative x_t @ w, outputs stacked on axis 0
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+
+    def body(x_t, state):
+        h = mx.sym.dot(x_t, w) + state
+        return h, h
+
+    outs, final = S.contrib.foreach(body, data, mx.sym.var("s0"))
+    exe = outs.simple_bind(data=(4, 2, 3), w=(3, 3), s0=(2, 3))
+    d = rng.randn(4, 2, 3).astype(np.float32)
+    wv = rng.randn(3, 3).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(d)
+    exe.arg_dict["w"][:] = mx.nd.array(wv)
+    exe.arg_dict["s0"][:] = mx.nd.zeros((2, 3))
+    got = exe.forward(is_train=True)[0].asnumpy()
+    ref, st = [], np.zeros((2, 3), np.float32)
+    for t in range(4):
+        st = d[t] @ wv + st
+        ref.append(st)
+    np.testing.assert_allclose(got, np.stack(ref), rtol=1e-5, atol=1e-5)
+
+    # differentiable: grad of sum(outputs) w.r.t. w matches numeric
+    exe.backward(mx.nd.ones((4, 2, 3)))
+    gw = exe.grad_dict["w"].asnumpy()
+    # d(sum_t sum(cumsum_t(d@w))) / dw = sum_t (T - t) * d[t]^T @ 1
+    ref_g = np.zeros((3, 3), np.float32)
+    for t in range(4):
+        ref_g += (4 - t) * d[t].T @ np.ones((2, 3), np.float32)
+    np.testing.assert_allclose(gw, ref_g, rtol=1e-4, atol=1e-4)
+
+    # cond: branch picked by a traced predicate
+    x = mx.sym.var("x")
+    out = S.contrib.cond(lambda: mx.sym.sum(x) > 0,
+                         lambda: x * 2.0, lambda: x - 1.0)
+    exe2 = out.simple_bind(x=(3,))
+    exe2.arg_dict["x"][:] = mx.nd.array(np.array([1., 2, 3], np.float32))
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(), [2, 4, 6])
+    exe2.arg_dict["x"][:] = mx.nd.array(np.array([-1, -2, -3], np.float32))
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(), [-2, -3, -4])
+
+    # while_loop: doubling until the sum reaches 100 (bounded, masked)
+    s = mx.sym.var("s")
+    _outs, fin = S.contrib.while_loop(
+        lambda st: mx.sym.sum(st) < 100.0,
+        lambda st: (st, st * 2.0), s, max_iterations=10)
+    exe3 = fin.simple_bind(s=(2,))
+    exe3.arg_dict["s"][:] = mx.nd.array(np.array([1., 1.], np.float32))
+    np.testing.assert_allclose(exe3.forward()[0].asnumpy(), [64, 64])
+
+
+def test_symbol_comparison_operators():
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    for op, ref in ((x > y, np.greater), (x >= y, np.greater_equal),
+                    (x < y, np.less), (x <= y, np.less_equal),
+                    (x > 1.5, None)):
+        a = np.array([1., 2, 2, 3], np.float32)
+        b = np.array([2., 2, 1, 1], np.float32)
+        if ref is not None:
+            exe = op.simple_bind(x=(4,), y=(4,))
+            exe.arg_dict["y"][:] = mx.nd.array(b)
+        else:
+            exe = op.simple_bind(x=(4,))
+        exe.arg_dict["x"][:] = mx.nd.array(a)
+        got = exe.forward()[0].asnumpy()
+        if ref is not None:
+            np.testing.assert_array_equal(got, ref(a, b).astype(np.float32))
+        else:
+            np.testing.assert_array_equal(got, (a > 1.5).astype(np.float32))
+
+
+def test_symbolic_control_flow_nesting_and_shared_vars():
+    """Regressions: (a) nested foreach must capture the OUTER trace's
+    state (placeholder names are unique per trace); (b) a free variable
+    used both inside and outside the loop must appear once in
+    list_arguments and survive backward."""
+    import mxnet_tpu.symbol as S
+    data = mx.sym.var("d")
+    inner_data = mx.sym.var("d2")
+
+    def outer_body(x, s):
+        def inner_body(x2, s2):
+            return x2 + s, s2          # closes over OUTER state
+        inner_outs, _ = S.contrib.foreach(inner_body, inner_data,
+                                          mx.sym.var("z0"))
+        total = mx.sym.sum(inner_outs, axis=0) + x + s
+        return total, total
+
+    outs, _fin = S.contrib.foreach(outer_body, data, mx.sym.var("s0"))
+    exe = outs.simple_bind(d=(2, 2), d2=(3, 2), z0=(2,), s0=(2,))
+    exe.arg_dict["d"][:] = mx.nd.zeros((2, 2))
+    exe.arg_dict["d2"][:] = mx.nd.zeros((3, 2))
+    exe.arg_dict["z0"][:] = mx.nd.zeros((2,))
+    exe.arg_dict["s0"][:] = mx.nd.array(np.array([10., 10], np.float32))
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(),
+                               [[40, 40], [160, 160]])
+
+    w = mx.sym.var("w")
+    d3 = mx.sym.var("d3")
+    outs2, _ = S.contrib.foreach(
+        lambda x, s: ((mx.sym.dot(x, w) + s,) * 2), d3, mx.sym.var("s1"))
+    t = mx.sym.sum(outs2) + mx.sym.sum(w)
+    assert t.list_arguments().count("w") == 1
+    exe2 = t.simple_bind(d3=(4, 2, 3), w=(3, 3), s1=(2, 3))
+    rng = np.random.RandomState(0)
+    exe2.arg_dict["d3"][:] = mx.nd.array(
+        rng.randn(4, 2, 3).astype(np.float32))
+    exe2.arg_dict["w"][:] = mx.nd.array(rng.randn(3, 3).astype(np.float32))
+    exe2.arg_dict["s1"][:] = mx.nd.zeros((2, 3))
+    exe2.forward(is_train=True)
+    exe2.backward(mx.nd.ones(()))
+    assert np.isfinite(exe2.grad_dict["w"].asnumpy()).all()
