@@ -1,0 +1,2 @@
+"""Contrib datasets/samplers (reference: gluon/contrib/data/)."""
+from .sampler import IntervalSampler

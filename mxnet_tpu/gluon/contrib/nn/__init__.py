@@ -1,0 +1,3 @@
+"""Contrib neural-network layers (reference: gluon/contrib/nn/)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm)

@@ -1,0 +1,114 @@
+"""Contrib layers (reference: gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...nn.basic_layers import (Sequential, HybridSequential, Embedding,
+                                BatchNorm)
+from ...block import Block, HybridBlock
+
+
+def _init(v):
+    from ....initializer import create as _create
+    return _create(v) if isinstance(v, str) else v
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Feed the input to every child and concatenate the outputs
+    (reference: contrib/nn/basic_layers.py:29)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(Concurrent, self).__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference: contrib/nn/basic_layers.py:62)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(HybridConcurrent, self).__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, for skip branches inside Concurrent
+    (reference: contrib/nn/basic_layers.py:95)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose weight gradient is ROW-SPARSE over the ids in
+    the batch (reference: contrib/nn/basic_layers.py:116): O(batch)
+    optimizer work per step via the lazy-update kernels instead of
+    O(vocab). A Block (not hybridizable), as in the reference — the
+    sparse cotangent rides the eager tape."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super(SparseEmbedding, self).__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=_init(weight_initializer),
+                grad_stype="row_sparse")
+
+    def forward(self, x):
+        from ....ndarray import sparse as nd_sparse
+        return nd_sparse.embedding(x, self.weight.data())
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._input_dim,
+                                              self._output_dim)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: contrib/nn/basic_layers.py:163
+    over src/operator/contrib/sync_batch_norm-inl.h). Under the GSPMD
+    data-parallel paths the batch axis is one logical axis so plain
+    batch moments already reduce globally; under explicit shard_map
+    pass the mapped axis via ``axis_name``."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name="",
+                 **kwargs):
+        super(SyncBatchNorm, self).__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._kwargs.update(ndev=num_devices or 1, key=self.name,
+                            axis_name=axis_name)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from .... import autograd
+        if autograd.is_training() and not self._kwargs["use_global_stats"]:
+            out, mean, var = F.SyncBatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                output_mean_var=True, **self._kwargs)
+            mom = self._kwargs["momentum"]
+            self.running_mean.set_data(running_mean * mom + mean * (1 - mom))
+            self.running_var.set_data(running_var * mom + var * (1 - mom))
+            return out
+        return F.SyncBatchNorm(x, gamma, beta, running_mean, running_var,
+                               **self._kwargs)

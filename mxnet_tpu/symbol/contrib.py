@@ -39,21 +39,62 @@ def _fresh_prefix(kind):
     return "_cf%d_%s_" % (_trace_counter[0], kind)
 
 
-def _free_inputs(sub, bound_names):
-    """The subgraph's unbound variables, as symbols wrapping the SAME
-    var nodes the body closed over — rebuilding fresh vars by name
-    would duplicate arguments shared with the enclosing graph (the
-    executor rejects duplicate argument names on backward)."""
-    from .symbol import Symbol
-    from .symbol import _topo
-    frees, syms, seen = [], [], set()
+def _trace_mark():
+    from .symbol import _node_serial
+    return _node_serial[0]
+
+
+def _extract_body(out_syms, bound_names, mark, pre):
+    """Close a traced body into a standalone subgraph.
+
+    Nodes created BEFORE the trace (serial <= mark) are closed-over
+    OUTER computations: each such entry is cut into a placeholder
+    variable and the original symbol becomes an extra input — computed
+    ONCE in the enclosing graph (the reference wires captured outputs
+    as subgraph data inputs the same way; re-inlining would re-execute
+    them per iteration and fork their RNG). Free VARIABLES keep their
+    identity (shared with the enclosing graph). Returns
+    (sub, free_names, free_syms, aux_names): free/aux names in the
+    order the op will receive them as inputs."""
+    from .symbol import Group, Symbol, _Node, _topo
+    cut = {}          # (id(node), oi) -> (placeholder_node, 0)
+    cloned = {}       # id(node) -> cloned _Node
+    captures = []     # (name, Symbol of the outer entry)
+
+    def walk(src, oi):
+        if src.is_var:
+            return (src, oi)
+        if src.serial <= mark:
+            key = (id(src), oi)
+            if key not in cut:
+                nm = "%scap%d" % (pre, len(captures))
+                cut[key] = (_Node(None, nm), 0)
+                captures.append((nm, Symbol([(src, oi)])))
+            return cut[key]
+        if id(src) not in cloned:
+            new_inputs = [walk(s, o) for (s, o) in src.inputs]
+            cloned[id(src)] = _Node(src.op, src.name, src.attrs,
+                                    new_inputs, src.is_aux, src.in_names)
+        return (cloned[id(src)], oi)
+
+    entries = []
+    for s in out_syms:
+        assert len(s._entries) == 1
+        entries.append(walk(*s._entries[0]))
+    sub = Group([Symbol([e]) for e in entries])
+
+    cap_map = dict(captures)
+    frees, syms, aux_names, seen = [], [], [], set()
     for node in _topo(sub._entries):
         if node.is_var and node.name not in bound_names \
                 and node.name not in seen:
             seen.add(node.name)
             frees.append(node.name)
-            syms.append(Symbol([(node, 0)]))
-    return frees, syms
+            cap = cap_map.get(node.name)
+            syms.append(Symbol([(node, 0)]) if cap is None else cap)
+            if node.is_aux:
+                aux_names.append(node.name)
+    return sub, frees, syms, tuple(aux_names)
 
 
 def _register_cf_ops():
@@ -66,8 +107,8 @@ def _register_cf_ops():
         pass
 
     def _foreach_fn(key, data, *rest, graph_json=None, data_name="",
-                    state_names=(), free_names=(), n_outputs=1,
-                    train_mode=False, **_ig):
+                    state_names=(), free_names=(), aux_names=(),
+                    n_outputs=1, train_mode=False, **_ig):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -78,33 +119,40 @@ def _register_cf_ops():
         frees = dict(zip(free_names, rest[n_states:]))
         fn = _graph_eval_fn(load_json(graph_json),
                             is_train=bool(train_mode))
+        aux0 = tuple(frees[n] for n in aux_names)
 
         def step(carry, xt):
-            st, i = carry
-            env = {data_name: xt}
+            st, aux, i = carry
+            env = dict(frees)
+            env[data_name] = xt
             env.update(zip(state_names, st))
-            env.update(frees)
+            env.update(zip(aux_names, aux))   # carried stats win
             k = None if key is None else jax.random.fold_in(key, i)
-            outs, _aux = fn(env, k)
-            return ((tuple(outs[n_outputs:]), i + 1),
+            outs, new_aux = fn(env, k)
+            aux_next = tuple(new_aux.get(n, a)
+                             for n, a in zip(aux_names, aux))
+            return ((tuple(outs[n_outputs:]), aux_next, i + 1),
                     tuple(outs[:n_outputs]))
 
-        (final_states, _), ys = lax.scan(
-            step, (tuple(states), jnp.int32(0)), data)
-        result = tuple(ys) + tuple(final_states)
+        (final_states, final_aux, _), ys = lax.scan(
+            step, (tuple(states), aux0, jnp.int32(0)), data)
+        result = tuple(ys) + tuple(final_states) + tuple(final_aux)
         return result if len(result) > 1 else result[0]
 
     register("_sym_foreach", needs_rng=True,
              num_outputs=lambda a: (int(a.get("n_outputs", 1)) +
-                                    len(a.get("state_names", ()))),
+                                    len(a.get("state_names", ())) +
+                                    len(a.get("aux_names", ()))),
              attr_defaults={"graph_json": None, "data_name": "",
                             "state_names": (), "free_names": (),
-                            "n_outputs": 1, "train_mode": False})(
+                            "aux_names": (), "n_outputs": 1,
+                            "train_mode": False})(
                  _foreach_fn)
 
     def _while_fn(key, *rest, cond_json=None, body_json=None,
                   state_names=(), cond_free_names=(), body_free_names=(),
-                  n_outputs=1, max_iterations=0, train_mode=False, **_ig):
+                  aux_names=(), n_outputs=1, max_iterations=0,
+                  train_mode=False, **_ig):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -117,6 +165,7 @@ def _register_cf_ops():
         cond_fn = _graph_eval_fn(load_json(cond_json), is_train=False)
         body_fn = _graph_eval_fn(load_json(body_json),
                                  is_train=bool(train_mode))
+        aux0 = tuple(bf[n] for n in aux_names)
 
         def pred(st):
             env = dict(zip(state_names, st))
@@ -125,39 +174,45 @@ def _register_cf_ops():
             return p.reshape(()).astype(bool)
 
         def step(carry, i):
-            st, active = carry
-            env = dict(zip(state_names, st))
-            env.update(bf)
+            st, aux, active = carry
+            env = dict(bf)
+            env.update(zip(state_names, st))
+            env.update(zip(aux_names, aux))
             k = None if key is None else jax.random.fold_in(key, i)
-            outs, _aux = body_fn(env, k)
+            outs, new_aux = body_fn(env, k)
             new_st = tuple(
                 jnp.where(active, n, o) for n, o in
                 zip(outs[n_outputs:], st))
+            aux_next = tuple(
+                jnp.where(active, new_aux.get(n, a), a)
+                for n, a in zip(aux_names, aux))
             ys = tuple(jnp.where(active, o, jnp.zeros_like(o))
                        for o in outs[:n_outputs])
             nxt_active = jnp.logical_and(active, pred(new_st))
-            return (new_st, nxt_active), ys
+            return (new_st, aux_next, nxt_active), ys
 
         active0 = pred(states)
-        (final, _a), ys = lax.scan(
-            step, (states, active0),
+        (final, final_aux, _a), ys = lax.scan(
+            step, (states, aux0, active0),
             jnp.arange(int(max_iterations)))
-        result = tuple(ys) + tuple(final)
+        result = tuple(ys) + tuple(final) + tuple(final_aux)
         return result if len(result) > 1 else result[0]
 
     register("_sym_while_loop", needs_rng=True,
              num_outputs=lambda a: (int(a.get("n_outputs", 1)) +
-                                    len(a.get("state_names", ()))),
+                                    len(a.get("state_names", ())) +
+                                    len(a.get("aux_names", ()))),
              attr_defaults={"cond_json": None, "body_json": None,
                             "state_names": (), "cond_free_names": (),
-                            "body_free_names": (), "n_outputs": 1,
-                            "max_iterations": 0, "train_mode": False})(
+                            "body_free_names": (), "aux_names": (),
+                            "n_outputs": 1, "max_iterations": 0,
+                            "train_mode": False})(
                  _while_fn)
 
     def _cond_fn(key, *rest, pred_json=None, then_json=None,
                  else_json=None, input_names=(), pred_free_names=(),
-                 then_free_names=(), else_free_names=(), n_outputs=1,
-                 train_mode=False, **_ig):
+                 then_free_names=(), else_free_names=(), aux_names=(),
+                 n_outputs=1, train_mode=False, **_ig):
         import jax
         from jax import lax
         from .symbol import load_json, _graph_eval_fn
@@ -176,30 +231,34 @@ def _register_cf_ops():
         env_p = dict(ins)
         env_p.update(pf)
         (p,), _ = pred_fn(env_p, None)
+        aux_env = dict(tf)
+        aux_env.update(ef)
+        aux0 = tuple(aux_env[n] for n in aux_names)
 
-        def _then(_):
-            env = dict(ins)
-            env.update(tf)
-            outs, _aux = then_fn(env, key)
-            return tuple(outs)
+        def _branch(fn, branch_frees):
+            def run(_):
+                env = dict(ins)
+                env.update(branch_frees)
+                outs, new_aux = fn(env, key)
+                # untaken-branch aux stays put; the taken branch's
+                # updates win
+                return tuple(outs) + tuple(
+                    new_aux.get(n, a) for n, a in zip(aux_names, aux0))
+            return run
 
-        def _else(_):
-            env = dict(ins)
-            env.update(ef)
-            outs, _aux = else_fn(env, key)
-            return tuple(outs)
-
-        result = lax.cond(p.reshape(()).astype(bool), _then, _else,
+        result = lax.cond(p.reshape(()).astype(bool),
+                          _branch(then_fn, tf), _branch(else_fn, ef),
                           operand=None)
         return result if len(result) > 1 else result[0]
 
     register("_sym_cond", needs_rng=True,
-             num_outputs=lambda a: int(a.get("n_outputs", 1)),
+             num_outputs=lambda a: (int(a.get("n_outputs", 1)) +
+                                    len(a.get("aux_names", ()))),
              attr_defaults={"pred_json": None, "then_json": None,
                             "else_json": None, "input_names": (),
                             "pred_free_names": (), "then_free_names": (),
-                            "else_free_names": (), "n_outputs": 1,
-                            "train_mode": False})(
+                            "else_free_names": (), "aux_names": (),
+                            "n_outputs": 1, "train_mode": False})(
                  _cond_fn)
 
 
@@ -215,6 +274,7 @@ def foreach(body, data, init_states, name="foreach"):
     from ..ops.registry import get_op
     pre = _fresh_prefix("foreach")
     states, states_list = _as_list(init_states)
+    mark = _trace_mark()
     dvar = _var(pre + "data")
     svars = [_var(pre + "state%d" % i) for i in range(len(states))]
     outs, new_states = body(dvar, svars if states_list else svars[0])
@@ -222,15 +282,15 @@ def foreach(body, data, init_states, name="foreach"):
     new_states, _ = _as_list(new_states)
     assert len(new_states) == len(states), \
         "body must return as many states as it was given"
-    sub = _group(outs + new_states)
     bound = [pre + "data"] + [pre + "state%d" % i
                               for i in range(len(states))]
-    free_names, free_syms = _free_inputs(sub, set(bound))
+    sub, free_names, free_syms, aux_names = _extract_body(
+        outs + new_states, set(bound), mark, pre)
     node = make_op_func(get_op("_sym_foreach"))(
         data, *states, *free_syms, name=name,
         graph_json=sub.tojson(), data_name=bound[0],
         state_names=tuple(bound[1:]), free_names=tuple(free_names),
-        n_outputs=len(outs))
+        aux_names=aux_names, n_outputs=len(outs))
     outputs = [node[i] for i in range(len(outs))]
     finals = [node[len(outs) + i] for i in range(len(states))]
     return (outputs if outs_list else outputs[0],
@@ -247,6 +307,7 @@ def while_loop(cond, func, loop_vars, max_iterations, name="while_loop"):
     from ..ops.registry import get_op
     pre = _fresh_prefix("while")
     states, states_list = _as_list(loop_vars)
+    mark = _trace_mark()
     svars = [_var(pre + "state%d" % i) for i in range(len(states))]
     packed = svars if states_list else svars[0]
     pred = cond(packed)
@@ -255,17 +316,18 @@ def while_loop(cond, func, loop_vars, max_iterations, name="while_loop"):
     new_states, _ = _as_list(new_states)
     assert len(new_states) == len(states)
     bound = set(pre + "state%d" % i for i in range(len(states)))
-    csub = _group([pred])
-    bsub = _group(outs + new_states)
-    c_free, c_syms = _free_inputs(csub, bound)
-    b_free, b_syms = _free_inputs(bsub, bound)
+    csub, c_free, c_syms, _c_aux = _extract_body([pred], bound, mark,
+                                                 pre + "c")
+    bsub, b_free, b_syms, aux_names = _extract_body(
+        outs + new_states, bound, mark, pre + "b")
     node = make_op_func(get_op("_sym_while_loop"))(
         *states, *c_syms, *b_syms, name=name,
         cond_json=csub.tojson(), body_json=bsub.tojson(),
         state_names=tuple(pre + "state%d" % i
                           for i in range(len(states))),
         cond_free_names=tuple(c_free), body_free_names=tuple(b_free),
-        n_outputs=len(outs), max_iterations=int(max_iterations))
+        aux_names=aux_names, n_outputs=len(outs),
+        max_iterations=int(max_iterations))
     outputs = [node[i] for i in range(len(outs))]
     finals = [node[len(outs) + i] for i in range(len(states))]
     return (outputs if outs_list else outputs[0],
@@ -283,6 +345,7 @@ def cond(pred, then_func, else_func, inputs=None, name="cond"):
     pre = _fresh_prefix("cond")
     inputs, _ = _as_list(inputs if inputs is not None else [])
     in_names = [pre + "in%d" % i for i in range(len(inputs))]
+    mark = _trace_mark()
     in_vars = [_var(n) for n in in_names]
 
     def run(f):
@@ -295,18 +358,20 @@ def cond(pred, then_func, else_func, inputs=None, name="cond"):
     assert len(t_outs) == len(e_outs), \
         "then/else branches must produce the same number of outputs"
     bound = set(in_names)
-    psub = _group(p_outs)
-    tsub = _group(t_outs)
-    esub = _group(e_outs)
-    p_free, p_syms = _free_inputs(psub, bound)
-    t_free, t_syms = _free_inputs(tsub, bound)
-    e_free, e_syms = _free_inputs(esub, bound)
+    psub, p_free, p_syms, _pa = _extract_body(p_outs, bound, mark,
+                                              pre + "p")
+    tsub, t_free, t_syms, t_aux = _extract_body(t_outs, bound, mark,
+                                                pre + "t")
+    esub, e_free, e_syms, e_aux = _extract_body(e_outs, bound, mark,
+                                                pre + "e")
+    aux_names = tuple(t_aux) + tuple(a for a in e_aux if a not in t_aux)
     node = make_op_func(get_op("_sym_cond"))(
         *inputs, *p_syms, *t_syms, *e_syms, name=name,
         pred_json=psub.tojson(), then_json=tsub.tojson(),
         else_json=esub.tojson(), input_names=tuple(in_names),
         pred_free_names=tuple(p_free), then_free_names=tuple(t_free),
-        else_free_names=tuple(e_free), n_outputs=len(t_outs))
+        else_free_names=tuple(e_free), aux_names=aux_names,
+        n_outputs=len(t_outs))
     outs = [node[i] for i in range(len(t_outs))]
     return outs if t_list else outs[0]
 

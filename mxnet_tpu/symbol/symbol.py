@@ -31,6 +31,9 @@ AUX_STATES = {
     "SyncBatchNorm": ("moving_mean", "moving_var"),
 }
 
+# control-flow subgraph ops: inner aux updates ride as trailing outputs
+_CF_OPS = ("_sym_foreach", "_sym_while_loop", "_sym_cond")
+
 
 class _NameManager(threading.local):
     """Auto-naming for anonymous symbols (reference:
@@ -99,10 +102,14 @@ def _input_names(op):
     return names
 
 
+_node_serial = [0]
+
+
 class _Node:
     """One graph node: an op application or a variable (op is None)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "in_names")
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "in_names",
+                 "serial")
 
     def __init__(self, op, name, attrs=None, inputs=(), is_aux=False,
                  in_names=None):
@@ -114,6 +121,10 @@ class _Node:
         # names of the op input slots actually wired, aligned with
         # ``inputs`` (optional inputs like bias may be skipped)
         self.in_names = in_names
+        # creation order: control-flow tracing uses it to tell nodes
+        # built INSIDE a body apart from closed-over outer nodes
+        _node_serial[0] += 1
+        self.serial = _node_serial[0]
 
     @property
     def is_var(self):
@@ -492,6 +503,11 @@ class Symbol(object):
     def __eq__(self, other):
         return self is other
 
+    def __bool__(self):
+        raise TypeError(
+            "Symbol has no truth value: comparisons build graph nodes "
+            "(use sym.contrib.cond for data-dependent branching)")
+
 
 def _n_outputs(node):
     op = _reg.get_op(node.op)
@@ -754,6 +770,13 @@ def _graph_eval_fn(symbol, is_train):
             else:
                 out = op.fn(*arrs, **attrs)
                 outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+                # control-flow subgraphs surface their inner aux
+                # updates (BN moving stats) as trailing outputs
+                cf_aux = attrs.get("aux_names", ()) \
+                    if node.op in _CF_OPS else ()
+                if cf_aux and is_train:
+                    for nm, val in zip(cf_aux, outs[-len(cf_aux):]):
+                        new_aux[nm] = val
             for i, o in enumerate(outs):
                 values[(id(node), i)] = o
         outputs = tuple(values[(id(n), oi)] for (n, oi) in symbol._entries)
@@ -843,6 +866,62 @@ def _deduce_shapes(symbol, known, partial=False):
     # Module/model-zoo paths require.)
 
 
+def _deduce_cf_params(node, in_shapes, shapes):
+    """Recurse shape deduction into a control-flow node's serialized
+    subgraph(s): inner auto-created parameters (e.g. an RNN cell's
+    weights inside a foreach body) are free inputs of the node, so the
+    shapes found inside become outer leaf shapes."""
+    attrs = node.attrs
+    wired = node.in_names or ()
+    by_slot = dict(zip(wired, in_shapes))
+
+    def recurse(graph_json, known):
+        try:
+            sub = load_json(graph_json)
+        except Exception:
+            return False
+        inner = dict(known)
+        inner.update({k: v for k, v in shapes.items() if v is not None})
+        deduced = _deduce_shapes(sub, inner, partial=True)
+        changed = False
+        for k, v in deduced.items():
+            # bound placeholders (_cf...) are loop-internal names
+            if k not in shapes and v is not None and \
+                    not k.startswith("_cf"):
+                shapes[k] = tuple(v)
+                changed = True
+        return changed
+
+    changed = False
+    if node.op == "_sym_foreach":
+        known = {}
+        dshape = by_slot.get("data") or (in_shapes[0] if in_shapes
+                                         else None)
+        if dshape:
+            known[attrs.get("data_name", "")] = tuple(dshape[1:])
+        for nm, sh in zip(attrs.get("state_names", ()),
+                          in_shapes[1:1 + len(attrs.get("state_names",
+                                                        ()))]):
+            if sh is not None:
+                known[nm] = tuple(sh)
+        changed |= recurse(attrs.get("graph_json"), known)
+    elif node.op == "_sym_while_loop":
+        known = {}
+        for nm, sh in zip(attrs.get("state_names", ()), in_shapes):
+            if sh is not None:
+                known[nm] = tuple(sh)
+        changed |= recurse(attrs.get("cond_json"), known)
+        changed |= recurse(attrs.get("body_json"), known)
+    elif node.op == "_sym_cond":
+        known = {}
+        for nm, sh in zip(attrs.get("input_names", ()), in_shapes):
+            if sh is not None:
+                known[nm] = tuple(sh)
+        for key in ("pred_json", "then_json", "else_json"):
+            changed |= recurse(attrs.get(key), known)
+    return changed
+
+
 def _deduce_params(node, in_shapes, shapes):
     """Deduce missing parameter-leaf shapes for the core NN ops from the
     data input's shape (the analog of each op's FInferShape filling in
@@ -850,6 +929,8 @@ def _deduce_params(node, in_shapes, shapes):
     op_name = node.op
     attrs = node.attrs
     ins = node.inputs
+    if op_name in _CF_OPS:
+        return _deduce_cf_params(node, in_shapes, shapes)
 
     def set_leaf(pos, shape):
         src, _ = ins[pos]

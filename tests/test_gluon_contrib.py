@@ -1,0 +1,133 @@
+"""gluon.contrib: Concurrent/Identity/SparseEmbedding/SyncBatchNorm,
+VariationalDropout/LSTMP/Conv*Cells, IntervalSampler (reference:
+python/mxnet/gluon/contrib/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn as gnn
+from mxnet_tpu.gluon.contrib import data as cdata, nn as cnn, rnn as crnn
+from mxnet_tpu.gluon.rnn import LSTMCell
+
+
+def test_concurrent_and_identity():
+    rng = np.random.RandomState(0)
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(gnn.Dense(4), cnn.Identity(), gnn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(rng.randn(3, 5).astype(np.float32))
+    out = net(x)
+    assert out.shape == (3, 11)          # 4 + 5 + 2
+    # Identity slice equals the input
+    np.testing.assert_allclose(out.asnumpy()[:, 4:9], x.asnumpy(),
+                               rtol=1e-6)
+
+    eager = cnn.Concurrent(axis=-1)
+    eager.add(cnn.Identity(), cnn.Identity())
+    eager.initialize()
+    np.testing.assert_allclose(eager(x).asnumpy(),
+                               np.concatenate([x.asnumpy()] * 2, -1))
+
+
+def test_sparse_embedding_row_sparse_grad():
+    emb = cnn.SparseEmbedding(40, 6)
+    emb.initialize()
+    idx = nd.array(np.array([1, 3, 3, 7], np.float32))
+    with autograd.record():
+        loss = (emb(idx) ** 2).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    rows = set(int(i) for i in np.asarray(g.indices))
+    assert rows == {1, 3, 7}
+    # dense equivalence
+    w = emb.weight.data().asnumpy()
+    dense = np.zeros_like(w)
+    for i in [1, 3, 3, 7]:
+        dense[i] += 2 * w[i]
+    np.testing.assert_allclose(g.todense().asnumpy(), dense, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_contrib_sync_batch_norm_layer():
+    net = cnn.SyncBatchNorm(num_devices=1)
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(4, 3, 5, 5)
+                 .astype(np.float32))
+    with autograd.record():
+        y = net(x)
+    # per-channel train-mode output is standardized
+    m = y.asnumpy().mean(axis=(0, 2, 3))
+    v = y.asnumpy().var(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0, atol=1e-5)
+    np.testing.assert_allclose(v, 1, atol=1e-3)
+
+
+def test_variational_dropout_locks_mask():
+    vd = crnn.VariationalDropoutCell(LSTMCell(6), drop_inputs=0.5,
+                                     drop_outputs=0.5)
+    vd.initialize()
+    x = nd.array(np.ones((3, 7, 5), np.float32))
+    with autograd.record():
+        out, _ = vd.unroll(7, x, merge_outputs=True)
+    zp = (out.asnumpy() == 0)
+    assert zp.any()
+    assert (zp[:, 0:1] == zp).all()      # identical zero pattern per step
+
+
+def test_lstmp_projection():
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    rng = np.random.RandomState(2)
+    out, states = cell.unroll(
+        4, nd.array(rng.randn(2, 4, 5).astype(np.float32)),
+        merge_outputs=True)
+    assert out.shape == (2, 4, 3)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+
+
+@pytest.mark.parametrize("kind,n_states", [("RNN", 1), ("LSTM", 2),
+                                           ("GRU", 1)])
+def test_conv2d_cells(kind, n_states):
+    cls = getattr(crnn, "Conv2D%sCell" % kind)
+    cell = cls(input_shape=(2, 6, 6), hidden_channels=4, i2h_kernel=3,
+               h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    rng = np.random.RandomState(3)
+    seq = nd.array(rng.randn(2, 5, 2, 6, 6).astype(np.float32))
+    with autograd.record():
+        outs, states = cell.unroll(5, seq, merge_outputs=True)
+        loss = (outs ** 2).sum()
+    loss.backward()
+    assert outs.shape == (2, 5, 4, 6, 6)
+    assert len(states) == n_states
+    g = cell.i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and np.abs(g.asnumpy()).sum() > 0
+
+
+def test_conv1d_3d_cells_shapes():
+    c1 = crnn.Conv1DLSTMCell(input_shape=(2, 8), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c1.initialize()
+    o1, _ = c1.unroll(3, nd.array(np.random.rand(1, 3, 2, 8)
+                                  .astype(np.float32)),
+                      merge_outputs=True)
+    assert o1.shape == (1, 3, 3, 8)
+    c3 = crnn.Conv3DGRUCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                            i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize()
+    o3, _ = c3.unroll(2, nd.array(np.random.rand(1, 2, 1, 4, 4, 4)
+                                  .astype(np.float32)),
+                      merge_outputs=True)
+    assert o3.shape == (1, 2, 2, 4, 4, 4)
+
+
+def test_interval_sampler():
+    s = cdata.IntervalSampler(10, 3)
+    assert list(s) == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    assert len(s) == 10
+    s2 = cdata.IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9]
+    assert len(s2) == 4

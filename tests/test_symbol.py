@@ -321,3 +321,52 @@ def test_symbolic_control_flow_nesting_and_shared_vars():
     exe2.forward(is_train=True)
     exe2.backward(mx.nd.ones(()))
     assert np.isfinite(exe2.grad_dict["w"].asnumpy()).all()
+
+
+def test_control_flow_capture_aux_and_inner_shapes():
+    """Regressions from review: (a) a stochastic node closed over by a
+    loop body is computed ONCE in the outer graph and shared (not
+    re-drawn per iteration); (b) BatchNorm moving stats update through
+    control-flow bodies; (c) auto-created params inside a body
+    shape-deduce through the subgraph; (d) Symbol has no truth value."""
+    import mxnet_tpu.symbol as S
+    rng = np.random.RandomState(0)
+
+    x = mx.sym.var("x")
+    h = mx.sym.Dropout(x, p=0.5, name="drop")
+    outs, _ = S.contrib.foreach(lambda t, s: (h + 0 * t, s),
+                                mx.sym.var("dd"), mx.sym.var("ss"))
+    total = mx.sym.Group([outs, h])
+    exe = total.simple_bind(x=(64,), dd=(2, 64), ss=(1,))
+    exe.arg_dict["x"][:] = mx.nd.array(np.ones(64, np.float32))
+    exe.arg_dict["dd"][:] = mx.nd.zeros((2, 64))
+    exe.arg_dict["ss"][:] = mx.nd.zeros((1,))
+    o = exe.forward(is_train=True)
+    np.testing.assert_array_equal(o[0].asnumpy()[0], o[1].asnumpy())
+    np.testing.assert_array_equal(o[0].asnumpy()[1], o[1].asnumpy())
+
+    data = mx.sym.var("data")
+    outs2, _ = S.contrib.foreach(
+        lambda xt, s: (mx.sym.BatchNorm(xt, name="bn", fix_gamma=False),
+                       s), data, mx.sym.var("s2"))
+    exe2 = outs2.simple_bind(data=(3, 4, 5), s2=(1,))
+    for n, a in exe2.arg_dict.items():
+        if n not in ("data", "s2"):
+            a[:] = mx.nd.ones(a.shape)
+    exe2.arg_dict["data"][:] = mx.nd.array(
+        (rng.randn(3, 4, 5) * 3 + 7).astype(np.float32))
+    exe2.arg_dict["s2"][:] = mx.nd.zeros((1,))
+    before = {k: v.asnumpy().copy() for k, v in exe2.aux_dict.items()}
+    exe2.forward(is_train=True)
+    assert any(not np.allclose(before[k], exe2.aux_dict[k].asnumpy())
+               for k in before)
+
+    outs3, _ = S.contrib.foreach(
+        lambda xt, s: (mx.sym.FullyConnected(xt, num_hidden=4,
+                                             name="fc") + 0 * s, s),
+        mx.sym.var("dd2"), mx.sym.var("ss2"))
+    exe3 = outs3.simple_bind(dd2=(5, 2, 3), ss2=(2, 4))
+    assert exe3.arg_dict["fc_weight"].shape == (4, 3)
+
+    with pytest.raises(TypeError):
+        bool(mx.sym.var("q") > 0)
