@@ -1,0 +1,216 @@
+"""Reference-binary NDArray file codec (reference:
+src/ndarray/ndarray.cc:1565-1800 — the ``.params`` format every
+published MXNet checkpoint uses).
+
+Layout (little-endian, dmlc::Stream serialization):
+  uint64 0x112 (kMXAPINDArrayListMagic), uint64 reserved
+  uint64 n; n x NDArray       (vector<NDArray>)
+  uint64 k; k x (uint64 len, bytes)   (vector<string> names)
+
+NDArray v2 (uint32 magic 0xF993fac9):
+  int32 stype; [storage_shape Tuple if sparse]; shape Tuple;
+  int32 dev_type, int32 dev_id; int32 type_flag;
+  [per aux: int32 aux_type, Tuple aux_shape]; raw data; [raw aux data]
+
+Tuple = uint32 ndim + ndim dims. The dim width changed across MXNet
+releases (uint32 through ~1.4, int64 from 1.5 with int64-TShape
+builds); both are accepted — each array is parsed with one width and
+re-parsed with the other if validation (device-type / dtype ranges,
+stream bounds) rejects it.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["LIST_MAGIC", "is_mxnet_params", "loads", "dumps"]
+
+LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+
+# mshadow type flags (mshadow/base.h)
+_DTYPES = {0: _np.float32, 1: _np.float64, 2: _np.float16, 3: _np.uint8,
+           4: _np.int32, 5: _np.int8, 6: _np.int64}
+_FLAGS = {_np.dtype(v): k for k, v in _DTYPES.items()}
+
+# storage types (include/mxnet/ndarray.h:61-65); value -> n aux arrays
+_NAD = {0: 0, 1: 1, 2: 2}      # default, row_sparse, csr
+
+
+class _Cursor(object):
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n):
+        if self.pos + n > len(self.buf):
+            raise MXNetError("truncated NDArray file")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+
+def _read_tuple(cur, dim64):
+    ndim = cur.u32()
+    if ndim > 32:
+        raise MXNetError("implausible ndim %d" % ndim)
+    fmt = "<%d%s" % (ndim, "q" if dim64 else "I")
+    size = 8 * ndim if dim64 else 4 * ndim
+    dims = struct.unpack(fmt, cur.read(size))
+    if any(d <= 0 or d > 2 ** 40 for d in dims):
+        raise MXNetError("implausible dims %s" % (dims,))
+    return tuple(int(d) for d in dims)
+
+
+def _read_array(cur, dim64):
+    magic = cur.u32()
+    if magic == _V2_MAGIC:
+        stype = cur.i32()
+        if stype not in _NAD:
+            raise MXNetError("bad storage type %d" % stype)
+        nad = _NAD[stype]
+        sshape = _read_tuple(cur, dim64) if nad > 0 else None
+        shape = _read_tuple(cur, dim64)
+    elif magic == _V1_MAGIC:
+        stype, nad, sshape = 0, 0, None
+        shape = _read_tuple(cur, dim64)
+    else:
+        # oldest legacy: the "magic" IS ndim, dims always uint32
+        stype, nad, sshape = 0, 0, None
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError("bad magic 0x%x" % magic)
+        shape = struct.unpack("<%dI" % ndim, cur.read(4 * ndim))
+    if len(shape) == 0:
+        return None, None, None                      # none-array
+    dev_type = cur.i32()
+    dev_id = cur.i32()
+    # loose plausibility bound: exists only to disambiguate the dim
+    # width, must not reject real files from high-numbered devices
+    if not (1 <= dev_type <= 6 and 0 <= dev_id <= 255):
+        raise MXNetError("implausible context (%d,%d)"
+                         % (dev_type, dev_id))
+    type_flag = cur.i32()
+    if type_flag not in _DTYPES:
+        raise MXNetError("unknown type flag %d" % type_flag)
+    aux = []
+    for _ in range(nad):
+        aux_type = cur.i32()
+        if aux_type not in _DTYPES:
+            raise MXNetError("unknown aux type flag %d" % aux_type)
+        aux.append((aux_type, _read_tuple(cur, dim64)))
+    data_shape = sshape if nad > 0 else shape
+    dtype = _np.dtype(_DTYPES[type_flag])
+    n = int(_np.prod(data_shape)) if data_shape else 1
+    data = _np.frombuffer(cur.read(n * dtype.itemsize),
+                          dtype=dtype).reshape(data_shape)
+    aux_arrays = []
+    for aux_type, ashape in aux:
+        adt = _np.dtype(_DTYPES[aux_type])
+        an = int(_np.prod(ashape)) if ashape else 1
+        aux_arrays.append(_np.frombuffer(cur.read(an * adt.itemsize),
+                                         dtype=adt).reshape(ashape))
+    return stype, (shape, data), aux_arrays
+
+
+def is_mxnet_params(head):
+    """First 8+ bytes → is this the reference binary list format?"""
+    return len(head) >= 8 and \
+        struct.unpack("<Q", head[:8])[0] == LIST_MAGIC
+
+
+def _parse_all(buf, dim64, ctx):
+    from .ndarray import array
+    from .sparse import RowSparseNDArray, CSRNDArray
+    cur = _Cursor(buf)
+    if cur.u64() != LIST_MAGIC:
+        raise MXNetError("not an MXNet NDArray list file")
+    cur.u64()                                        # reserved
+    n = cur.u64()
+    if n > 10 ** 7:
+        raise MXNetError("implausible array count %d" % n)
+    arrays = []
+    for _ in range(n):
+        stype, payload, aux = _read_array(cur, dim64)
+        if payload is None:
+            arrays.append(None)
+            continue
+        shape, data = payload
+        if stype == 0:
+            arrays.append(array(data, ctx=ctx, dtype=data.dtype))
+        elif stype == 1:                             # row_sparse
+            arrays.append(RowSparseNDArray(data, aux[0], shape, ctx=ctx))
+        else:                                        # csr
+            arrays.append(CSRNDArray(data, aux[1], aux[0], shape,
+                                     ctx=ctx))
+    k = cur.u64()
+    if k not in (0, n):
+        raise MXNetError("key count %d != array count %d" % (k, n))
+    keys = []
+    for _ in range(k):
+        ln = cur.u64()
+        if ln > 4096:
+            raise MXNetError("implausible key length %d" % ln)
+        keys.append(cur.read(ln).decode())
+    if cur.pos != len(buf):
+        raise MXNetError("trailing bytes (%d) after parse"
+                         % (len(buf) - cur.pos))
+    return keys, arrays
+
+
+def loads(buf, ctx=None):
+    """Decode a reference ``.params`` blob → (keys, ndarray list).
+    Sparse entries decode to RowSparse/CSR NDArrays. The TShape dim
+    width is a property of the WRITER's version: try uint32 (<=1.4),
+    fall back to int64 (>=1.5) — exactly one parses the stream to the
+    end. float64 entries land at float32 precision under JAX's default
+    x64-off config."""
+    try:
+        return _parse_all(buf, False, ctx)
+    except MXNetError:
+        return _parse_all(buf, True, ctx)
+
+
+def dumps(items, keyed):
+    """Encode (name, NDArray) pairs as a reference-compatible blob
+    (v2 arrays, uint32 dims — the 1.x layout)."""
+    out = [struct.pack("<QQ", LIST_MAGIC, 0),
+           struct.pack("<Q", len(items))]
+    for name, v in items:
+        a = _np.ascontiguousarray(v.asnumpy())
+        if a.ndim == 0:
+            raise MXNetError(
+                "cannot write %r: the reference format has no 0-dim "
+                "arrays (ndim=0 marks a none-entry); reshape to (1,)"
+                % name)
+        if a.dtype not in _FLAGS:
+            raise MXNetError(
+                "cannot write %r: dtype %s has no mshadow type flag in "
+                "the reference format; cast explicitly (e.g. float32)"
+                % (name, a.dtype))
+        out.append(struct.pack("<Ii", _V2_MAGIC, 0))
+        out.append(struct.pack("<I%dI" % a.ndim, a.ndim, *a.shape))
+        out.append(struct.pack("<ii", 1, 0))          # cpu(0)
+        out.append(struct.pack("<i", _FLAGS[a.dtype]))
+        out.append(a.tobytes())
+    if keyed:
+        out.append(struct.pack("<Q", len(items)))
+        for name, _v in items:
+            b = name.encode()
+            out.append(struct.pack("<Q", len(b)) + b)
+    else:
+        out.append(struct.pack("<Q", 0))
+    return b"".join(out)

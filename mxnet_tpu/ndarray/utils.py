@@ -22,8 +22,13 @@ __all__ = ["save", "load"]
 _MAGIC = "mxtpu-ndarray-v1"
 
 
-def save(fname, data):
-    """Save a list or str->NDArray dict (reference: utils.py:149)."""
+def save(fname, data, format="mxtpu"):
+    """Save a list or str->NDArray dict (reference: utils.py:149).
+
+    ``format="mxnet"`` writes the reference's binary ``.params``
+    layout (ndarray.cc:1565) so checkpoints interchange with the
+    reference; the default zip/NPY layout stays readable without any
+    framework."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -37,6 +42,14 @@ def save(fname, data):
     for _, v in items:
         if not isinstance(v, NDArray):
             raise MXNetError("save requires NDArray values")
+    if format not in ("mxtpu", "mxnet"):
+        raise MXNetError("unknown save format %r (use 'mxtpu' or "
+                         "'mxnet')" % (format,))
+    if format == "mxnet":
+        from . import mxnet_format
+        with open(fname, "wb") as f:
+            f.write(mxnet_format.dumps(items, keyed))
+        return
     with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
         zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
                     (_MAGIC, int(keyed), len(items)))
@@ -47,9 +60,20 @@ def save(fname, data):
 
 
 def load(fname, ctx=None):
-    """Load NDArrays saved by :func:`save` (reference: utils.py:222)."""
+    """Load NDArrays saved by :func:`save` OR by the reference
+    framework (binary ``.params``, detected by magic — so published
+    MXNet checkpoints load directly; reference: utils.py:222)."""
     if not os.path.exists(fname):
         raise MXNetError("no such file %r" % fname)
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from . import mxnet_format
+    if mxnet_format.is_mxnet_params(head):
+        with open(fname, "rb") as f:
+            keys, arrays = mxnet_format.loads(f.read(), ctx=ctx)
+        if keys:
+            return dict(zip(keys, arrays))
+        return arrays
     with zipfile.ZipFile(fname, "r") as zf:
         meta = zf.read("__meta__").decode().splitlines()
         if meta[0] != _MAGIC:
