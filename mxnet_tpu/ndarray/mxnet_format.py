@@ -180,8 +180,13 @@ def loads(buf, ctx=None):
     x64-off config."""
     try:
         return _parse_all(buf, False, ctx)
-    except MXNetError:
-        return _parse_all(buf, True, ctx)
+    except MXNetError as first:
+        try:
+            return _parse_all(buf, True, ctx)
+        except MXNetError:
+            # a corrupt file fails both widths; the uint32 pass usually
+            # gets further, so its error is the informative one
+            raise first
 
 
 def dumps(items, keyed):

@@ -347,3 +347,41 @@ def embedding(data, weight, sparse_grad=True):
             return None, RowSparseNDArray(vals, flat, self._vocab)
 
     return _Fn()(data, weight)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """Sum of squares, touching only stored values where the layout
+    allows (reference: src/operator/tensor/square_sum.cc _square_sum —
+    the row_sparse-efficient reduction SGD weight-decay paths use)."""
+    jnp = _jnp()
+    from .ndarray import NDArray
+    if isinstance(arr, RowSparseNDArray):
+        if axis is None:
+            sq = jnp.asarray(arr.data) ** 2
+            out = jnp.sum(sq)
+            if keepdims:
+                out = out.reshape((1,) * arr.ndim)
+            return NDArray(out, ctx=arr.context)
+        if arr.ndim == 2 and axis in (1, -1, (1,), (-1,)):
+            # the sparse-efficient case: per-row reduce over stored rows
+            red = jnp.sum(jnp.asarray(arr.data) ** 2, axis=1)
+            out = jnp.zeros((arr.shape[0],), red.dtype).at[
+                jnp.asarray(arr.indices)].set(red)
+            if keepdims:
+                out = out.reshape((arr.shape[0], 1))
+            return NDArray(out, ctx=arr.context)
+        dense = arr.todense()
+        return NDArray(jnp.sum(dense._data ** 2, axis=axis,
+                               keepdims=keepdims), ctx=arr.context)
+    if isinstance(arr, CSRNDArray):
+        if axis is None:
+            out = jnp.sum(jnp.asarray(arr.data) ** 2)
+            if keepdims:
+                out = out.reshape((1,) * arr.ndim)
+            return NDArray(out, ctx=arr.context)
+        dense = arr.todense()
+        return NDArray(jnp.sum(dense._data ** 2, axis=axis,
+                               keepdims=keepdims), ctx=arr.context)
+    data = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    return NDArray(jnp.sum(data ** 2, axis=axis, keepdims=keepdims),
+                   ctx=getattr(arr, "context", None))

@@ -371,3 +371,9 @@ def _flash_attention_op(q, k, v, causal=False, sm_scale=None,
     return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                            block_q=block_q, block_k=block_k,
                            interpret=interpret)
+
+
+# reference add_alias parity (bounding_box.cc, ctc_loss.cc)
+alias("_contrib_box_non_maximum_suppression", "_contrib_box_nms")
+alias("_contrib_ctc_loss", "_contrib_CTCLoss")
+alias("ctc_loss", "_contrib_CTCLoss")

@@ -234,3 +234,54 @@ def _hard_sigmoid(x, alpha=0.2, beta=0.5, **_ig):
     """y = max(0, min(1, alpha*x + beta)) (reference:
     tensor/elemwise_unary_op_basic.cc:109)."""
     return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# logical binary family (reference: elemwise_binary_op_logic.cc,
+# elemwise_binary_scalar_op_logic.cc) — outputs are 0/1 in the input
+# dtype, like the comparison family
+# ---------------------------------------------------------------------------
+
+def _logical_family(name, fn):
+    """Elemwise twins of the broadcast_logical_* family above
+    (reference: elemwise_binary_op_logic.cc registers both; the scalar
+    variants are registered with the scalar sweep at line ~186)."""
+    @register("_" + name, differentiable=False)
+    def _op(a, b, _fn=fn):
+        return _fn(a != 0, b != 0).astype(a.dtype)
+    alias(name, "_" + name)
+
+
+_logical_family("logical_and", jnp.logical_and)
+_logical_family("logical_or", jnp.logical_or)
+_logical_family("logical_xor", jnp.logical_xor)
+
+
+@register("add_n")
+def _add_n(*args, **_ig):
+    """Variadic sum (reference: elemwise_sum.cc ElementWiseSum — the
+    gradient-aggregation workhorse). XLA fuses the chain."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+alias("ElementWiseSum", "add_n")
+
+
+@register("SoftmaxActivation", attr_defaults={"mode": "instance"})
+def _softmax_activation(x, mode="instance", **_ig):
+    """Deprecated-but-present reference op
+    (src/operator/softmax_activation.cc): softmax over the class axis
+    ('instance') or per spatial position over channels ('channel')."""
+    import jax
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1),
+                          axis=-1).reshape(x.shape)
+
+
+# reference add_alias parity (elemwise_binary_broadcast_op_basic.cc)
+alias("broadcast_plus", "broadcast_add")
+alias("broadcast_minus", "broadcast_sub")

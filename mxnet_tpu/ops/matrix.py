@@ -506,3 +506,27 @@ def _unravel_index(data, shape=(), **_ig):
 
 
 alias("unravel_index", "_unravel_index")
+
+
+@register("Crop", num_outputs=1,
+          attr_defaults={"offset": (0, 0), "h_w": (0, 0),
+                         "center_crop": False, "num_args": 1})
+def _crop_op(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False,
+             num_args=1, **_ig):
+    """Legacy Crop (reference: src/operator/crop.cc, the FCN-era op):
+    crop data (N,C,H,W) to ``h_w`` or to the spatial size of a second
+    input, at ``offset`` or centered."""
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    if y0 < 0 or x0 < 0 or y0 + th > H or x0 + tw > W:
+        raise MXNetError(
+            "Crop: window %dx%d at offset (%d,%d) exceeds input %dx%d"
+            % (th, tw, y0, x0, H, W))
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

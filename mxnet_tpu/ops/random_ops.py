@@ -137,3 +137,81 @@ alias("random_randint", "_random_randint")
 alias("random_negative_binomial", "_random_negative_binomial")
 alias("sample_multinomial", "_sample_multinomial")
 alias("shuffle", "_shuffle")
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True,
+          differentiable=False,
+          attr_defaults={"mu": 1.0, "alpha": 1.0, "shape": (),
+                         "dtype": "float32"})
+def _random_gnb(key, mu=1.0, alpha=1.0, shape=(), dtype="float32", **_ig):
+    """Generalized negative binomial = gamma-mixed Poisson (reference:
+    src/operator/random/sample_op.cc GeneralizedNegativeBinomial):
+    lambda ~ Gamma(1/alpha, mu*alpha); x ~ Poisson(lambda)."""
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (mu * alpha)
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+alias("random_generalized_negative_binomial",
+      "_random_generalized_negative_binomial")
+alias("generalized_negative_binomial",
+      "_random_generalized_negative_binomial")
+
+
+# ---------------------------------------------------------------------------
+# sample_* family: one draw (or ``shape`` draws) PER ROW of the
+# parameter arrays (reference: src/operator/random/multisample_op.cc)
+# ---------------------------------------------------------------------------
+
+def _multisample(name, n_params, draw):
+    @register("_sample_" + name, needs_rng=True, differentiable=False,
+              attr_defaults={"shape": (), "dtype": "float32"})
+    def _op(key, *params, shape=(), dtype="float32", **_ig):
+        import numpy as _onp
+        ps = params[:n_params]
+        per = _shape(shape)
+        batch = tuple(ps[0].shape)
+        n = int(_onp.prod(batch)) if batch else 1
+        keys = jax.random.split(key, n)
+
+        def one(k, *args):
+            return draw(k, *args, shape=per)
+
+        flat = [p.reshape(-1) for p in ps]
+        out = jax.vmap(one)(keys, *flat)
+        return out.reshape(batch + per).astype(np_dtype(dtype))
+    alias("sample_" + name, "_sample_" + name)
+
+
+_multisample("uniform", 2,
+             lambda k, lo, hi, shape: jax.random.uniform(
+                 k, shape, minval=lo, maxval=hi))
+_multisample("normal", 2,
+             lambda k, mu, sigma, shape: mu + sigma *
+             jax.random.normal(k, shape))
+_multisample("gamma", 2,
+             lambda k, alpha, beta, shape: jax.random.gamma(
+                 k, alpha, shape) * beta)
+_multisample("exponential", 1,
+             lambda k, lam, shape: jax.random.exponential(k, shape) / lam)
+_multisample("poisson", 1,
+             lambda k, lam, shape: jax.random.poisson(
+                 k, lam, shape).astype(jnp.float32))
+_multisample("negative_binomial", 2,
+             lambda k, kk, p, shape: _nb_draw(k, kk, p, shape))
+_multisample("generalized_negative_binomial", 2,
+             lambda k, mu, alpha, shape: _gnb_draw(k, mu, alpha, shape))
+
+
+def _nb_draw(key, k_param, p, shape):
+    # NB(k, p) = Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k_param, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+
+def _gnb_draw(key, mu, alpha, shape):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / alpha, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam).astype(jnp.float32)

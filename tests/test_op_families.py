@@ -387,3 +387,83 @@ def test_spatial_transformer_downscale_shape_and_grad():
         fn(d, t, target_shape=(4, 4)) ** 2), (0, 1))(data, theta)
     assert np.isfinite(np.asarray(g[0])).all()
     assert np.isfinite(np.asarray(g[1])).all() and np.abs(g[1]).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# op-audit additions (reference: elemwise_sum.cc, *_logic.cc, crop.cc,
+# softmax_activation.cc, cast_storage.cc, sparse_retain.cc,
+# square_sum.cc, multisample_op.cc)
+# ---------------------------------------------------------------------------
+
+def test_add_n_and_logical_family():
+    a = mx.nd.array(np.array([1., 0, 2], np.float32))
+    b = mx.nd.array(np.array([0., 0, 5], np.float32))
+    c = mx.nd.array(np.array([1., 1, 1], np.float32))
+    np.testing.assert_array_equal(mx.nd.add_n(a, b, c).asnumpy(),
+                                  [2, 1, 8])
+    np.testing.assert_array_equal(mx.nd.ElementWiseSum(a, c).asnumpy(),
+                                  [2, 1, 3])
+    np.testing.assert_array_equal(mx.nd.logical_and(a, b).asnumpy(),
+                                  [0, 0, 1])
+    np.testing.assert_array_equal(mx.nd.logical_or(a, b).asnumpy(),
+                                  [1, 0, 1])
+    np.testing.assert_array_equal(mx.nd.logical_xor(a, c).asnumpy(),
+                                  [0, 1, 0])
+
+
+def test_crop_and_softmax_activation():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                    .reshape(2, 3, 6, 6))
+    like = mx.nd.zeros((2, 3, 4, 4))
+    out = mx.nd.Crop(x, like, num_args=2, offset=(1, 1))
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 1:5])
+    out2 = mx.nd.Crop(x, h_w=(2, 2), center_crop=True)
+    np.testing.assert_array_equal(out2.asnumpy(),
+                                  x.asnumpy()[:, :, 2:4, 2:4])
+    sm = mx.nd.SoftmaxActivation(
+        mx.nd.array(np.random.rand(2, 4, 3, 3).astype(np.float32)),
+        mode="channel")
+    np.testing.assert_allclose(sm.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_cast_storage_retain_square_sum():
+    from mxnet_tpu.ndarray import sparse
+    d = np.zeros((5, 4), np.float32)
+    d[1] = 3
+    d[3, 2] = 7
+    rsp = mx.nd.cast_storage(mx.nd.array(d), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert sorted(np.asarray(rsp.indices).tolist()) == [1, 3]
+    np.testing.assert_array_equal(rsp.todense().asnumpy(), d)
+    csr = mx.nd.cast_storage(mx.nd.array(d), "csr")
+    np.testing.assert_array_equal(
+        mx.nd.cast_storage(csr, "default").asnumpy(), d)
+    kept = sparse.retain(rsp, mx.nd.array(np.array([3], np.float32)))
+    np.testing.assert_array_equal(kept.todense().asnumpy()[3], d[3])
+    assert float(sparse.square_sum(rsp).asnumpy()) == float((d**2).sum())
+    # per-row reduction lands on the right rows
+    per_row = sparse.square_sum(rsp, axis=1).asnumpy()
+    np.testing.assert_allclose(per_row, (d ** 2).sum(axis=1))
+
+
+def test_multisample_family_and_gnb():
+    mx.seed(0)
+    mu = mx.nd.array(np.array([0.0, 100.0], np.float32))
+    sig = mx.nd.array(np.array([1.0, 1.0], np.float32))
+    s = mx.nd.sample_normal(mu, sig, shape=(500,))
+    assert s.shape == (2, 500)
+    m = s.asnumpy().mean(axis=1)
+    assert abs(m[0]) < 0.5 and abs(m[1] - 100) < 0.5, m
+    g = mx.nd.sample_gamma(mx.nd.array(np.array([2.0], np.float32)),
+                           mx.nd.array(np.array([3.0], np.float32)),
+                           shape=(800,))
+    assert abs(g.asnumpy().mean() - 6.0) < 0.5
+    u = mx.nd.sample_uniform(mx.nd.array(np.array([0., 10], np.float32)),
+                             mx.nd.array(np.array([1., 20], np.float32)),
+                             shape=(400,))
+    assert 0 <= u.asnumpy()[0].min() and u.asnumpy()[0].max() <= 1
+    assert 10 <= u.asnumpy()[1].min() and u.asnumpy()[1].max() <= 20
+    gnb = mx.nd.random_generalized_negative_binomial(
+        mu=8.0, alpha=0.25, shape=(4000,))
+    assert abs(gnb.asnumpy().mean() - 8.0) < 0.8
