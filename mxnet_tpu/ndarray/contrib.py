@@ -10,6 +10,7 @@ from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from .ndarray import invoke_op
 
 __all__ = ["foreach", "while_loop", "cond", "ROIAlign", "box_iou",
+           "bipartite_matching", "box_non_maximum_suppression",
            "box_nms", "MultiBoxPrior", "CTCLoss", "ctc_loss",
            "AdaptiveAvgPooling2D", "BilinearResize2D", "div_sqrt_dim",
            "arange_like", "dot_product_attention", "flash_attention", "quantize",
@@ -49,6 +50,10 @@ AdaptiveAvgPooling2D = _wrap("_contrib_AdaptiveAvgPooling2D",
 BilinearResize2D = _wrap("_contrib_BilinearResize2D", "BilinearResize2D")
 div_sqrt_dim = _wrap("_contrib_div_sqrt_dim", "div_sqrt_dim")
 arange_like = _wrap("_contrib_arange_like", "arange_like")
+bipartite_matching = _wrap("_contrib_bipartite_matching",
+                           "bipartite_matching")
+box_non_maximum_suppression = _wrap("_contrib_box_nms",
+                                    "box_non_maximum_suppression")
 dot_product_attention = _wrap("_contrib_dot_product_attention",
                               "dot_product_attention")
 def flash_attention(q, k, v, **kwargs):

@@ -377,3 +377,57 @@ def _flash_attention_op(q, k, v, causal=False, sm_scale=None,
 alias("_contrib_box_non_maximum_suppression", "_contrib_box_nms")
 alias("_contrib_ctc_loss", "_contrib_CTCLoss")
 alias("ctc_loss", "_contrib_CTCLoss")
+
+
+def _bm_num_outputs(_attrs):
+    return 2
+
+
+@register("_contrib_bipartite_matching", num_outputs=_bm_num_outputs,
+          differentiable=False,
+          attr_defaults={"is_ascend": False, "threshold": 1e-12,
+                         "topk": -1})
+def _bipartite_matching(data, is_ascend=False, threshold=1e-12, topk=-1,
+                        **_ig):
+    """Greedy bipartite matching on a score matrix [..., N, M]
+    (reference: contrib/bounding_box.cc:147): globally best-first pair
+    assignment, gated by ``threshold`` and optionally ``topk``. Returns
+    (row->col matches [..., N], col->row matches [..., M]), -1 for
+    unmatched. Sequential by nature: lax.fori_loop over the sorted
+    pair list, vmapped over leading dims."""
+    shape = data.shape
+    N, M = shape[-2], shape[-1]
+    flat_batch = data.reshape((-1, N, M))
+    topk_ = int(topk)
+
+    def one(s):
+        flat = s.reshape(-1)
+        order = jnp.argsort(flat if is_ascend else -flat)
+
+        def body(j, carry):
+            rm, cm, cnt = carry
+            idx = order[j]
+            r = idx // M
+            c = idx % M
+            sc = flat[idx]
+            ok = (rm[r] == -1) & (cm[c] == -1)
+            ok = ok & ((sc < threshold) if is_ascend else
+                       (sc > threshold))
+            if topk_ > 0:
+                ok = ok & (cnt < topk_)
+            rm = jnp.where(ok, rm.at[r].set(c), rm)
+            cm = jnp.where(ok, cm.at[c].set(r), cm)
+            return rm, cm, cnt + ok.astype(jnp.int32)
+
+        rm0 = jnp.full((N,), -1, jnp.int32)
+        cm0 = jnp.full((M,), -1, jnp.int32)
+        rm, cm, _ = lax.fori_loop(0, N * M, body,
+                                  (rm0, cm0, jnp.int32(0)))
+        return rm, cm
+
+    rms, cms = jax.vmap(one)(flat_batch)
+    return (rms.reshape(shape[:-1]).astype(data.dtype),
+            cms.reshape(shape[:-2] + (M,)).astype(data.dtype))
+
+
+alias("bipartite_matching", "_contrib_bipartite_matching")

@@ -269,3 +269,19 @@ def test_ssd_trains_with_finite_decreasing_loss():
     imgs, _ = synthetic_batch(2, 64, 3, rng)
     out = detect(net, imgs)
     assert out.shape[0] == 2 and out.shape[2] == 6
+
+
+def test_bipartite_matching_reference_example():
+    """The documented example from contrib/bounding_box.cc:147."""
+    s = mx.nd.array(np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]],
+                             np.float32))
+    x, y = mx.nd.contrib.bipartite_matching(s, threshold=1e-12,
+                                            is_ascend=False)
+    np.testing.assert_array_equal(x.asnumpy(), [1, -1, 0])
+    np.testing.assert_array_equal(y.asnumpy(), [2, 0])
+    # batched + topk
+    sb = mx.nd.array(np.stack([s.asnumpy(), s.asnumpy()[::-1]]))
+    xb, yb = mx.nd.contrib.bipartite_matching(sb, threshold=1e-12,
+                                              topk=1)
+    assert xb.shape == (2, 3) and yb.shape == (2, 2)
+    assert (xb.asnumpy() >= 0).sum() == 2       # one match per batch
