@@ -260,6 +260,47 @@ def train_resnet(batch=32, dtype="float32", num_layers=50, iters=20,
                           fwd_gflop_per_img=gflop)
 
 
+class _RecAugDataset:
+    """RecordIO decode+augment dataset for the pipeline bench.
+    Module-level (NOT a closure) so spawn/forkserver workers can pickle
+    it; each worker opens its own reader lazily."""
+
+    def __init__(self, idx_path, rec_path, n_images, size):
+        self._idx_path = idx_path
+        self._rec_path = rec_path
+        self._n = n_images
+        self._size = size
+        self._rec = None
+        self._augs = None
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        from . import image as img
+        from . import recordio
+        if self._rec is None:         # one reader per worker process
+            self._rec = recordio.MXIndexedRecordIO(
+                self._idx_path, self._rec_path, "r")
+            self._augs = img.CreateAugmenter(
+                (3, self._size, self._size), resize=self._size,
+                rand_crop=True, rand_mirror=True)
+        header, s = recordio.unpack(self._rec.read_idx(i))
+        im2 = img.imdecode(s, to_ndarray=False)
+        for aug in self._augs:
+            im2 = aug(im2)
+        arr = np.asarray(im2)
+        if arr.shape[-1] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        return arr.astype(np.float32), np.float32(header.label)
+
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        st["_rec"] = None             # readers don't cross processes
+        st["_augs"] = None
+        return st
+
+
 def data_pipeline(batch=128, n_images=512, size=224, iters=8,
                   num_workers=None):
     """Input-pipeline throughput: RecordIO JPEG decode + augment
@@ -292,31 +333,9 @@ def data_pipeline(batch=128, n_images=512, size=224, iters=8,
             recordio.IRHeader(0, float(i % 10), i, 0), buf.tobytes()))
     rec.close()
 
-    augs = img.CreateAugmenter((3, size, size), resize=size,
-                               rand_crop=True, rand_mirror=True)
-
-    class _RecDataset(Dataset):
-        def __init__(self):
-            self._rec = None
-
-        def __len__(self):
-            return n_images
-
-        def __getitem__(self, i):
-            if self._rec is None:     # one reader per worker process
-                self._rec = recordio.MXIndexedRecordIO(idx_path, rec_path,
-                                                       "r")
-            header, s = recordio.unpack(self._rec.read_idx(i))
-            im2 = img.imdecode(s, to_ndarray=False)
-            for aug in augs:
-                im2 = aug(im2)
-            arr = np.asarray(im2)
-            if arr.shape[-1] in (1, 3):
-                arr = arr.transpose(2, 0, 1)
-            return arr.astype(np.float32), np.float32(header.label)
-
-    dl = DataLoader(_RecDataset(), batch_size=batch,
-                    num_workers=num_workers, last_batch="discard")
+    dl = DataLoader(_RecAugDataset(idx_path, rec_path, n_images, size),
+                    batch_size=batch, num_workers=num_workers,
+                    last_batch="discard")
     # warm one epoch fragment
     it = iter(dl)
     next(it)

@@ -213,11 +213,26 @@ def _ints(v):
     return [int(v)]
 
 
-def _pads4(attrs):
-    p = _ints(attrs.get("pad", (0, 0)))
-    if len(p) == 1:
-        p = p * 2
-    return p + p                                     # [top,left,bot,right]
+def _spatial(attrs, key, nd, default):
+    """A spatial attr with the kernel's dimensionality (1D/2D/3D)."""
+    v = _ints(attrs.get(key, ()))
+    if not v:
+        v = [default] * nd
+    if len(v) != nd:
+        raise MXNetError(
+            "ONNX export: %s %s does not match kernel dimensionality %d"
+            % (key, v, nd))
+    return v
+
+
+def _pads(attrs, nd):
+    p = _ints(attrs.get("pad", ()))
+    if not p:
+        p = [0] * nd
+    if len(p) != nd:
+        raise MXNetError("ONNX export: pad %s does not match kernel "
+                         "dimensionality %d" % (p, nd))
+    return p + p                                     # begins + ends
 
 
 def _export_node(node, in_names, out_name, params):
@@ -226,10 +241,12 @@ def _export_node(node, in_names, out_name, params):
     attrs = node.get("attrs") or {}
     name = node["name"]
     if op == "Convolution":
-        a = [_attr_ints("kernel_shape", _ints(attrs["kernel"])),
-             _attr_ints("strides", _ints(attrs.get("stride", (1, 1)))),
-             _attr_ints("pads", _pads4(attrs)),
-             _attr_ints("dilations", _ints(attrs.get("dilate", (1, 1)))),
+        kernel = _ints(attrs["kernel"])
+        nd = len(kernel)
+        a = [_attr_ints("kernel_shape", kernel),
+             _attr_ints("strides", _spatial(attrs, "stride", nd, 1)),
+             _attr_ints("pads", _pads(attrs, nd)),
+             _attr_ints("dilations", _spatial(attrs, "dilate", nd, 1)),
              _attr_int("group", int(attrs.get("num_group", 1)))]
         return [_node("Conv", in_names, [out_name], name, _wrap_attrs(a))]
     if op == "FullyConnected":
@@ -240,8 +257,12 @@ def _export_node(node, in_names, out_name, params):
             # weight initializer (+ Add for bias) instead of Gemm
             wt_name = name + "_weight_T"
             wsrc = in_names[1]
-            if wsrc in params:
-                params[wt_name] = _np.ascontiguousarray(params[wsrc].T)
+            if wsrc not in params:
+                raise MXNetError(
+                    "ONNX export: flatten=False FullyConnected %r needs "
+                    "its weight %r in params (a graph-input weight "
+                    "cannot be transposed at export time)" % (name, wsrc))
+            params[wt_name] = _np.ascontiguousarray(params[wsrc].T)
             mm_out = out_name if len(in_names) < 3 else name + "_mm"
             nodes = [_node("MatMul", [in_names[0], wt_name], [mm_out],
                            name)]
@@ -275,11 +296,11 @@ def _export_node(node, in_names, out_name, params):
             return [_node(onnx_op, in_names, [out_name], name)]
         onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
         kernel = _ints(attrs["kernel"])
+        nd = len(kernel)
         # default stride is 1 in both this framework and the ONNX spec
         a = [_attr_ints("kernel_shape", kernel),
-             _attr_ints("strides",
-                        _ints(attrs.get("stride", [1] * len(kernel)))),
-             _attr_ints("pads", _pads4(attrs))]
+             _attr_ints("strides", _spatial(attrs, "stride", nd, 1)),
+             _attr_ints("pads", _pads(attrs, nd))]
         return [_node(onnx_op, in_names, [out_name], name,
                       _wrap_attrs(a))]
     if op == "BatchNorm":
@@ -376,8 +397,16 @@ def export_model(sym, params, input_shape, input_type="float32",
 
     body = b"".join(_f_bytes(1, n) for n in onnx_nodes)
     body += _f_bytes(2, "mxnet_tpu")
+    # serialize only CONSUMED initializers: rewrites (e.g. the
+    # flatten=False transposed weight) would otherwise leave the
+    # original as a dead duplicate doubling the file
+    consumed = set()
+    for nb in onnx_nodes:
+        f = _parse(nb)
+        consumed.update(_as_str(v) for v in _all(f, 1))
     for pname, arr in flat_params.items():
-        body += _f_bytes(5, _tensor(pname, arr))
+        if pname in consumed:
+            body += _f_bytes(5, _tensor(pname, arr))
     for iname, shape in inputs:
         body += _f_bytes(11, _value_info(iname, shape))
     for h in heads:
@@ -487,11 +516,11 @@ def import_model(model_file):
                 return v
             raise MXNetError("ONNX import: undefined input %r" % nm)
 
-        def split_pads(data_sym, pad_value=0.0, tag="_pad"):
+        def split_pads(data_sym, pad_value=0.0, tag="_pad", nd=2):
             """ONNX pads = [b1..bn, e1..en]. Symmetric → usable as the
             op's ``pad``; asymmetric → explicit Pad on the spatial dims
             (NC leading) and a zero op-level pad."""
-            pads = [int(v) for v in attrs.get("pads", [0, 0, 0, 0])]
+            pads = [int(v) for v in attrs.get("pads", [0] * (2 * nd))]
             n = len(pads) // 2
             begin, end = pads[:n], pads[n:]
             if begin == end:
@@ -506,10 +535,11 @@ def import_model(model_file):
 
         if op_type == "Conv":
             num_filter = inits[ins[1]].shape[0]
-            data, pad = split_pads(arg(0))
+            knd = len(attrs["kernel_shape"])
+            data, pad = split_pads(arg(0), nd=knd)
             kw = dict(kernel=tuple(attrs["kernel_shape"]),
-                      stride=tuple(attrs.get("strides", [1, 1])),
-                      dilate=tuple(attrs.get("dilations", [1, 1])),
+                      stride=tuple(attrs.get("strides", [1] * knd)),
+                      dilate=tuple(attrs.get("dilations", [1] * knd)),
                       pad=pad,
                       num_group=int(attrs.get("group", 1)),
                       num_filter=num_filter, name=name)
@@ -577,7 +607,8 @@ def import_model(model_file):
             kernel = tuple(attrs["kernel_shape"])
             # ONNX spec default strides is 1 (NOT kernel_shape)
             stride = tuple(attrs.get("strides", [1] * len(kernel)))
-            data, pad = split_pads(arg(0), pad_value=-3.4e38)
+            data, pad = split_pads(arg(0), pad_value=-3.4e38,
+                                   nd=len(kernel))
             out = mx.sym.Pooling(data, kernel=kernel, stride=stride,
                                  pad=pad, pool_type="max", name=name)
         elif op_type == "AveragePool":
@@ -663,6 +694,17 @@ def import_model(model_file):
         last = out
     # split initializers by how the rebuilt symbol classifies them
     # (moving BN stats are auxiliary states, everything else args)
+    # honor the graph's DECLARED outputs (field 12): valid ONNX only
+    # requires topological node order, so the last node may feed a side
+    # branch rather than produce the model output
+    declared = []
+    for raw in _all(graph, 12):
+        nm = _as_str(_one(_parse(raw), 1))
+        if nm in env:
+            declared.append(env[nm])
+    if declared:
+        from ..symbol import Group
+        last = declared[0] if len(declared) == 1 else Group(declared)
     aux_names = set(last.list_auxiliary_states()) if last is not None \
         else set()
     for n in list(arg_params):

@@ -76,11 +76,11 @@ def _worker_loop(dataset, batchify_fn, in_q, out_q):
         if item is None:
             break
         seq, indices = item
+        metas = []
         try:
             leaves = []
             tree = _flatten_np(batchify_fn([dataset[i] for i in indices]),
                                leaves)
-            metas = []
             for arr in leaves:
                 arr = _np.ascontiguousarray(arr)
                 shm = shared_memory.SharedMemory(
@@ -97,6 +97,16 @@ def _worker_loop(dataset, batchify_fn, in_q, out_q):
                 shm.close()
             out_q.put((seq, (tree, metas), None))
         except Exception as e:  # propagate to the consumer
+            # segments created before the failure are untracked and will
+            # never reach the consumer: unlink them here or they leak in
+            # /dev/shm — compounding pressure exactly when shm is tight
+            for name, _shape, _dt in metas:
+                try:
+                    seg = shared_memory.SharedMemory(name=name)
+                    seg.close()
+                    seg.unlink()
+                except Exception:
+                    pass
             out_q.put((seq, None, repr(e)))
 
 

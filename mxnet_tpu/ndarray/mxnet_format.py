@@ -189,25 +189,67 @@ def loads(buf, ctx=None):
             raise first
 
 
+def _check_writable(name, a):
+    if a.ndim == 0:
+        raise MXNetError(
+            "cannot write %r: the reference format has no 0-dim "
+            "arrays (ndim=0 marks a none-entry); reshape to (1,)"
+            % name)
+    if a.dtype not in _FLAGS:
+        raise MXNetError(
+            "cannot write %r: dtype %s has no mshadow type flag in "
+            "the reference format; cast explicitly (e.g. float32)"
+            % (name, a.dtype))
+
+
+def _tuple_bytes(shape):
+    return struct.pack("<I%dI" % len(shape), len(shape), *shape)
+
+
 def dumps(items, keyed):
-    """Encode (name, NDArray) pairs as a reference-compatible blob
-    (v2 arrays, uint32 dims — the 1.x layout)."""
+    """Encode (name, NDArray-or-sparse) pairs as a reference-compatible
+    blob (v2 arrays, uint32 dims — the 1.x layout). Row-sparse and CSR
+    arrays write true sparse records, so sparse checkpoints round-trip
+    with the reference."""
+    from .sparse import RowSparseNDArray, CSRNDArray
     out = [struct.pack("<QQ", LIST_MAGIC, 0),
            struct.pack("<Q", len(items))]
     for name, v in items:
+        if isinstance(v, RowSparseNDArray):
+            data = _np.ascontiguousarray(_np.asarray(v.data))
+            idx = _np.ascontiguousarray(
+                _np.asarray(v.indices).astype(_np.int64))
+            _check_writable(name, data)
+            out.append(struct.pack("<Ii", _V2_MAGIC, 1))
+            out.append(_tuple_bytes(data.shape))      # storage shape
+            out.append(_tuple_bytes(v.shape))
+            out.append(struct.pack("<ii", 1, 0))
+            out.append(struct.pack("<i", _FLAGS[data.dtype]))
+            out.append(struct.pack("<i", _FLAGS[_np.dtype(_np.int64)]))
+            out.append(_tuple_bytes(idx.shape))
+            out.append(data.tobytes() + idx.tobytes())
+            continue
+        if isinstance(v, CSRNDArray):
+            data = _np.ascontiguousarray(_np.asarray(v.data))
+            indptr = _np.ascontiguousarray(
+                _np.asarray(v.indptr).astype(_np.int64))
+            idx = _np.ascontiguousarray(
+                _np.asarray(v.indices).astype(_np.int64))
+            _check_writable(name, data)
+            out.append(struct.pack("<Ii", _V2_MAGIC, 2))
+            out.append(_tuple_bytes(data.shape))
+            out.append(_tuple_bytes(v.shape))
+            out.append(struct.pack("<ii", 1, 0))
+            out.append(struct.pack("<i", _FLAGS[data.dtype]))
+            i64 = struct.pack("<i", _FLAGS[_np.dtype(_np.int64)])
+            out.append(i64 + _tuple_bytes(indptr.shape))
+            out.append(i64 + _tuple_bytes(idx.shape))
+            out.append(data.tobytes() + indptr.tobytes() + idx.tobytes())
+            continue
         a = _np.ascontiguousarray(v.asnumpy())
-        if a.ndim == 0:
-            raise MXNetError(
-                "cannot write %r: the reference format has no 0-dim "
-                "arrays (ndim=0 marks a none-entry); reshape to (1,)"
-                % name)
-        if a.dtype not in _FLAGS:
-            raise MXNetError(
-                "cannot write %r: dtype %s has no mshadow type flag in "
-                "the reference format; cast explicitly (e.g. float32)"
-                % (name, a.dtype))
+        _check_writable(name, a)
         out.append(struct.pack("<Ii", _V2_MAGIC, 0))
-        out.append(struct.pack("<I%dI" % a.ndim, a.ndim, *a.shape))
+        out.append(_tuple_bytes(a.shape))
         out.append(struct.pack("<ii", 1, 0))          # cpu(0)
         out.append(struct.pack("<i", _FLAGS[a.dtype]))
         out.append(a.tobytes())

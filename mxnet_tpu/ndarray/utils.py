@@ -39,8 +39,9 @@ def save(fname, data, format="mxtpu"):
         keyed = False
     else:
         raise MXNetError("save requires NDArray, list or dict")
+    from .sparse import BaseSparseNDArray
     for _, v in items:
-        if not isinstance(v, NDArray):
+        if not isinstance(v, (NDArray, BaseSparseNDArray)):
             raise MXNetError("save requires NDArray values")
     if format not in ("mxtpu", "mxnet"):
         raise MXNetError("unknown save format %r (use 'mxtpu' or "
@@ -54,6 +55,9 @@ def save(fname, data, format="mxtpu"):
         zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
                     (_MAGIC, int(keyed), len(items)))
         for i, (k, v) in enumerate(items):
+            from .sparse import BaseSparseNDArray
+            if isinstance(v, BaseSparseNDArray):
+                v = v.todense()      # zip/NPY layout is dense-only
             buf = io.BytesIO()
             _np.save(buf, v.asnumpy(), allow_pickle=False)
             zf.writestr("%05d:%s" % (i, k), buf.getvalue())

@@ -125,3 +125,64 @@ def test_checkpoint_flow_reads_reference_file(tmp_path):
     sym, args, aux = mx.model.load_checkpoint(prefix, 7)
     np.testing.assert_array_equal(args["fc_weight"].asnumpy(), w)
     assert aux == {}
+
+
+def test_sparse_save_load_round_trip(tmp_path):
+    """Sparse checkpoints write true sparse records and round-trip
+    (review finding: loadable sparse entries must be re-savable)."""
+    from mxnet_tpu.ndarray import sparse
+    rng = np.random.RandomState(4)
+    d = np.zeros((6, 4), np.float32)
+    d[1] = rng.rand(4)
+    d[4] = rng.rand(4)
+    rsp = mx.nd.cast_storage(mx.nd.array(d), "row_sparse")
+    csr = mx.nd.cast_storage(mx.nd.array(d), "csr")
+    path = str(tmp_path / "sp.params")
+    mx.nd.save(path, {"w_rsp": rsp, "w_csr": csr}, format="mxnet")
+    out = mx.nd.load(path)
+    assert out["w_rsp"].stype == "row_sparse"
+    assert out["w_csr"].stype == "csr"
+    np.testing.assert_allclose(out["w_rsp"].todense().asnumpy(), d,
+                               rtol=1e-6)
+    np.testing.assert_allclose(out["w_csr"].todense().asnumpy(), d,
+                               rtol=1e-6)
+    # the zip layout densifies but accepts sparse too
+    path2 = str(tmp_path / "sp.zip")
+    mx.nd.save(path2, {"w": rsp})
+    np.testing.assert_allclose(mx.nd.load(path2)["w"].asnumpy(), d,
+                               rtol=1e-6)
+
+
+def test_export_1d_conv_round_trips(tmp_path):
+    """Regression: 1-D convolutions export spec-valid attribute lengths
+    (strides/dilations/pads were hardcoded 2-D)."""
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3,), num_filter=4, pad=(1,),
+                           name="c1d")
+    f = mx.sym.FullyConnected(mx.sym.Flatten(c), num_hidden=2, name="fc")
+    exe = f.simple_bind(data=(2, 3, 8))
+    rng = np.random.RandomState(5)
+    for n, a in exe.arg_dict.items():
+        if n != "data":
+            a[:] = mx.nd.array(rng.randn(*a.shape).astype(np.float32) * .2)
+    x = rng.randn(2, 3, 8).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+    from mxnet_tpu.contrib import onnx as mxonnx
+    path = str(tmp_path / "c1d.onnx")
+    mxonnx.export_model(
+        f, {n: a for n, a in exe.arg_dict.items() if n != "data"},
+        (2, 3, 8), onnx_file_path=path)
+    blob = open(path, "rb").read()
+    graph = mxonnx._parse(mxonnx._one(mxonnx._parse(blob), 7))
+    node0 = mxonnx._parse(next(iter(mxonnx._all(graph, 1))))
+    attrs = mxonnx._decode_attrs(node0)
+    assert attrs["kernel_shape"] == [3]
+    assert attrs["strides"] == [1] and attrs["pads"] == [1, 1]
+    sym2, args2, aux2 = mxonnx.import_model(path)
+    exe2 = sym2.simple_bind(data=(2, 3, 8))
+    for n, a in args2.items():
+        exe2.arg_dict[n][:] = a
+    exe2.arg_dict["data"][:] = mx.nd.array(x)
+    np.testing.assert_allclose(exe2.forward(is_train=False)[0].asnumpy(),
+                               ref, rtol=1e-4, atol=1e-5)
