@@ -186,3 +186,28 @@ def test_export_1d_conv_round_trips(tmp_path):
     exe2.arg_dict["data"][:] = mx.nd.array(x)
     np.testing.assert_allclose(exe2.forward(is_train=False)[0].asnumpy(),
                                ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_load_parameters_reads_reference_file(tmp_path):
+    """gluon load_parameters flows through the format-aware nd.load, so
+    weight files written by reference gluon (binary, plain names) load
+    directly."""
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(6).randn(2, 3)
+                    .astype(np.float32))
+    net(x)                                    # materialize shapes
+    names = list(net._collect_params_with_prefix())
+    rng = np.random.RandomState(7)
+    weights = {n: rng.randn(*net._collect_params_with_prefix()[n]
+                            .shape).astype(np.float32) for n in names}
+    path = str(tmp_path / "gluon.params")
+    with open(path, "wb") as f:
+        f.write(_file([_dense(weights[n], True) for n in names], names))
+    net.load_parameters(path)
+    for n in names:
+        np.testing.assert_array_equal(
+            net._collect_params_with_prefix()[n].data().asnumpy(),
+            weights[n])
