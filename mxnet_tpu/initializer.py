@@ -311,9 +311,44 @@ class LSTMBias(Initializer):
     def _init_weight(self, name, arr):
         arr[:] = 0.0
         num_hidden = int(arr.shape[0] / 4)
-        b = arr.asnumpy()
+        b = arr.asnumpy().copy()        # asnumpy views can be read-only
         b[num_hidden:2 * num_hidden] = self.forget_bias
         arr._set_data(nd_array(b, ctx=arr.context, dtype=arr.dtype)._data)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initializer twin of the reference's FusedRNN (initializer.py
+    FusedRNN): the reference unpacks a cuDNN-fused parameter blob; this
+    build's FusedRNNCell keeps per-gate named parameters, so weights
+    delegate to the wrapped initializer and LSTM biases receive the
+    ``forget_bias`` on the forget-gate quarter."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if init is None:
+            raise MXNetError("FusedRNN requires a wrapped initializer")
+        if isinstance(init, str):
+            # reference-compatible: a dumps() JSON spec
+            name, kwargs = json.loads(init)
+            init = create(name, **kwargs)
+        super().__init__(init=init.dumps(), num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._mode = mode
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        self._init(InitDesc(name), arr)
+
+    def _init_bias(self, name, arr):
+        if self._mode == "lstm" and arr.ndim == 1 \
+                and arr.shape[0] % 4 == 0:
+            LSTMBias(self._forget_bias)._init_weight(name, arr)
+            return
+        super()._init_bias(name, arr)
 
 
 @register

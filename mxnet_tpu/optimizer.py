@@ -606,6 +606,93 @@ class DCASGD(Optimizer):
 
 
 @register
+class LBSGD(Optimizer):
+    """Large-Batch SGD (reference: optimizer.py:672 LBSGD).
+
+    Per layer, gradients accumulate for ``batch_scale`` micro-batches;
+    then ONE momentum-SGD step applies with the learning rate scaled by
+    the warmup schedule ('linear' / 'power2' / 'sqrt' toward
+    batch_scale over warmup_epochs) or by the LARS trust ratio
+    sqrt(||w||^2 / (||g||^2 + wd*||w||^2)) when
+    warmup_strategy='lars'. The standard recipe for scaling batch size
+    with worker count — particularly relevant on pod-scale dp meshes.
+    """
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = int(batch_scale)
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self._cum = {}                     # index -> [cum_grad, n]
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def _warmup_mult(self, nup):
+        import math
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            return maxmult
+        if nwup <= 1:
+            return 1.0
+        if self.warmup_strategy == "linear":
+            return 1.0 + (maxmult - 1) * nup / nwup
+        if self.warmup_strategy == "power2":
+            return 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+        if self.warmup_strategy == "sqrt":
+            return 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+        return 1.0
+
+    def _lars(self, weight, grad, wd):
+        import math
+        w2 = float((weight * weight).asnumpy().sum())
+        g2 = float((grad * grad).asnumpy().sum())
+        lars = math.sqrt(w2 / (g2 + wd * w2 + 1e-18))
+        return min(max(lars, 0.01), 100.0)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad):
+            grad = grad.todense()
+        if self.batch_scale > 1:
+            # accumulate per layer; the per-index counter starts at 1 so
+            # the modulus is phase-aligned regardless of begin_epoch
+            # (the resume offset only advances the warmup schedule)
+            cum = self._cum.get(index)
+            if cum is None or cum[1] % self.batch_scale == 0:
+                self._cum[index] = cum = [grad.copy(), 1]
+            else:
+                cum[0]._set_data((cum[0] + grad)._data)
+                cum[1] += 1
+            if cum[1] % self.batch_scale != 0:
+                return                      # accumulating micro-batch
+            grad = cum[0] / self.batch_scale
+            nup = self.init_updates + cum[1]
+        else:
+            nup = self.init_updates + self.num_update
+        if self.warmup_strategy == "lars":
+            lr = lr * self._lars(weight, grad, wd)
+        else:
+            lr = lr * self._warmup_mult(nup)
+        kw = _common_kwargs(self)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+
+@register
 class Test(Optimizer):
     """Test optimizer: simple accumulating SGD (reference: optimizer.py Test)."""
 

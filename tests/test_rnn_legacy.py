@@ -166,3 +166,28 @@ def test_bucketing_module_lstm_lm_trains():
         if first is None:
             first = ppl
     assert ppl < first * 0.7, (first, ppl)
+
+
+def test_lbsgd_and_fused_rnn_init():
+    """Parity fillers: LBSGD (reference optimizer.py:672) and
+    init.FusedRNN (per-gate delegation + LSTM forget bias)."""
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, batch_scale=2,
+                              warmup_strategy="linear", warmup_epochs=0,
+                              updates_per_epoch=1)
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.full((3,), 0.5, np.float32))
+    st = opt.create_state(0, w)
+    opt.update(0, w, g, st)
+    np.testing.assert_allclose(w.asnumpy(), 1.0)       # accumulating
+    opt.update(0, w, g, st)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.2 * 0.5, rtol=1e-6)
+
+    init = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=4, num_layers=1,
+                            mode="lstm", forget_bias=2.0)
+    b = mx.nd.zeros((16,))
+    init(mx.init.InitDesc("lstm_l0_i2h_bias"), b)
+    bb = b.asnumpy()
+    assert (bb[4:8] == 2.0).all() and (bb[:4] == 0).all()
+    wt = mx.nd.zeros((16, 8))
+    init(mx.init.InitDesc("lstm_l0_i2h_weight"), wt)
+    assert float(np.abs(wt.asnumpy()).sum()) > 0
