@@ -191,3 +191,21 @@ def test_lbsgd_and_fused_rnn_init():
     wt = mx.nd.zeros((16, 8))
     init(mx.init.InitDesc("lstm_l0_i2h_weight"), wt)
     assert float(np.abs(wt.asnumpy()).sum()) > 0
+
+
+def test_lstm_bucketing_example_cli(tmp_path):
+    """The lstm_bucketing example CLI trains end-to-end (subprocess, as
+    a user runs it)."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples",
+                                      "lstm_bucketing.py"),
+         "--num-epochs", "2", "--num-hidden", "16", "--num-embed", "16",
+         "--batch-size", "16", "--buckets", "8,16"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "Train-perplexity" in r.stderr or "Train-perplexity" in r.stdout
