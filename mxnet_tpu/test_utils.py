@@ -286,3 +286,24 @@ def check_consistency(f, inputs, dtypes=("float64", "float32", "float16"),
                                 names=("out[%d][%s]" % (i, dt),
                                        "out[%d][%s]" % (i, dtypes[0])))
     return results
+
+
+def list_gpus():
+    """Indices of attached accelerator devices (reference:
+    test_utils.py list_gpus — probes nvidia-smi; here the accelerator
+    set comes from the JAX backend)."""
+    import jax
+    try:
+        return list(range(len([d for d in jax.local_devices()
+                               if d.platform != "cpu"])))
+    except Exception:
+        return []
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution="uniform"):
+    """A random sparse NDArray plus its dense twin (reference:
+    test_utils.py rand_sparse_ndarray; returns (sparse, dense))."""
+    arr = rand_ndarray(shape, stype=stype, density=density, dtype=dtype,
+                       distribution=distribution)
+    return arr, arr.todense().asnumpy()
