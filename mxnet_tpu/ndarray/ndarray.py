@@ -214,6 +214,13 @@ class NDArray:
                          {"num_outputs": num_outputs, "axis": axis,
                           "squeeze_axis": squeeze_axis})
 
+    def slice(self, begin, end, step=None):
+        """Reference: ndarray slice method (tensor/matrix_op.cc slice)."""
+        attrs = {"begin": tuple(begin), "end": tuple(end)}
+        if step is not None:
+            attrs["step"] = tuple(step)
+        return invoke_op("slice", [self], attrs)
+
     def take(self, indices, axis=0, mode="clip"):
         return invoke_op("take", [self, indices], {"axis": axis, "mode": mode})
 

@@ -197,6 +197,23 @@ class Symbol(object):
             raise IndexError("index %d out of range" % index)
         return Symbol([self._entries[index]])
 
+    def debug_str(self):
+        """Human-readable graph dump (reference: symbol.py debug_str)."""
+        lines = []
+        for node in _topo(self._entries):
+            if node.is_var:
+                lines.append("Variable:%s" % node.name)
+                continue
+            ins = ", ".join("%s[%d]" % (src.name, oi)
+                            for src, oi in node.inputs)
+            attrs = " ".join("%s=%r" % kv for kv in
+                             sorted(node.attrs.items())
+                             if not kv[0].startswith("__"))
+            lines.append("Op:%s, Name=%s\n  inputs: %s%s"
+                         % (node.op, node.name, ins,
+                            ("\n  attrs: " + attrs) if attrs else ""))
+        return "\n".join(lines) + "\n"
+
     def get_internals(self):
         """All intermediate outputs as a grouped symbol
         (reference: symbol.py get_internals)."""
