@@ -76,7 +76,12 @@ class MXRecordIO(object):
         self.open()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            # interpreter shutdown may have torn down class globals
+            # (super() in subclasses raises); nothing left to release
+            pass
 
     def __getstate__(self):
         """Support pickling across DataLoader workers

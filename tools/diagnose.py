@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Diagnose the runtime environment (reference: tools/diagnose.py —
+prints platform / framework / hardware / connectivity info for bug
+reports). The TPU build reports the JAX/XLA stack and device topology
+instead of the reference's CUDA probes; there is no network section
+(deployments are airgapped pods more often than not).
+
+Run: python tools/diagnose.py
+"""
+import os
+import platform
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def section(title):
+    print("----------" + title + "----------", flush=True)
+
+
+def main():
+    section("Python Info")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+    section("Platform Info")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+    print("processor    :", platform.processor() or "n/a")
+    print("cpu count    :", os.cpu_count())
+
+    section("Environment")
+    for k in sorted(os.environ):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_", "LIBTPU_")):
+            print("%s=%s" % (k, os.environ[k]))
+
+    section("Framework Info")
+    t0 = time.time()
+    import mxnet_tpu as mx
+    print("mxnet_tpu    :", mx.__version__)
+    print("import time  : %.3fs" % (time.time() - t0))
+    print("location     :", os.path.dirname(os.path.abspath(mx.__file__)))
+    from mxnet_tpu.libinfo import find_lib_path
+    print("native libs  :", find_lib_path() or "(not built)")
+    from mxnet_tpu.ops.registry import list_ops
+    print("ops          :", len(list_ops()))
+
+    section("JAX / XLA Info")
+    import jax
+    import jaxlib
+    print("jax          :", jax.__version__)
+    print("jaxlib       :", jaxlib.__version__)
+
+    section("Device Info")
+    # a wedged accelerator tunnel hangs enumeration; probe in a bounded
+    # subprocess like the bench harness does
+    from mxnet_tpu.benchmark import probe_device
+    t0 = time.time()
+    plat = probe_device(timeout=60)
+    if plat is None:
+        print("devices      : UNREACHABLE (enumeration timed out; the "
+              "accelerator tunnel may be wedged)")
+    else:
+        print("platform     :", plat)
+        print("probe time   : %.1fs" % (time.time() - t0))
+        if plat == "cpu":
+            print("note         : no accelerator attached; running on "
+                  "host CPU")
+        else:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax;"
+                 "print([str(d) for d in jax.devices()]);"
+                 "print(jax.device_count(), jax.local_device_count(),"
+                 "jax.process_count())"],
+                capture_output=True, text=True, timeout=120, cwd=REPO)
+            print(r.stdout.strip())
+
+
+if __name__ == "__main__":
+    main()

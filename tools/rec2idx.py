@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Rebuild the .idx file for a RecordIO .rec (reference:
+tools/rec2idx.py — recovers the index when only the record file
+survived, enabling MXIndexedRecordIO random access again).
+
+Usage: python tools/rec2idx.py data.rec data.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", help="path of the .idx file to write")
+    args = ap.parse_args()
+
+    from mxnet_tpu import recordio
+    reader = recordio.MXRecordIO(args.record, "r")
+    count = 0
+    with open(args.index, "w") as idx:
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            idx.write("%d\t%d\n" % (count, pos))
+            count += 1
+    reader.close()
+    print("wrote %d entries to %s" % (count, args.index))
+
+
+if __name__ == "__main__":
+    main()
