@@ -24,7 +24,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, ROOT)
 
 from mxnet_tpu.benchmark import (  # noqa: E402
-    BASELINES, BENCH_DIR, load_results)
+    BASELINES, BENCH_DIR, HARNESS_GEN, load_results)
 
 HEADLINE = "resnet50_train_img_per_sec"
 BASELINE_IMG_S = BASELINES[HEADLINE]
@@ -78,6 +78,13 @@ def _live_run(timeout=900):
     return False
 
 
+def _verified(rec):
+    """Only fetch-synced (harness >= 2) measurements are headline-worthy:
+    the axon transport can satisfy block_until_ready early, so harness-1
+    numbers may be inflated (one read 3x the chip's physical peak)."""
+    return rec.get("harness", 1) >= HARNESS_GEN
+
+
 def main():
     _quiesce_daemon()
     _live_run()  # on success this persists into .bench/results.json
@@ -85,24 +92,37 @@ def main():
 
     # headline = the strongest banked ResNet-50 *training* point relative
     # to its own reference baseline (the bf16/b128 run is the chip-native
-    # configuration; fp32/b32 remains the fallback anchor)
-    best = None
-    for cand in ("resnet50_train_b128_bf16_img_per_sec",
-                 "resnet50_train_b128_img_per_sec",
-                 HEADLINE,
-                 "resnet50_train_bf16_img_per_sec"):
-        rec = results.get(cand)
-        if rec and rec.get("vs_baseline"):
-            if best is None or rec["vs_baseline"] > best.get("vs_baseline",
-                                                            0):
-                best = rec
+    # configuration; fp32/b32 remains the fallback anchor). A harness-1
+    # record is NEVER headlined as verified: if nothing fetch-synced is
+    # banked, the best harness-1 value is reported with an explicit
+    # "unverified:" metric name instead.
+    train_cands = ("resnet50_train_b128_bf16_img_per_sec",
+                   "resnet50_train_b128_img_per_sec",
+                   HEADLINE,
+                   "resnet50_train_bf16_img_per_sec")
+    fallbacks = (HEADLINE, "resnet50_train_bf16_img_per_sec",
+                 "resnet50_infer_img_per_sec",
+                 "transformer_lm_tokens_per_sec", "mlp_train_img_per_sec")
+
+    def pick(pred):
+        best = None
+        for cand in train_cands:
+            rec = results.get(cand)
+            if rec and pred(rec) and rec.get("vs_baseline"):
+                if best is None or rec["vs_baseline"] > best["vs_baseline"]:
+                    best = rec
+        if best is None:
+            for alt in fallbacks:
+                rec = results.get(alt)
+                if rec and pred(rec):
+                    return rec
+        return best
+
+    best = pick(_verified)
+    unverified = False
     if best is None:
-        # secondary fallbacks so *some* measured number lands
-        for alt in (HEADLINE, "resnet50_train_bf16_img_per_sec",
-                    "resnet50_infer_img_per_sec", "mlp_train_img_per_sec"):
-            if alt in results:
-                best = results[alt]
-                break
+        best = pick(lambda r: True)
+        unverified = best is not None
     if best is None:
         print(json.dumps({
             "metric": HEADLINE,
@@ -114,13 +134,26 @@ def main():
         }), flush=True)
         return
 
-    out = {"metric": best["metric"], "value": best["value"],
+    name = best["metric"] if not unverified else "unverified:" + best["metric"]
+    out = {"metric": name, "value": best["value"],
            "unit": best["unit"],
-           "vs_baseline": best.get("vs_baseline", 0.0)}
+           "vs_baseline": best.get("vs_baseline", 0.0),
+           "harness": best.get("harness", 1)}
+    if unverified:
+        out["warning"] = ("no fetch-synced (harness-2) measurement banked; "
+                          "this value used the weaker block_until_ready "
+                          "sync and may be inflated")
     # attach every other banked metric as supplementary evidence
-    extras = {k: {"value": v["value"], "unit": v["unit"],
-                  "vs_baseline": v.get("vs_baseline")}
-              for k, v in sorted(results.items()) if k != best["metric"]}
+    extras = {}
+    for k, v in sorted(results.items()):
+        if k == best["metric"]:
+            continue
+        e = {"value": v["value"], "unit": v["unit"],
+             "vs_baseline": v.get("vs_baseline"),
+             "harness": v.get("harness", 1)}
+        if not _verified(v):
+            e["unverified"] = True
+        extras[k] = e
     if extras:
         out["supplementary"] = extras
     print(json.dumps(out), flush=True)

@@ -64,9 +64,35 @@ PEAK_FLOPS_BF16 = float(os.environ.get("MXNET_TPU_PEAK_FLOPS", 197e12))
 
 def peak_flops(dtype):
     return PEAK_FLOPS_BF16  # dtype-invariant on v5e (see note above)
+
+
+# FLOP convention for every MFU estimate in this module (self-describing:
+# the convention string is persisted next to each mfu_est). He et al.'s
+# "4.09 G" ResNet-50 figure is read as multiply-accumulates, x2 for
+# FLOPs; a train step counts fwd + 2x bwd = 3x forward. Under the
+# CONSERVATIVE reading (4.09 G already = FLOPs) every mfu_est here
+# halves — that lower bound is persisted as mfu_conservative.
+FLOP_CONVENTION = "GMAC/img x2 (MAC->FLOP) fwd; train = 3x fwd"
 RESNET50_GFLOP_PER_IMG = 4.09 * 2  # fwd GFLOPs (He et al.); x2 MACs->FLOPs
 # train step ~= 3x forward (fwd + 2x bwd)
 RESNET50_TRAIN_GFLOP_PER_IMG = 3 * RESNET50_GFLOP_PER_IMG
+
+# above this, a conv-net MFU estimate is suspicious (well-tuned conv
+# nets rarely exceed ~60% MFU; matmul-dominated transformers can)
+MFU_PLAUSIBLE_CONV = 0.60
+
+
+def _mfu_extra(mfu, pk, convention=None, conv_net=True):
+    """Self-describing MFU annotation persisted next to every estimate."""
+    extra = {"mfu_est": round(mfu, 4), "peak_flops": pk,
+             "flop_convention": convention or FLOP_CONVENTION}
+    if convention is None:
+        extra["mfu_conservative"] = round(mfu / 2, 4)
+    if conv_net and mfu > MFU_PLAUSIBLE_CONV:
+        extra["mfu_warning"] = (
+            "mfu_est %.2f exceeds the ~%.2f plausibility bound for "
+            "conv nets; treat with suspicion" % (mfu, MFU_PLAUSIBLE_CONV))
+    return extra
 
 # forward GFLOPs/image at the standard input size (2x MACs), used to
 # sanity-gate measurements: a reading implying more FLOP/s than the
@@ -240,7 +266,7 @@ def _measure_train(trainer, batch, image, num_classes, iters, dtype,
                 "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
                 "— transport not blocking, refusing to bank"
                 % (img_s, mfu))
-        extra.update(mfu_est=round(mfu, 4), peak_flops=pk)
+        extra.update(_mfu_extra(mfu, pk))
     return img_s, extra
 
 
@@ -380,6 +406,67 @@ def train_inception(batch=32, dtype="float32", iters=10):
         fwd_gflop_per_img=MODEL_GFLOP_PER_IMG["inception-v3"])
 
 
+def train_transformer_lm(batch=8, seq=1024, dtype="bfloat16", iters=10,
+                         d_model=1024, n_heads=16, n_layers=12, d_ff=4096,
+                         vocab=32768):
+    """Single-chip tokens/s for the 5-axis transformer LM
+    (parallel/transformer.py) on a dense config at seq >= 1024, with the
+    Pallas flash-attention kernel compiled through real Mosaic on TPU
+    (interpret=False is the on-TPU default in ring_attention). The mesh
+    is (1,1,1,1,1) so the exact multi-chip code path runs — size-1 axes
+    degrade to identity collectives. Reference capability target:
+    SURVEY §5 long-context row (the reference itself has no transformer
+    LM benchmark; tokens/s is reported without a vs_baseline)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from .parallel.transformer import (
+        TransformerConfig, init_transformer_params,
+        make_transformer_train_step)
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_len=seq,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    step = make_transformer_train_step(cfg, mesh, lr=0.01)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (batch, seq)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, vocab, (batch, seq)), jnp.int32)
+    state = [params]
+
+    def one():
+        state[0], loss = step(state[0], tokens, targets)
+        return loss
+
+    def _sync(loss):
+        return (loss, state[0]["embed"])
+
+    t0 = time.time()
+    dt = _timeit(one, warmup=3, iters=iters, sync=_sync)
+    log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
+    tok_s = batch * seq / dt
+    # decoder train FLOPs/token ~= 6*N (fwd+bwd matmuls) plus the
+    # attention score/value term 12*L*d*s, halved by causal masking
+    flop_per_tok = 6 * n_params + 12 * n_layers * d_model * seq * 0.5
+    pk = peak_flops(dtype)
+    mfu = tok_s * flop_per_tok / pk
+    if mfu > 1.05:
+        raise RuntimeError(
+            "implausible measurement: %.0f tok/s implies MFU %.2f > 1 "
+            "— transport not blocking, refusing to bank" % (tok_s, mfu))
+    extra = {"ms_per_step": round(dt * 1e3, 1), "dtype": dtype,
+             "batch": batch, "seq": seq, "n_params": n_params,
+             "attn": "pallas flash (ring path, 1-device mesh)"}
+    extra.update(_mfu_extra(mfu, pk, conv_net=False,
+                            convention="6N + 12*L*d*s/2 FLOP/token, train"))
+    return tok_s, extra
+
+
 def train_mlp(batch=64, iters=50):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run."""
@@ -481,7 +568,8 @@ def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
              "batch": batch}
     if gflop:
         tflops = img_s * gflop * 1e9
-        extra["mfu_est"] = round(tflops / peak_flops(dtype), 4)
+        mfu = tflops / peak_flops(dtype)
+        extra.update(_mfu_extra(mfu, peak_flops(dtype)))
         if tflops > 1.05 * peak_flops(dtype):
             raise RuntimeError(
                 "implausible measurement: %s %.0f img/s implies %.0f "
@@ -529,6 +617,12 @@ def _job_inception_train():
                    "img/s (batch 32, fp32, 1 chip)", x)
 
 
+def _job_transformer_lm():
+    v, x = train_transformer_lm()
+    return persist("transformer_lm_tokens_per_sec", v,
+                   "tok/s (GPT ~185M, batch 8, seq 1024, bf16, 1 chip)", x)
+
+
 def _job_data_pipeline():
     v, x = data_pipeline()
     return persist("data_pipeline_img_per_sec", v,
@@ -550,6 +644,7 @@ def _make_infer_job(model, dtype, batch=32):
 JOBS = {
     "mlp_train": _job_mlp_train,
     "data_pipeline": _job_data_pipeline,
+    "transformer_lm": _job_transformer_lm,
     "inception-v3_train": _job_inception_train,
     "resnet50_train": _job_resnet50_train,
     "resnet50_train_bf16": _job_resnet50_train_bf16,
@@ -569,6 +664,7 @@ JOB_PRIORITY = [
     "data_pipeline",
     "resnet50_train",
     "resnet50_train_bf16",
+    "transformer_lm",
     "resnet50_infer",
     "resnet50_infer_bf16",
     "resnet50_train_b128",
