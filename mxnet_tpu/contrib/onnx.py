@@ -307,10 +307,18 @@ def _export_node(node, in_names, out_name, params):
         a = [_attr_float("epsilon", float(attrs.get("eps", 1e-3))),
              _attr_float("momentum", float(attrs.get("momentum", 0.9)))]
         in_names = list(in_names)
-        if str(attrs.get("fix_gamma", "True")).lower() in ("true", "1") \
-                and in_names[1] in params:
+        if str(attrs.get("fix_gamma", "True")).lower() in ("true", "1"):
             # the op ignores gamma under fix_gamma; ONNX has no such
-            # flag, so export a ones scale initializer instead
+            # flag, so export a ones scale initializer instead. When
+            # gamma is a graph input (not in params) we cannot know the
+            # channel count to synthesize ones — refuse rather than
+            # silently exporting the trained (ignored-at-runtime) gamma
+            if in_names[1] not in params:
+                raise ValueError(
+                    "cannot export BatchNorm %r: fix_gamma=True but "
+                    "gamma %r is a graph input, not a bound parameter "
+                    "— bind gamma or set fix_gamma=False" %
+                    (name, in_names[1]))
             gname = name + "_fixed_gamma"
             if gname not in params:
                 params[gname] = _np.ones_like(params[in_names[1]])

@@ -70,7 +70,10 @@ def _read_tuple(cur, dim64):
     fmt = "<%d%s" % (ndim, "q" if dim64 else "I")
     size = 8 * ndim if dim64 else 4 * ndim
     dims = struct.unpack(fmt, cur.read(size))
-    if any(d <= 0 or d > 2 ** 40 for d in dims):
+    # d == 0 is legal (zero-size arrays, e.g. an empty row_sparse with 0
+    # stored rows); only negatives and absurd magnitudes disambiguate
+    # the dim width
+    if any(d < 0 or d > 2 ** 40 for d in dims):
         raise MXNetError("implausible dims %s" % (dims,))
     return tuple(int(d) for d in dims)
 

@@ -665,12 +665,16 @@ class LBSGD(Optimizer):
         if _is_row_sparse(grad):
             grad = grad.todense()
         if self.batch_scale > 1:
-            # accumulate per layer; the per-index counter starts at 1 so
-            # the modulus is phase-aligned regardless of begin_epoch
-            # (the resume offset only advances the warmup schedule)
+            # accumulate per layer; the micro-batch counter is MONOTONIC
+            # for the whole run (the reference's num_cums) so the warmup
+            # schedule advances — only the accumulated gradient resets
+            # at each macro-batch boundary
             cum = self._cum.get(index)
-            if cum is None or cum[1] % self.batch_scale == 0:
+            if cum is None:
                 self._cum[index] = cum = [grad.copy(), 1]
+            elif cum[1] % self.batch_scale == 0:
+                cum[0] = grad.copy()
+                cum[1] += 1
             else:
                 cum[0]._set_data((cum[0] + grad)._data)
                 cum[1] += 1

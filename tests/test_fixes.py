@@ -277,3 +277,46 @@ def test_grad_create_graph_duplicate_variables():
     np.testing.assert_allclose(gs[1].asnumpy(), [12.0], rtol=1e-5)
     gs[0].backward()
     np.testing.assert_allclose(w.grad.asnumpy(), [4.0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-4 ADVICE regressions
+# ---------------------------------------------------------------------------
+
+def test_lbsgd_warmup_progresses_with_batch_scale():
+    """ADVICE r4: the warmup multiplier must RAMP across macro-batches
+    (monotonic micro-batch count, reference optimizer.py:799-815), not
+    stay pinned near 1.0 because the counter resets every macro-batch."""
+    opt = mx.optimizer.create(
+        "lbsgd", learning_rate=1.0, batch_scale=2, momentum=0.0,
+        warmup_strategy="linear", warmup_epochs=1, updates_per_epoch=10)
+    w = nd.array(np.zeros((1,), np.float32))
+    g = nd.array(np.ones((1,), np.float32))
+    steps = []
+    prev = 0.0
+    for _ in range(8):                      # 8 micro = 4 macro batches
+        opt.update(0, w, g, opt.create_state(0, w))
+        cur = float(w.asnumpy()[0])
+        if cur != prev:                     # a macro step applied
+            steps.append(prev - cur)        # effective lr * grad
+            prev = cur
+    assert len(steps) == 4
+    # nwup = 10 micro-updates; multiplier = 1 + (2-1)*nup/10 with
+    # nup = 2, 4, 6, 8 -> strictly increasing effective lr
+    assert all(b > a for a, b in zip(steps, steps[1:])), steps
+    np.testing.assert_allclose(steps, [1.2, 1.4, 1.6, 1.8], rtol=1e-5)
+
+
+def test_onnx_batchnorm_fix_gamma_unbound_raises(tmp_path):
+    """ADVICE r4: fix_gamma=True with gamma as a free graph input must
+    refuse to export (silently shipping trained gamma diverges)."""
+    from mxnet_tpu.contrib import onnx as mxonnx
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=True, name="bn0")
+    # bind only the non-gamma params: gamma stays a graph input
+    params = {"bn0_beta": nd.zeros((3,)),
+              "bn0_moving_mean": nd.zeros((3,)),
+              "bn0_moving_var": nd.ones((3,))}
+    with pytest.raises(ValueError, match="fix_gamma"):
+        mxonnx.export_model(bn, params, (1, 3, 4, 4),
+                            onnx_file_path=str(tmp_path / "bn.onnx"))

@@ -211,3 +211,15 @@ def test_gluon_load_parameters_reads_reference_file(tmp_path):
         np.testing.assert_array_equal(
             net._collect_params_with_prefix()[n].data().asnumpy(),
             weights[n])
+
+
+def test_empty_row_sparse_round_trip(tmp_path):
+    """ADVICE r4: a zero-stored-rows row_sparse array (storage shape
+    (0, D)) must load back — d == 0 dims are legal, not 'implausible'."""
+    rsp = mx.nd.cast_storage(mx.nd.zeros((6, 4)), "row_sparse")
+    path = str(tmp_path / "empty.params")
+    mx.nd.save(path, {"w": rsp}, format="mxnet")
+    out = mx.nd.load(path)
+    assert out["w"].stype == "row_sparse"
+    np.testing.assert_allclose(out["w"].todense().asnumpy(),
+                               np.zeros((6, 4), np.float32))
