@@ -23,7 +23,7 @@ _SRC = os.path.join(_ROOT, "src", "native")
 _OUT = os.path.join(_ROOT, "build", "native")
 
 
-def _build(name, sources):
+def _build(name, sources, flags=()):
     os.makedirs(_OUT, exist_ok=True)
     lib_path = os.path.join(_OUT, "lib%s.so" % name)
     srcs = [os.path.join(_SRC, s) for s in sources]
@@ -31,19 +31,19 @@ def _build(name, sources):
             os.path.getmtime(lib_path) >= os.path.getmtime(s) for s in srcs):
         return lib_path
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", lib_path] \
-        + srcs
+        + srcs + list(flags)
     subprocess.run(cmd, check=True, capture_output=True)
     return lib_path
 
 
-def load(name, sources):
+def load(name, sources, flags=()):
     """Build (if needed) + dlopen lib<name>.so from src/native sources.
     Returns the ctypes CDLL, or None when the toolchain is unavailable."""
     with _lock:
         if name in _cache:
             return _cache[name]
         try:
-            lib = ctypes.CDLL(_build(name, sources))
+            lib = ctypes.CDLL(_build(name, sources, flags))
         except Exception:
             lib = None
         _cache[name] = lib
@@ -69,4 +69,32 @@ def recordio_lib():
         lib.rio_tell.argtypes = [ctypes.c_void_p]
         lib.rio_free.argtypes = [ctypes.c_char_p]
         lib._rio_typed = True
+    return lib
+
+
+def imagedec_lib():
+    """Parallel JPEG decode+augment pool (src/native/imagedec.cc; the
+    analog of the reference's OMP ParseChunk hot path). Needs the
+    system OpenCV C++ libs; returns None when they're absent."""
+    lib = load("imagedec", ["imagedec.cc"],
+               flags=["-I/usr/include/opencv4", "-pthread",
+                      "-lopencv_core", "-lopencv_imgcodecs",
+                      "-lopencv_imgproc"])
+    if lib is not None and not getattr(lib, "_img_typed", False):
+        u8pp = ctypes.POINTER(ctypes.c_char_p)
+        lib.imgdec_create.restype = ctypes.c_void_p
+        lib.imgdec_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64]
+        lib.imgdec_decode_batch.restype = ctypes.c_int
+        lib.imgdec_decode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, u8pp,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.imgdec_last_error.restype = ctypes.c_char_p
+        lib.imgdec_last_error.argtypes = [ctypes.c_void_p]
+        lib.imgdec_destroy.argtypes = [ctypes.c_void_p]
+        lib._img_typed = True
     return lib

@@ -373,15 +373,102 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+class NativeImageDecoder(object):
+    """ctypes front for the C++ parallel decode pool
+    (src/native/imagedec.cc — the analog of the reference's OMP decode
+    in src/io/iter_image_recordio_2.cc:78 ParseChunk). One call decodes
+    + augments a whole batch of JPEG buffers into a float32 CHW array
+    on native threads with the GIL released."""
+
+    def __init__(self, data_shape, resize=0, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None, num_threads=0,
+                 seed=0):
+        import ctypes
+        from . import _native
+        lib = _native.imagedec_lib()
+        if lib is None:
+            raise MXNetError("native image decoder unavailable "
+                             "(no g++/OpenCV)")
+        c, h, w = data_shape
+        if c not in (1, 3):
+            raise MXNetError("native decoder supports 1 or 3 channels")
+        if mean is True:
+            mean = _np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = _np.array([58.395, 57.12, 57.375])
+
+        def fptr(v):
+            if v is None:
+                return None
+            a = _np.ascontiguousarray(
+                _np.broadcast_to(_np.asarray(_to_np(v), _np.float32)
+                                 .ravel(), (3,)) if c == 3
+                else _np.asarray(_to_np(v), _np.float32).ravel()[:1])
+            return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+        m, s = fptr(mean), fptr(std)
+        self._keep = (m, s)                    # keep buffers alive
+        self._lib = lib
+        self._ctypes = ctypes
+        self._shape = (c, h, w)
+        self._h = lib.imgdec_create(
+            int(num_threads), h, w, c, int(resize), int(bool(rand_crop)),
+            int(bool(rand_mirror)), m and m[1], s and s[1], int(seed))
+        if not self._h:
+            raise MXNetError("imgdec_create failed")
+
+    def decode_batch(self, bufs, base=0, out=None):
+        """Decode ``bufs`` (list of JPEG bytes) -> (n, c, h, w) float32.
+        ``base`` keys the per-image augmentation RNG by stream position
+        so results are identical for any thread count."""
+        ctypes = self._ctypes
+        n = len(bufs)
+        c, h, w = self._shape
+        if out is None:
+            out = _np.empty((n, c, h, w), _np.float32)
+        arr_p = (ctypes.c_char_p * n)(*bufs)
+        lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
+        rc = self._lib.imgdec_decode_batch(
+            self._h, n, arr_p, lens, int(base),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise MXNetError("native decode failed: %s" %
+                             self._lib.imgdec_last_error(self._h)
+                             .decode("utf-8", "replace"))
+        return out
+
+    def close(self):
+        h, self._h = self._h, None
+        if h and self._lib is not None:
+            self._lib.imgdec_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# CreateAugmenter kwargs the native decoder implements; anything else
+# (rand_resize, color jitter, pca_noise, non-cubic interp) falls back
+# to the Python augmenter loop
+_NATIVE_AUG_KEYS = {"resize", "rand_crop", "rand_mirror", "mean", "std"}
+
+
 class ImageIter(object):
     """Image data iterator over .rec packs or path lists with augmentation
     (reference: image.py ImageIter, C++ hot path
-    src/io/iter_image_recordio_2.cc)."""
+    src/io/iter_image_recordio_2.cc).
+
+    ``preprocess_threads`` > 0 engages the native parallel decode pool
+    (NativeImageDecoder) when the requested augmentations are in its
+    fast path; 0 keeps the pure-Python per-image loop."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, aug_list=None, imglist=None, dtype="float32",
-                 num_parts=1, part_index=0, **kwargs):
+                 num_parts=1, part_index=0, preprocess_threads=0,
+                 seed=0, **kwargs):
         from .io import DataDesc
         assert path_imgrec or path_imglist or imglist is not None
         self.batch_size = batch_size
@@ -393,6 +480,21 @@ class ImageIter(object):
         self._part_index = int(part_index)
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **kwargs)
+        self._native = None
+        self._stream_pos = 0                  # RNG key for native augs
+        if preprocess_threads and aug_list is None and dtype == "float32" \
+                and all(k in _NATIVE_AUG_KEYS or not kwargs[k]
+                        for k in kwargs if k != "inter_method") \
+                and kwargs.get("inter_method", 2) == 2:
+            try:
+                self._native = NativeImageDecoder(
+                    data_shape, resize=kwargs.get("resize", 0),
+                    rand_crop=kwargs.get("rand_crop", False),
+                    rand_mirror=kwargs.get("rand_mirror", False),
+                    mean=kwargs.get("mean"), std=kwargs.get("std"),
+                    num_threads=preprocess_threads, seed=seed)
+            except MXNetError:
+                self._native = None           # no toolchain: Python path
         self.imgrec = None
         self.imglist = None
         self.seq = None
@@ -472,21 +574,37 @@ class ImageIter(object):
         batch_label = _np.zeros((self.batch_size, self.label_width),
                                 dtype=_np.float32)
         i = 0
-        try:
-            while i < self.batch_size:
-                label, s = self.next_sample()
-                img = imdecode(s, 1 if c == 3 else 0, to_ndarray=False)
-                for aug in self.auglist:
-                    img = aug(img)
-                arr = _to_np(img)
-                if arr.ndim == 3:
-                    arr = arr.transpose(2, 0, 1)
-                batch_data[i] = arr
-                batch_label[i] = label
-                i += 1
-        except StopIteration:
-            if i == 0:
-                raise
+        if self._native is not None:
+            bufs = []
+            try:
+                while i < self.batch_size:
+                    label, s = self.next_sample()
+                    bufs.append(bytes(s))
+                    batch_label[i] = label
+                    i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
+            if bufs:
+                self._native.decode_batch(bufs, base=self._stream_pos,
+                                          out=batch_data[:len(bufs)])
+                self._stream_pos += len(bufs)
+        else:
+            try:
+                while i < self.batch_size:
+                    label, s = self.next_sample()
+                    img = imdecode(s, 1 if c == 3 else 0, to_ndarray=False)
+                    for aug in self.auglist:
+                        img = aug(img)
+                    arr = _to_np(img)
+                    if arr.ndim == 3:
+                        arr = arr.transpose(2, 0, 1)
+                    batch_data[i] = arr
+                    batch_label[i] = label
+                    i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
         pad = self.batch_size - i
         lbl = batch_label[:, 0] if self.label_width == 1 \
             else batch_label

@@ -686,8 +686,14 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
         mean = _np.array([mean_r, mean_g, mean_b])
     if (std_r, std_g, std_b) != (1, 1, 1):
         std = _np.array([std_r, std_g, std_b])
-    kwargs.pop("preprocess_threads", None)
-    return ImageIter(batch_size=batch_size, data_shape=data_shape,
-                     path_imgrec=path_imgrec, shuffle=shuffle,
-                     rand_crop=rand_crop, rand_mirror=rand_mirror,
-                     mean=mean, std=std, **kwargs)
+    prefetch = kwargs.pop("prefetch_buffer", None)
+    it = ImageIter(batch_size=batch_size, data_shape=data_shape,
+                   path_imgrec=path_imgrec, shuffle=shuffle,
+                   rand_crop=rand_crop, rand_mirror=rand_mirror,
+                   mean=mean, std=std, **kwargs)
+    if prefetch:
+        # reference parity: ImageRecordIter is prefetched by default in
+        # C++ (PrefetcherParam); here opt-in so the single-threaded CI
+        # host isn't forced to pay the double-buffer thread
+        it = PrefetchingIter(it)
+    return it

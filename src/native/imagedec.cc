@@ -1,0 +1,255 @@
+// Parallel JPEG decode + augment into a preallocated batch buffer.
+//
+// TPU-native replacement for the reference's OMP decode hot path
+// (reference: src/io/iter_image_recordio_2.cc:78 ParseChunk — decode
+// threads write straight into the output batch tensor). Design differs
+// deliberately: a persistent std::thread pool fed whole batches over a
+// C ABI (ctypes releases the GIL for the call, so Python's prefetch
+// thread overlaps this with the device step), and the augmentation RNG
+// is keyed per IMAGE (seed, stream position) rather than per thread —
+// results are bit-identical for any thread count or schedule.
+//
+// Pipeline per image, matching mxnet_tpu/image.py CreateAugmenter
+// semantics: imdecode(BGR) -> RGB -> resize short side (INTER_CUBIC)
+// -> random/center crop (resize when the source is smaller) ->
+// optional horizontal mirror -> (x - mean) / std -> float32 CHW.
+#include <opencv2/core.hpp>
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Job {
+  int n = 0;
+  const uint8_t* const* bufs = nullptr;
+  const int64_t* lens = nullptr;
+  uint64_t base = 0;        // stream position of bufs[0] (RNG key part)
+  float* out = nullptr;
+};
+
+class Decoder {
+ public:
+  Decoder(int threads, int out_h, int out_w, int channels, int resize,
+          int rand_crop, int rand_mirror, const float* mean,
+          const float* stdv, uint64_t seed)
+      : out_h_(out_h), out_w_(out_w), channels_(channels), resize_(resize),
+        rand_crop_(rand_crop), rand_mirror_(rand_mirror), seed_(seed) {
+    for (int c = 0; c < 3; ++c) {
+      mean_[c] = 0.f;
+      std_[c] = 1.f;
+    }
+    // grayscale callers pass 1-element mean/std buffers: only read
+    // what the channel count guarantees exists
+    int nc = channels == 1 ? 1 : 3;
+    for (int c = 0; c < nc; ++c) {
+      if (mean) mean_[c] = mean[c];
+      if (stdv) std_[c] = stdv[c];
+    }
+    int nt = threads > 0 ? threads : (int)std::thread::hardware_concurrency();
+    if (nt < 1) nt = 1;
+    workers_.reserve(nt);
+    for (int t = 0; t < nt; ++t)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Decoder() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      quit_ = true;
+    }
+    cv_job_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  int Decode(int n, const uint8_t* const* bufs, const int64_t* lens,
+             uint64_t base, float* out) {
+    if (n <= 0) return 0;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      job_ = Job{n, bufs, lens, base, out};
+      next_ = 0;
+      pending_.store(n, std::memory_order_relaxed);
+      failed_.store(0, std::memory_order_relaxed);
+      epoch_++;
+    }
+    cv_job_.notify_all();
+    {
+      // wait until every image is done AND every worker has LEFT the
+      // job (a worker still in its claim loop holds stale pointers and
+      // must not race the next job's reset of next_/pending_)
+      std::unique_lock<std::mutex> g(mu_);
+      cv_done_.wait(g, [this] {
+        return pending_.load(std::memory_order_acquire) == 0 &&
+               running_ == 0;
+      });
+    }
+    return failed_.load(std::memory_order_relaxed) ? -1 : 0;
+  }
+
+  const char* Error() {
+    std::lock_guard<std::mutex> g(err_mu_);
+    return err_.c_str();
+  }
+
+ private:
+  void WorkerLoop() {
+    uint64_t seen = 0;
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_job_.wait(g, [&] { return quit_ || epoch_ != seen; });
+        if (quit_) return;
+        seen = epoch_;
+        job = job_;
+        running_++;
+      }
+      for (;;) {
+        int i;
+        {
+          // claim under the job mutex, re-validating the epoch: a
+          // worker that joined a job in the window after Decode()
+          // returned but before the NEXT Decode() installed its job
+          // must not claim indices against the new job's counter with
+          // this (stale, freed) job's pointers
+          std::lock_guard<std::mutex> g(mu_);
+          if (epoch_ != seen) break;
+          i = next_++;
+        }
+        if (i >= job.n) break;
+        try {
+          DecodeOne(job.bufs[i], job.lens[i], job.base + (uint64_t)i,
+                    job.out + (size_t)i * channels_ * out_h_ * out_w_);
+        } catch (const std::exception& e) {
+          failed_.store(1, std::memory_order_relaxed);
+          std::lock_guard<std::mutex> g(err_mu_);
+          err_ = e.what();
+        } catch (...) {
+          failed_.store(1, std::memory_order_relaxed);
+          std::lock_guard<std::mutex> g(err_mu_);
+          err_ = "unknown decode error";
+        }
+        pending_.fetch_sub(1, std::memory_order_acq_rel);
+      }
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (--running_ == 0 &&
+            pending_.load(std::memory_order_acquire) == 0)
+          cv_done_.notify_all();
+      }
+    }
+  }
+
+  void DecodeOne(const uint8_t* buf, int64_t len, uint64_t pos, float* out) {
+    cv::Mat raw(1, (int)len, CV_8UC1, const_cast<uint8_t*>(buf));
+    cv::Mat img = cv::imdecode(
+        raw, channels_ == 3 ? cv::IMREAD_COLOR : cv::IMREAD_GRAYSCALE);
+    if (img.empty()) throw std::runtime_error("cannot decode image");
+    if (channels_ == 3) cv::cvtColor(img, img, cv::COLOR_BGR2RGB);
+
+    if (resize_ > 0) {
+      int h = img.rows, w = img.cols, nh, nw;
+      if (h > w) { nw = resize_; nh = (int)((int64_t)resize_ * h / w); }
+      else       { nh = resize_; nw = (int)((int64_t)resize_ * w / h); }
+      cv::resize(img, img, cv::Size(nw, nh), 0, 0, cv::INTER_CUBIC);
+    }
+
+    // deterministic per-image stream: any thread that picks this image
+    // draws the same crop/mirror decisions
+    std::mt19937_64 rng(seed_ ^ (0x9E3779B97F4A7C15ULL * (pos + 1)));
+    int cw = std::min(out_w_, img.cols), ch = std::min(out_h_, img.rows);
+    int x0, y0;
+    if (rand_crop_) {
+      x0 = (int)(rng() % (uint64_t)(img.cols - cw + 1));
+      y0 = (int)(rng() % (uint64_t)(img.rows - ch + 1));
+    } else {
+      x0 = (img.cols - cw) / 2;
+      y0 = (img.rows - ch) / 2;
+    }
+    cv::Mat crop = img(cv::Rect(x0, y0, cw, ch));
+    if (cw != out_w_ || ch != out_h_)
+      cv::resize(crop, crop, cv::Size(out_w_, out_h_), 0, 0,
+                 cv::INTER_CUBIC);
+    bool mirror = rand_mirror_ &&
+        ((rng() >> 11) * 0x1.0p-53 < 0.5);   // uniform [0,1) < p
+    if (mirror) cv::flip(crop, crop, 1);
+
+    // HWC uint8 -> CHW float32 with per-channel normalisation
+    const int hw = out_h_ * out_w_;
+    if (channels_ == 3) {
+      for (int y = 0; y < out_h_; ++y) {
+        const uint8_t* row = crop.ptr<uint8_t>(y);
+        float* o0 = out + y * out_w_;
+        float* o1 = o0 + hw;
+        float* o2 = o1 + hw;
+        for (int x = 0; x < out_w_; ++x) {
+          o0[x] = (row[3 * x + 0] - mean_[0]) / std_[0];
+          o1[x] = (row[3 * x + 1] - mean_[1]) / std_[1];
+          o2[x] = (row[3 * x + 2] - mean_[2]) / std_[2];
+        }
+      }
+    } else {
+      for (int y = 0; y < out_h_; ++y) {
+        const uint8_t* row = crop.ptr<uint8_t>(y);
+        float* o = out + y * out_w_;
+        for (int x = 0; x < out_w_; ++x)
+          o[x] = (row[x] - mean_[0]) / std_[0];
+      }
+    }
+  }
+
+  const int out_h_, out_w_, channels_, resize_, rand_crop_, rand_mirror_;
+  float mean_[3], std_[3];
+  const uint64_t seed_;
+
+  std::mutex mu_, err_mu_;
+  std::condition_variable cv_job_, cv_done_;
+  std::vector<std::thread> workers_;
+  Job job_;
+  uint64_t epoch_ = 0;
+  int running_ = 0;
+  int next_ = 0;                // guarded by mu_ (claims re-check epoch)
+  bool quit_ = false;
+  std::atomic<int> pending_{0}, failed_{0};
+  std::string err_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* imgdec_create(int threads, int out_h, int out_w, int channels,
+                    int resize, int rand_crop, int rand_mirror,
+                    const float* mean, const float* stdv, uint64_t seed) {
+  if (channels != 1 && channels != 3) return nullptr;
+  try {
+    return new Decoder(threads, out_h, out_w, channels, resize, rand_crop,
+                       rand_mirror, mean, stdv, seed);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+int imgdec_decode_batch(void* h, int n, const uint8_t* const* bufs,
+                        const int64_t* lens, uint64_t base, float* out) {
+  if (!h) return -1;
+  return static_cast<Decoder*>(h)->Decode(n, bufs, lens, base, out);
+}
+
+const char* imgdec_last_error(void* h) {
+  return h ? static_cast<Decoder*>(h)->Error() : "null decoder";
+}
+
+void imgdec_destroy(void* h) { delete static_cast<Decoder*>(h); }
+
+}  // extern "C"

@@ -139,3 +139,117 @@ def test_record_file_dataset(tmp_path):
     ds = RecordFileDataset(path)
     assert len(ds) == 5
     assert ds[3] == b"item3"
+
+
+# ---------------------------------------------------------------------------
+# native parallel decode pool (src/native/imagedec.cc; reference hot
+# path src/io/iter_image_recordio_2.cc ParseChunk)
+# ---------------------------------------------------------------------------
+
+def _jpegs(n, hw=96, seed=0):
+    cv2 = pytest.importorskip("cv2")
+    rng = np.random.RandomState(seed)
+    bufs = []
+    for i in range(n):
+        im = rng.randint(0, 255, (hw + i, hw + 8 - i, 3), dtype=np.uint8)
+        ok, b = cv2.imencode(".jpg", im)
+        bufs.append(b.tobytes())
+    return bufs
+
+
+def _native_dec(*a, **k):
+    try:
+        return image.NativeImageDecoder(*a, **k)
+    except mx.MXNetError:
+        pytest.skip("native decoder unavailable (no g++/OpenCV)")
+
+
+def test_native_decode_matches_python_exact():
+    """Decode + center crop only (no resize): bit-exact vs the Python
+    cv2 path — both run the same libjpeg decode."""
+    bufs = _jpegs(6)
+    dec = _native_dec((3, 64, 64))
+    out = dec.decode_batch(bufs)
+    for i, b in enumerate(bufs):
+        img = image.imdecode(b, to_ndarray=False)
+        ref = image.center_crop(img, (64, 64))[0]
+        np.testing.assert_array_equal(
+            out[i], np.asarray(ref).transpose(2, 0, 1).astype(np.float32))
+
+
+def test_native_decode_resize_close_to_python():
+    """With resize the system OpenCV (4.x) and pip cv2 (5.x) differ by
+    INTER_CUBIC rounding only — bounded by ~2 uint8 ULP."""
+    bufs = _jpegs(6, hw=128)
+    mean = np.array([123.68, 116.28, 103.53], np.float32)
+    std = np.array([58.395, 57.12, 57.375], np.float32)
+    dec = _native_dec((3, 96, 96), resize=112, mean=mean, std=std)
+    out = dec.decode_batch(bufs)
+    augs = image.CreateAugmenter((3, 96, 96), resize=112, mean=mean, std=std)
+    for i, b in enumerate(bufs):
+        img = image.imdecode(b, to_ndarray=False)
+        for a in augs:
+            img = a(img)
+        ref = np.asarray(img).transpose(2, 0, 1)
+        assert np.abs(out[i] - ref).max() < 2.5 / 57.0
+
+def test_native_decode_thread_invariant_and_stream_keyed():
+    """Random crop/mirror draws are keyed per (seed, stream position):
+    identical for any thread count, different at different positions."""
+    bufs = _jpegs(12, hw=128)
+    kw = dict(resize=112, rand_crop=True, rand_mirror=True)
+    d1 = _native_dec((3, 96, 96), num_threads=1, seed=5, **kw)
+    d3 = _native_dec((3, 96, 96), num_threads=3, seed=5, **kw)
+    a = d1.decode_batch(bufs, base=40)
+    b = d3.decode_batch(bufs, base=40)
+    np.testing.assert_array_equal(a, b)
+    c = d3.decode_batch(bufs, base=52)
+    assert not np.array_equal(a, c)
+    # many consecutive batches through one pool: no cross-batch races
+    for k in range(16):
+        d3.decode_batch(bufs, base=k)
+
+
+def test_native_decode_corrupt_buffer_raises():
+    dec = _native_dec((3, 32, 32))
+    with pytest.raises(mx.MXNetError, match="decode"):
+        dec.decode_batch([b"\xff\xd8 not a real jpeg"])
+
+
+def test_image_record_iter_native_path(tmp_path):
+    """ImageRecordIter(preprocess_threads=N) engages the pool and yields
+    the same labels/shapes as the Python path; unsupported augmentations
+    fall back to the Python loop."""
+    cv2 = pytest.importorskip("cv2")
+    rng = np.random.RandomState(3)
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        im = rng.randint(0, 255, (80, 80, 3), dtype=np.uint8)
+        ok, b = cv2.imencode(".jpg", im)
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     b.tobytes()))
+    w.close()
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 64, 64),
+                         batch_size=4, preprocess_threads=2)
+    if it._native is None:
+        pytest.skip("native decoder unavailable")
+    ref = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 64, 64),
+                          batch_size=4)
+    assert ref._native is None
+    b_nat, b_ref = next(it), next(ref)
+    np.testing.assert_array_equal(b_nat.label[0].asnumpy(),
+                                  b_ref.label[0].asnumpy())
+    np.testing.assert_allclose(b_nat.data[0].asnumpy(),
+                               b_ref.data[0].asnumpy(), atol=1e-5)
+    # partial final batch pads identically
+    for _ in range(1):
+        next(it), next(ref)
+    b_nat, b_ref = next(it), next(ref)
+    assert b_nat.pad == b_ref.pad == 2
+    # color jitter is not in the native fast path -> Python fallback
+    it2 = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 64, 64),
+                          batch_size=4, preprocess_threads=2, brightness=0.2)
+    assert it2._native is None
