@@ -1,0 +1,15 @@
+// Umbrella header for the C++ frontend (capability analog of the
+// reference's cpp-package/include/mxnet-cpp/MxNetCpp.h): one include
+// brings in NDArray/autograd, the generated op wrappers, symbol +
+// executor, optimizers, kvstore, data iterators, and the predictor.
+#ifndef MXNET_TPU_CPP_MXNETCPP_H_
+#define MXNET_TPU_CPP_MXNETCPP_H_
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+#include "mxnet_tpu_cpp/op.h"
+#include "mxnet_tpu_cpp/executor.hpp"
+#include "mxnet_tpu_cpp/optimizer.hpp"
+#include "mxnet_tpu_cpp/kvstore.hpp"
+#include "mxnet_tpu_cpp/io.hpp"
+
+#endif  // MXNET_TPU_CPP_MXNETCPP_H_

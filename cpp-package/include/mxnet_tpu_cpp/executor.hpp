@@ -1,0 +1,106 @@
+// C++ Symbol + Executor wrappers over the general C ABI.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// symbol.h + executor.h: load a topology from JSON, simple_bind with
+// example inputs, forward/backward, reach args/grads/outputs.
+#ifndef MXNET_TPU_CPP_EXECUTOR_HPP_
+#define MXNET_TPU_CPP_EXECUTOR_HPP_
+
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class Symbol {
+ public:
+  static Symbol FromJSON(const std::string& json) {
+    Symbol s;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &s.handle_));
+    return s;
+  }
+
+  Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+
+  ~Symbol() {
+    if (handle_ != nullptr) MXSymbolFree(handle_);
+  }
+
+  std::string ToJSON() const {
+    const char* j = nullptr;
+    Check(MXSymbolSaveToJSON(handle_, &j));
+    return j;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    uint32_t n = 0;
+    const char** names = nullptr;
+    Check(MXSymbolListArguments(handle_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  SymbolHandle handle() const { return handle_; }
+
+ private:
+  Symbol() = default;
+  SymbolHandle handle_ = nullptr;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const std::vector<std::string>& input_names,
+           const std::vector<const NDArray*>& input_examples) {
+    std::vector<const char*> ns;
+    std::vector<NDArrayHandle> hs;
+    for (const auto& n : input_names) ns.push_back(n.c_str());
+    for (const auto* a : input_examples) hs.push_back(a->handle());
+    Check(MXExecutorSimpleBind(sym.handle(),
+                               static_cast<uint32_t>(ns.size()),
+                               ns.data(), hs.data(), &handle_));
+  }
+
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+
+  ~Executor() {
+    if (handle_ != nullptr) MXExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+
+  void Backward() { Check(MXExecutorBackward(handle_)); }
+
+  NDArray Arg(const std::string& name) const {
+    NDArrayHandle h = nullptr;
+    Check(MXExecutorGetArg(handle_, name.c_str(), &h));
+    return NDArray::FromHandle(h);
+  }
+
+  NDArray Grad(const std::string& name) const {
+    NDArrayHandle h = nullptr;
+    Check(MXExecutorGetGrad(handle_, name.c_str(), &h));
+    return NDArray::FromHandle(h);
+  }
+
+  std::vector<NDArray> Outputs() const {
+    uint32_t n = 0;
+    NDArrayHandle* hs = nullptr;
+    Check(MXExecutorOutputs(handle_, &n, &hs));
+    std::vector<NDArray> out;
+    out.reserve(n);
+    for (uint32_t i = 0; i < n; ++i)
+      out.push_back(NDArray::FromHandle(hs[i]));
+    return out;
+  }
+
+ private:
+  ExecutorHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_EXECUTOR_HPP_

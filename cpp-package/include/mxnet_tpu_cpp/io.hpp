@@ -1,0 +1,76 @@
+// C++ data-iterator wrapper over the general C ABI.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// io.h MXDataIter: create a registered iterator by name with flat
+// string kwargs, walk epochs batch by batch.
+#ifndef MXNET_TPU_CPP_IO_HPP_
+#define MXNET_TPU_CPP_IO_HPP_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+inline std::vector<std::string> ListDataIters() {
+  uint32_t n = 0;
+  const char** names = nullptr;
+  Check(MXListDataIters(&n, &names));
+  return std::vector<std::string>(names, names + n);
+}
+
+class DataIter {
+ public:
+  DataIter(const std::string& name,
+           const std::map<std::string, std::string>& kwargs) {
+    std::vector<const char*> ks, vs;
+    for (const auto& kv : kwargs) {
+      ks.push_back(kv.first.c_str());
+      vs.push_back(kv.second.c_str());
+    }
+    Check(MXDataIterCreateIter(name.c_str(),
+                               static_cast<uint32_t>(ks.size()),
+                               ks.data(), vs.data(), &handle_));
+  }
+
+  DataIter(const DataIter&) = delete;
+  DataIter& operator=(const DataIter&) = delete;
+
+  ~DataIter() {
+    if (handle_ != nullptr) MXDataIterFree(handle_);
+  }
+
+  bool Next() {
+    int has = 0;
+    Check(MXDataIterNext(handle_, &has));
+    return has != 0;
+  }
+
+  void Reset() { Check(MXDataIterBeforeFirst(handle_)); }
+
+  NDArray Data() const {
+    NDArrayHandle h = nullptr;
+    Check(MXDataIterGetData(handle_, &h));
+    return NDArray::FromHandle(h);
+  }
+
+  NDArray Label() const {
+    NDArrayHandle h = nullptr;
+    Check(MXDataIterGetLabel(handle_, &h));
+    return NDArray::FromHandle(h);
+  }
+
+  int PadNum() const {
+    int pad = 0;
+    Check(MXDataIterGetPadNum(handle_, &pad));
+    return pad;
+  }
+
+ private:
+  DataIterHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_IO_HPP_

@@ -1,0 +1,89 @@
+// C++ KVStore wrapper over the general C ABI (include/mxnet_tpu/c_api.h).
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// kvstore.h: init/push/pull on string keys, rank/size queries — the
+// aggregation layer a multi-worker C++ training loop drives.
+#ifndef MXNET_TPU_CPP_KVSTORE_HPP_
+#define MXNET_TPU_CPP_KVSTORE_HPP_
+
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &handle_));
+  }
+
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  ~KVStore() {
+    if (handle_ != nullptr) MXKVStoreFree(handle_);
+  }
+
+  void Init(const std::vector<std::string>& keys,
+            const std::vector<const NDArray*>& vals) {
+    Call(&MXKVStoreInit, keys, vals);
+  }
+
+  void Push(const std::vector<std::string>& keys,
+            const std::vector<const NDArray*>& vals, int priority = 0) {
+    CallP(&MXKVStorePush, keys, vals, priority);
+  }
+
+  void Pull(const std::vector<std::string>& keys,
+            const std::vector<const NDArray*>& outs, int priority = 0) {
+    CallP(&MXKVStorePull, keys, outs, priority);
+  }
+
+  std::string Type() const {
+    const char* t = nullptr;
+    Check(MXKVStoreGetType(handle_, &t));
+    return t;
+  }
+
+  int Rank() const {
+    int r = 0;
+    Check(MXKVStoreGetRank(handle_, &r));
+    return r;
+  }
+
+  int GroupSize() const {
+    int n = 0;
+    Check(MXKVStoreGetGroupSize(handle_, &n));
+    return n;
+  }
+
+ private:
+  template <typename Fn>
+  void Call(Fn fn, const std::vector<std::string>& keys,
+            const std::vector<const NDArray*>& vals) {
+    std::vector<const char*> ks;
+    std::vector<NDArrayHandle> hs;
+    for (const auto& k : keys) ks.push_back(k.c_str());
+    for (const auto* v : vals) hs.push_back(v->handle());
+    Check(fn(handle_, static_cast<uint32_t>(ks.size()), ks.data(),
+             hs.data()));
+  }
+
+  template <typename Fn>
+  void CallP(Fn fn, const std::vector<std::string>& keys,
+             const std::vector<const NDArray*>& vals, int priority) {
+    std::vector<const char*> ks;
+    std::vector<NDArrayHandle> hs;
+    for (const auto& k : keys) ks.push_back(k.c_str());
+    for (const auto* v : vals) hs.push_back(v->handle());
+    Check(fn(handle_, static_cast<uint32_t>(ks.size()), ks.data(),
+             hs.data(), priority));
+  }
+
+  KVStoreHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_KVSTORE_HPP_

@@ -1,0 +1,49 @@
+// C++ optimizers over the in-place update operators.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// optimizer.h: per-parameter state, Update(index, weight, grad); the
+// math runs in the framework's fused update ops (ops/optimizer_ops.py)
+// via MXImperativeInvoke, exactly like the reference routes through
+// its registered optimizer kernels.
+#ifndef MXNET_TPU_CPP_OPTIMIZER_HPP_
+#define MXNET_TPU_CPP_OPTIMIZER_HPP_
+
+#include <map>
+#include <string>
+#include <utility>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class SGDOptimizer {
+ public:
+  explicit SGDOptimizer(float lr, float momentum = 0.0f, float wd = 0.0f)
+      : lr_(lr), momentum_(momentum), wd_(wd) {}
+
+  void Update(int index, NDArray* weight, const NDArray& grad) {
+    AttrMapOf attrs = {{"lr", std::to_string(lr_)},
+                       {"wd", std::to_string(wd_)}};
+    if (momentum_ == 0.0f) {
+      InvokeInPlace("sgd_update", {weight, &grad}, attrs);
+      return;
+    }
+    attrs["momentum"] = std::to_string(momentum_);
+    auto it = states_.find(index);
+    if (it == states_.end()) {
+      NDArray mom(weight->Shape());
+      it = states_.emplace(index, std::move(mom)).first;
+    }
+    InvokeInPlace("sgd_mom_update", {weight, &grad, &it->second}, attrs);
+  }
+
+  void SetLR(float lr) { lr_ = lr; }
+
+ private:
+  using AttrMapOf = std::map<std::string, std::string>;
+  float lr_, momentum_, wd_;
+  std::map<int, NDArray> states_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_OPTIMIZER_HPP_

@@ -100,6 +100,43 @@ int MXExecutorOutputs(ExecutorHandle exec, uint32_t* out_num,
                       NDArrayHandle** outputs);
 int MXExecutorFree(ExecutorHandle exec);
 
+/* ---- kvstore (reference: include/mxnet/c_api.h:1942 block) ------- */
+typedef void* KVStoreHandle;
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle h);
+int MXKVStoreInit(KVStoreHandle h, uint32_t num, const char** keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle h, uint32_t num, const char** keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle h, uint32_t num, const char** keys,
+                  NDArrayHandle* outs, int priority);
+int MXKVStoreGetType(KVStoreHandle h, const char** out_type);
+int MXKVStoreGetRank(KVStoreHandle h, int* out_rank);
+int MXKVStoreGetGroupSize(KVStoreHandle h, int* out_size);
+
+/* ---- data iterators (reference: MXDataIterCreateIter family) ----- */
+typedef void* DataIterHandle;
+
+int MXListDataIters(uint32_t* out_num, const char*** out_names);
+int MXDataIterCreateIter(const char* name, uint32_t num_params,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle h);
+/* *out_has_next: 1 while a batch was produced, 0 at end of epoch. */
+int MXDataIterNext(DataIterHandle h, int* out_has_next);
+int MXDataIterBeforeFirst(DataIterHandle h);
+int MXDataIterGetData(DataIterHandle h, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle h, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle h, int* out_pad);
+
+/* ---- profiler (reference: src/c_api/c_api_profile.cc) ------------ */
+int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                               const char** vals);
+/* state: 0 = stop, 1 = run */
+int MXSetProcessProfilerState(int state);
+int MXDumpProcessProfile(int finished);
+
 #ifdef __cplusplus
 }
 #endif

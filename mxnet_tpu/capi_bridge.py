@@ -27,6 +27,11 @@ __all__ = [
     "symbol_from_json", "symbol_to_json", "symbol_list_arguments",
     "executor_bind", "executor_forward", "executor_backward",
     "executor_arg", "executor_grad", "executor_outputs",
+    "kv_create", "kv_init", "kv_push", "kv_pull", "kv_type", "kv_rank",
+    "kv_group_size",
+    "iter_list", "iter_create", "iter_next", "iter_reset", "iter_data",
+    "iter_label", "iter_pad",
+    "profiler_set_config", "profiler_set_state", "profiler_dump",
 ]
 
 _DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
@@ -187,3 +192,126 @@ def executor_grad(w, name):
 
 def executor_outputs(w):
     return list(w.exe.outputs)
+
+
+# -- kvstore (reference: c_api.cc MXKVStoreCreate block,
+#    include/mxnet/c_api.h:1942) --------------------------------------------
+
+def kv_create(name):
+    from . import kvstore
+    return kvstore.create(name)
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+    return 0
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+    return 0
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return 0
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+# -- data iterators (reference: c_api.cc MXListDataIters /
+#    MXDataIterCreateIter — the string-kwarg C++ iterator registry) ---------
+
+# iterators creatable through flat string kwargs, mirroring the
+# reference's IO registry (NDArrayIter is Python-side there too)
+_C_ITERS = ("ImageRecordIter", "MNISTIter", "CSVIter", "LibSVMIter")
+
+
+class _IterWrap(object):
+    __slots__ = ("it", "batch")
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def iter_list():
+    return list(_C_ITERS)
+
+
+def iter_create(name, keys, vals):
+    from . import io as _io
+    if name not in _C_ITERS:
+        raise MXNetError("unknown data iter %r (have %s)"
+                         % (name, ", ".join(_C_ITERS)))
+    kwargs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
+    if "data_shape" in kwargs and not isinstance(kwargs["data_shape"],
+                                                 (tuple, list)):
+        kwargs["data_shape"] = (kwargs["data_shape"],)
+    return _IterWrap(getattr(_io, name)(**kwargs))
+
+
+def iter_next(w):
+    try:
+        w.batch = next(w.it)
+        return 1
+    except StopIteration:
+        w.batch = None
+        return 0
+
+
+def iter_reset(w):
+    w.it.reset()
+    w.batch = None
+    return 0
+
+
+def _cur_batch(w):
+    if w.batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return w.batch
+
+
+def iter_data(w):
+    return _cur_batch(w).data[0]
+
+
+def iter_label(w):
+    return _cur_batch(w).label[0]
+
+
+def iter_pad(w):
+    return int(_cur_batch(w).pad or 0)
+
+
+# -- profiler (reference: src/c_api/c_api_profile.cc) -----------------------
+
+def profiler_set_config(keys, vals):
+    from . import profiler
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        kwargs[k] = _parse_attr(v)
+    profiler.set_config(**kwargs)
+    return 0
+
+
+def profiler_set_state(state):
+    from . import profiler
+    profiler.set_state({0: "stop", 1: "run"}[int(state)])
+    return 0
+
+
+def profiler_dump(finished):
+    from . import profiler
+    profiler.dump(finished=bool(finished))
+    return 0

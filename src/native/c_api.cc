@@ -543,3 +543,242 @@ MXTPU_API int MXExecutorOutputs(ExecutorHandle exec, uint32_t* out_num,
 MXTPU_API int MXExecutorFree(ExecutorHandle exec) {
   return MXNDArrayFree(exec);
 }
+
+// --------------------------------------------------------------- kvstore
+// (reference: src/c_api/c_api.cc MXKVStoreCreate block,
+//  include/mxnet/c_api.h:1942)
+
+namespace {
+
+// string-key + handle-list marshalling shared by init/push/pull
+PyObject* keyed_handle_args(void* h, uint32_t num, const char** keys,
+                            NDArrayHandle* vals, int priority,
+                            bool with_priority) {
+  PyObject* pkeys = PyList_New(num);
+  PyObject* pvals = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyObject* o = reinterpret_cast<PyObject*>(vals[i]);
+    Py_INCREF(o);
+    PyList_SetItem(pvals, i, o);
+  }
+  if (with_priority)
+    return Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(h), pkeys,
+                         pvals, priority);
+  return Py_BuildValue("(ONN)", reinterpret_cast<PyObject*>(h), pkeys,
+                       pvals);
+}
+
+int kv_keyed_call(const char* fn, KVStoreHandle h, uint32_t num,
+                  const char** keys, NDArrayHandle* vals, int priority,
+                  bool with_priority) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = keyed_handle_args(h, num, keys, vals, priority,
+                                     with_priority);
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* r = bridge_call("kv_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreFree(KVStoreHandle h) { return MXNDArrayFree(h); }
+
+MXTPU_API int MXKVStoreInit(KVStoreHandle h, uint32_t num,
+                            const char** keys, NDArrayHandle* vals) {
+  return kv_keyed_call("kv_init", h, num, keys, vals, 0, false);
+}
+
+MXTPU_API int MXKVStorePush(KVStoreHandle h, uint32_t num,
+                            const char** keys, NDArrayHandle* vals,
+                            int priority) {
+  return kv_keyed_call("kv_push", h, num, keys, vals, priority, true);
+}
+
+MXTPU_API int MXKVStorePull(KVStoreHandle h, uint32_t num,
+                            const char** keys, NDArrayHandle* outs,
+                            int priority) {
+  return kv_keyed_call("kv_pull", h, num, keys, outs, priority, true);
+}
+
+MXTPU_API int MXKVStoreGetType(KVStoreHandle h, const char** out_type) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("kv_type", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+  tl_cstrs.push_back(tl_strings.back().c_str());
+  *out_type = tl_cstrs[0];
+  Py_DECREF(r);
+  return 0;
+}
+
+static int kv_int_query(const char* fn, KVStoreHandle h, int* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreGetRank(KVStoreHandle h, int* out_rank) {
+  return kv_int_query("kv_rank", h, out_rank);
+}
+
+MXTPU_API int MXKVStoreGetGroupSize(KVStoreHandle h, int* out_size) {
+  return kv_int_query("kv_group_size", h, out_size);
+}
+
+// ---------------------------------------------------------- data iterators
+// (reference: src/c_api/c_api.cc MXDataIterCreateIter family over the
+//  registered C++ iterators)
+
+MXTPU_API int MXListDataIters(uint32_t* out_num, const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("iter_list", nullptr);
+  if (r == nullptr) return -1;
+  *out_names = stash_strings(r, out_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDataIterCreateIter(const char* name, uint32_t num_params,
+                                   const char** keys, const char** vals,
+                                   DataIterHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (uint32_t i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNN)", name, pkeys, pvals);
+  PyObject* r = bridge_call("iter_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXDataIterFree(DataIterHandle h) { return MXNDArrayFree(h); }
+
+MXTPU_API int MXDataIterNext(DataIterHandle h, int* out_has_next) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("iter_next", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out_has_next = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDataIterBeforeFirst(DataIterHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("iter_reset", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int iter_get(const char* fn, DataIterHandle h, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXDataIterGetData(DataIterHandle h, NDArrayHandle* out) {
+  return iter_get("iter_data", h, out);
+}
+
+MXTPU_API int MXDataIterGetLabel(DataIterHandle h, NDArrayHandle* out) {
+  return iter_get("iter_label", h, out);
+}
+
+MXTPU_API int MXDataIterGetPadNum(DataIterHandle h, int* out_pad) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("iter_pad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out_pad = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------- profiler
+// (reference: src/c_api/c_api_profile.cc)
+
+MXTPU_API int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                                         const char** vals) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(NN)", pkeys, pvals);
+  PyObject* r = bridge_call("profiler_set_config", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSetProcessProfilerState(int state) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* r = bridge_call("profiler_set_state", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDumpProcessProfile(int finished) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", finished);
+  PyObject* r = bridge_call("profiler_dump", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
