@@ -22,7 +22,8 @@ from .ndarray import NDArray, array as _dense_array
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "row_sparse_array", "csr_matrix", "array", "zeros", "empty",
-           "retain", "dot", "embedding"]
+           "retain", "dot", "embedding", "add", "subtract", "multiply",
+           "divide", "square_sum"]
 
 
 def _jnp():
@@ -144,12 +145,45 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
-            dense = _sk.rsp_add_rsp(self.shape, self.indices, self.data,
-                                    other.indices, other.data)
-            return NDArray(dense, ctx=self._ctx)
+            # rsp + rsp stays row_sparse over the index union
+            # (reference: elemwise_add rsp,rsp -> rsp)
+            jnp = _jnp()
+            idx = jnp.concatenate([self.indices, other.indices])
+            vals = jnp.concatenate([self.data, other.data])
+            uidx, uvals = _sk.rsp_aggregate(idx, vals)
+            return RowSparseNDArray(uvals, uidx, self.shape, self.dtype,
+                                    self._ctx)
         if isinstance(other, NDArray):
             return NDArray(self.todense()._data + other._data,
                            ctx=self._ctx)
+        raise TypeError(type(other))
+
+    def __sub__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self + (other * -1)
+        if isinstance(other, NDArray):
+            return NDArray(self.todense()._data - other._data,
+                           ctx=self._ctx)
+        raise TypeError(type(other))
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            # scalar scaling preserves the sparsity pattern
+            return RowSparseNDArray(self.data * other, self.indices,
+                                    self.shape, self.dtype, self._ctx)
+        if isinstance(other, NDArray):
+            # dense operand gathered at the stored rows only
+            return RowSparseNDArray(self.data * other._data[self.indices],
+                                    self.indices, self.shape, self.dtype,
+                                    self._ctx)
+        raise TypeError(type(other))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, (int, float)):
+            return RowSparseNDArray(self.data / other, self.indices,
+                                    self.shape, self.dtype, self._ctx)
         raise TypeError(type(other))
 
     def copyto(self, other):
@@ -188,6 +222,32 @@ class CSRNDArray(BaseSparseNDArray):
             dense = self.todense()._data[start:stop]
             return array(_np.asarray(dense), stype="csr")
         raise MXNetError("CSRNDArray only supports row-slice indexing")
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return CSRNDArray(self.data * other, self.indices, self.indptr,
+                              self.shape, self.dtype, self._ctx)
+        if isinstance(other, NDArray):
+            # csr (*) dense keeps the csr pattern (reference:
+            # elemwise_binary_op csr,dns -> csr)
+            data = _sk.csr_elemwise_dense(self.data, self.indices,
+                                          self.indptr, other._data, "mul")
+            return CSRNDArray(data, self.indices, self.indptr, self.shape,
+                              self.dtype, self._ctx)
+        raise TypeError(type(other))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, (int, float)):
+            return CSRNDArray(self.data / other, self.indices, self.indptr,
+                              self.shape, self.dtype, self._ctx)
+        if isinstance(other, NDArray):
+            data = _sk.csr_elemwise_dense(self.data, self.indices,
+                                          self.indptr, other._data, "div")
+            return CSRNDArray(data, self.indices, self.indptr, self.shape,
+                              self.dtype, self._ctx)
+        raise TypeError(type(other))
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -270,16 +330,72 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse dot (reference: src/operator/tensor/dot-inl.h sparse
     paths): csr x dense (differentiable w.r.t. the dense rhs, with a
     ROW-SPARSE gradient covering only the feature columns present in
-    the csr batch) and dense x dense fallbacks."""
+    the csr batch), row_sparse x dense (both transposes, computed on
+    the stored-row block only), and dense x dense fallbacks."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
         if transpose_b:
             raise MXNetError("transpose_b unsupported for csr dot")
         return _CsrDotDense(lhs, transpose_a)(rhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for row_sparse dot")
+        out = _sk.rsp_dot_dense(lhs.shape, lhs.indices, lhs.data,
+                                rhs._data, transpose_lhs=transpose_a)
+        return NDArray(out, ctx=rhs.context)
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         from . import dot as _dense_dot
         return _dense_dot(lhs, rhs, transpose_a, transpose_b)
     raise MXNetError("unsupported sparse dot combination: %s x %s"
                      % (type(lhs).__name__, type(rhs).__name__))
+
+
+def _binary(lhs, rhs, op):
+    """Storage-aware elementwise dispatch (reference: the FComputeEx
+    elemwise_binary_op sparse paths): rsp (.) rsp stays rsp for add/sub,
+    sparse (.) scalar keeps the pattern, anything else densifies."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                       RowSparseNDArray):
+        if op == "add":
+            return lhs + rhs
+        if op == "sub":
+            return lhs - rhs
+    if isinstance(lhs, (RowSparseNDArray, CSRNDArray)) and \
+            isinstance(rhs, (int, float)):
+        if op == "mul":
+            return lhs * rhs
+        if op == "div":
+            return lhs / rhs
+    if isinstance(lhs, (int, float)) and \
+            isinstance(rhs, (RowSparseNDArray, CSRNDArray)) and op == "mul":
+        return rhs * lhs
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
+            op in ("mul", "div"):
+        return lhs * rhs if op == "mul" else lhs / rhs
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray) and \
+            op == "mul":
+        return lhs * rhs
+    a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    fn = {"add": lambda x, y: x + y, "sub": lambda x, y: x - y,
+          "mul": lambda x, y: x * y, "div": lambda x, y: x / y}[op]
+    return fn(a, b)
+
+
+def add(lhs, rhs):
+    """Reference: sparse.py add (elemwise_add sparse dispatch)."""
+    return _binary(lhs, rhs, "add")
+
+
+def subtract(lhs, rhs):
+    return _binary(lhs, rhs, "sub")
+
+
+def multiply(lhs, rhs):
+    return _binary(lhs, rhs, "mul")
+
+
+def divide(lhs, rhs):
+    return _binary(lhs, rhs, "div")
 
 
 class _CsrDotDense(object):
@@ -363,13 +479,14 @@ def square_sum(arr, axis=None, keepdims=False):
                 out = out.reshape((1,) * arr.ndim)
             return NDArray(out, ctx=arr.context)
         if arr.ndim == 2 and axis in (1, -1, (1,), (-1,)):
-            # the sparse-efficient case: per-row reduce over stored rows
-            red = jnp.sum(jnp.asarray(arr.data) ** 2, axis=1)
-            out = jnp.zeros((arr.shape[0],), red.dtype).at[
-                jnp.asarray(arr.indices)].set(red)
-            if keepdims:
-                out = out.reshape((arr.shape[0], 1))
-            return NDArray(out, ctx=arr.context)
+            # the sparse-efficient case: per-row reduce over stored rows,
+            # returned ROW_SPARSE over the same indices (reference:
+            # square_sum-inl.h SquareSumRspImpl keeps the rsp layout)
+            red = jnp.sum(jnp.asarray(arr.data) ** 2, axis=1,
+                          keepdims=keepdims)
+            shape = (arr.shape[0], 1) if keepdims else (arr.shape[0],)
+            return RowSparseNDArray(red, arr.indices, shape, arr.dtype,
+                                    arr.context)
         dense = arr.todense()
         return NDArray(jnp.sum(dense._data ** 2, axis=axis,
                                keepdims=keepdims), ctx=arr.context)

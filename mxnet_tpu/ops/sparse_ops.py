@@ -17,7 +17,8 @@ import jax.numpy as jnp
 __all__ = ["dense_to_rsp", "rsp_to_dense", "dense_to_csr", "csr_to_dense",
            "csr_dot_dense", "rsp_retain", "rsp_add_rsp",
            "dot_dense_t_dense_rsp", "rsp_sgd_update", "rsp_sgd_mom_update",
-           "rsp_adam_update", "rsp_aggregate"]
+           "rsp_adam_update", "rsp_aggregate", "rsp_dot_dense",
+           "csr_elemwise_dense"]
 
 
 def dense_to_rsp(dense):
@@ -162,3 +163,34 @@ def rsp_aggregate(indices, values):
     summed = jax.ops.segment_sum(values, jnp.asarray(inv),
                                  num_segments=int(uniq.shape[0]))
     return jnp.asarray(uniq), summed
+
+
+def rsp_dot_dense(shape, indices, values, rhs, transpose_lhs=False):
+    """dot(row_sparse, dense) (reference: dot-inl.h DotRspDnsDnsImpl /
+    the transposed embedding-gradient pattern DotCsrRspDnsImpl family).
+
+    Forward: only stored rows contribute — (nnz, d) @ (d, k) on the
+    value block, scattered back to the stored row positions; the
+    transposed form is values^T @ rhs[stored rows], a dense (d_cols, k)
+    result. Both are single MXU matmuls over the nonzero block."""
+    if transpose_lhs:
+        # out[c, k] = sum_r values[r, c] * rhs[row_r, k]
+        return jnp.matmul(values.T, rhs[indices])
+    prod = jnp.matmul(values, rhs)                     # (nnz, k)
+    out = jnp.zeros((shape[0],) + prod.shape[1:], dtype=prod.dtype)
+    return out.at[indices].set(prod)
+
+
+def csr_elemwise_dense(data, indices, indptr, rhs, op):
+    """Elementwise csr (.) dense keeping the csr pattern (reference:
+    elemwise_binary_op-inl.h csr,dns -> csr paths): the dense operand is
+    gathered at the stored coordinates only."""
+    nnz = data.shape[0]
+    rows = jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                            side="right") - 1
+    gathered = rhs[rows, indices]
+    if op == "mul":
+        return data * gathered
+    if op == "div":
+        return data / gathered
+    raise ValueError(op)

@@ -249,3 +249,123 @@ def test_kvstore_rsp_push_no_updater_assign_semantics():
     out = nd.zeros((3, 1))
     kv.pull(0, out=out)
     np.testing.assert_allclose(out.asnumpy().ravel(), [0, 1, 0])
+
+
+# ---------------------------------------------------------------------------
+# round-5 breadth: rsp dot variants, storage-aware elemwise, square_sum
+# (reference: dot-inl.h, elemwise_binary_op-inl.h, square_sum-inl.h)
+
+
+def _rand_rsp(rng, n=8, d=5, rows=(1, 4, 6)):
+    vals = rng.randn(len(rows), d).astype(np.float32)
+    return sparse.RowSparseNDArray(vals, np.array(rows), (n, d))
+
+
+def test_rsp_dot_dense_both_transposes():
+    rng = np.random.RandomState(0)
+    r = _rand_rsp(rng)
+    rhs = nd.array(rng.randn(5, 3).astype(np.float32))
+    out = sparse.dot(r, rhs)
+    np.testing.assert_allclose(out.asnumpy(),
+                               r.todense().asnumpy() @ rhs.asnumpy(),
+                               rtol=1e-5)
+    rhs_t = nd.array(rng.randn(8, 3).astype(np.float32))
+    out_t = sparse.dot(r, rhs_t, transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(),
+                               r.todense().asnumpy().T @ rhs_t.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_rsp_add_sub_stay_row_sparse():
+    rng = np.random.RandomState(1)
+    a = _rand_rsp(rng, rows=(0, 3))
+    b = _rand_rsp(rng, rows=(3, 7))
+    s = a + b
+    assert s.stype == "row_sparse" and s.num_rows == 3
+    np.testing.assert_allclose(
+        s.todense().asnumpy(),
+        a.todense().asnumpy() + b.todense().asnumpy(), rtol=1e-6)
+    d = sparse.subtract(a, b)
+    assert d.stype == "row_sparse"
+    np.testing.assert_allclose(
+        d.todense().asnumpy(),
+        a.todense().asnumpy() - b.todense().asnumpy(), rtol=1e-6)
+
+
+def test_sparse_scalar_and_dense_elemwise_keep_pattern():
+    rng = np.random.RandomState(2)
+    r = _rand_rsp(rng)
+    assert (2.0 * r).stype == "row_sparse"
+    np.testing.assert_allclose((r * 2.0).todense().asnumpy(),
+                               2 * r.todense().asnumpy(), rtol=1e-6)
+    dense = np.zeros((4, 6), np.float32)
+    dense[1, 2] = 3.0
+    dense[3, 5] = -2.0
+    c = mx.nd.cast_storage(nd.array(dense), "csr")
+    other = nd.array(rng.rand(4, 6).astype(np.float32) + 1.0)
+    m = sparse.multiply(c, other)
+    assert m.stype == "csr" and m.nnz == c.nnz
+    np.testing.assert_allclose(m.todense().asnumpy(),
+                               dense * other.asnumpy(), rtol=1e-6)
+    q = sparse.divide(c, other)
+    np.testing.assert_allclose(q.todense().asnumpy(),
+                               dense / other.asnumpy(), rtol=1e-5)
+
+
+def test_square_sum_on_stored_rows():
+    rng = np.random.RandomState(3)
+    r = _rand_rsp(rng)
+    full = r.todense().asnumpy()
+    tot = sparse.square_sum(r)
+    np.testing.assert_allclose(tot.asnumpy(), (full ** 2).sum(), rtol=1e-5)
+    rows = sparse.square_sum(r, axis=1)
+    assert rows.stype == "row_sparse" and rows.shape == (8,)
+    np.testing.assert_allclose(rows.todense().asnumpy(),
+                               (full ** 2).sum(axis=1), rtol=1e-5)
+    rows_k = sparse.square_sum(r, axis=1, keepdims=True)
+    assert rows_k.shape == (8, 1)
+    np.testing.assert_allclose(rows_k.todense().asnumpy(),
+                               (full ** 2).sum(axis=1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_sparse_fm_converges(tmp_path):
+    """Factorization-machine convergence on CSR input — the analog of
+    the reference's tests/python/train/test_sparse_fm.py: sparse dot
+    forward, row-sparse gradients, lazy adam updates."""
+    rng = np.random.RandomState(7)
+    N, D, K = 256, 40, 4
+    X = np.zeros((N, D), np.float32)
+    for i in range(N):
+        active = rng.choice(D, size=5, replace=False)
+        X[i, active] = rng.rand(5).astype(np.float32)
+    w_true = rng.randn(D, 1).astype(np.float32)
+    v_true = rng.randn(D, K).astype(np.float32) * 0.5
+    xv = X @ v_true
+    y = (X @ w_true)[:, 0] + 0.5 * ((xv ** 2).sum(1)
+                                    - ((X ** 2) @ (v_true ** 2)).sum(1))
+    y = nd.array(y[:, None])
+
+    Xcsr = mx.nd.cast_storage(nd.array(X), "csr")
+    X2csr = mx.nd.cast_storage(nd.array(X ** 2), "csr")
+
+    W = nd.array(np.zeros((D, 1), np.float32))
+    V = nd.array(rng.randn(D, K).astype(np.float32) * 0.1)
+    W.attach_grad()
+    V.attach_grad()
+    ad = opt.create("adam", learning_rate=0.05, lazy_update=True)
+    states = {0: ad.create_state(0, W), 1: ad.create_state(1, V)}
+
+    losses = []
+    for step in range(60):
+        with autograd.record():
+            lin = sparse.dot(Xcsr, W)
+            xv = sparse.dot(Xcsr, V)
+            x2v2 = sparse.dot(X2csr, V * V)
+            pred = lin + 0.5 * (xv * xv - x2v2).sum(axis=1, keepdims=True)
+            loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        ad.update(0, W, W.grad, states[0])
+        ad.update(1, V, V.grad, states[1])
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.15 * losses[0], (losses[0], losses[-1])
