@@ -1,5 +1,16 @@
-"""Inception V3
-(reference: python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3 for the gluon model zoo.
+
+Capability parity with the reference zoo
+(python/mxnet/gluon/model_zoo/vision/inception.py), same parameter
+names so published ``.params`` files load.
+
+The topology is written as data, not builder functions: ``_STEM`` and
+``_STAGES`` below spell out every conv (channels/kernel/stride/pad) and
+pool of the network, and a small interpreter turns rows into blocks.
+The 17x17->8x8 "expanded" tail blocks (whose inner branches fork and
+re-concat) carry their fork structure in the same table via nested
+branch lists.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -9,159 +20,174 @@ from ..model_store import get_model_file
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _c(channels, kernel, stride=1, pad=0):
+    """One conv row of the topology table."""
+    return {"channels": channels, "kernel_size": kernel,
+            "strides": stride, "padding": pad}
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _cbr(cfg):
+    """conv -> BN(eps 1e-3) -> relu, the network's only conv unit."""
+    unit = nn.HybridSequential(prefix="")
+    unit.add(nn.Conv2D(use_bias=False, **cfg))
+    unit.add(nn.BatchNorm(epsilon=0.001))
+    unit.add(nn.Activation("relu"))
+    return unit
 
 
-class _Concurrent(HybridBlock):
-    """Run child branches on the same input and concat on channels
-    (reference: gluon/contrib/nn HybridConcurrent, used by inception)."""
-
-    def __init__(self, axis=1, prefix=None, params=None):
-        super(_Concurrent, self).__init__(prefix=prefix, params=params)
-        self._axis = axis
-
-    def add(self, block):
-        self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        outs = [block(x) for block in self._children.values()]
-        return F.Concat(*outs, dim=self._axis)
+def _branch(rows):
+    """A branch: optional leading pool marker, then conv rows."""
+    seq = nn.HybridSequential(prefix="")
+    for row in rows:
+        if row == "avgpool":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif row == "maxpool":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        else:
+            seq.add(_cbr(row))
+    return seq
 
 
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None),
-                             (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1), (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
+# Stem: 299x299x3 -> 35x35x192
+_STEM = (_c(32, 3, stride=2), _c(32, 3), _c(64, 3, pad=1), "maxpool",
+         _c(80, 1), _c(192, 3), "maxpool")
 
 
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1), (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _a_stage(prefix, pool_features):
+    return (prefix, [
+        [_c(64, 1)],
+        [_c(48, 1), _c(64, 5, pad=2)],
+        [_c(64, 1), _c(96, 3, pad=1), _c(96, 3, pad=1)],
+        ["avgpool", _c(pool_features, 1)],
+    ])
 
 
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _c_stage(prefix, mid):
+    return (prefix, [
+        [_c(192, 1)],
+        [_c(mid, 1), _c(mid, (1, 7), pad=(0, 3)),
+         _c(192, (7, 1), pad=(3, 0))],
+        [_c(mid, 1), _c(mid, (7, 1), pad=(3, 0)),
+         _c(mid, (1, 7), pad=(0, 3)), _c(mid, (7, 1), pad=(3, 0)),
+         _c(192, (1, 7), pad=(0, 3))],
+        ["avgpool", _c(192, 1)],
+    ])
 
 
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _e_stage(prefix):
+    """Expanded tail block: branches 2 and 3 fork into (1x3, 3x1) pairs
+    whose outputs concat — encoded as (stem_rows, [fork_rows, ...])."""
+    return (prefix, "expand", [
+        ([_c(320, 1)], None),
+        ([_c(384, 1)], [[_c(384, (1, 3), pad=(0, 1))],
+                        [_c(384, (3, 1), pad=(1, 0))]]),
+        ([_c(448, 1), _c(384, 3, pad=1)],
+         [[_c(384, (1, 3), pad=(0, 1))], [_c(384, (3, 1), pad=(1, 0))]]),
+        (["avgpool", _c(192, 1)], None),
+    ])
 
 
-class _InceptionE(HybridBlock):
-    def __init__(self, prefix=None):
-        super(_InceptionE, self).__init__(prefix=prefix)
+# 35x35 A mixes, the 17x17 reduction + C mixes, the 8x8 reduction + tail
+_STAGES = (
+    _a_stage("A1_", 32),
+    _a_stage("A2_", 64),
+    _a_stage("A3_", 64),
+    ("B_", [
+        [_c(384, 3, stride=2)],
+        [_c(64, 1), _c(96, 3, pad=1), _c(96, 3, stride=2)],
+        ["maxpool"],
+    ]),
+    _c_stage("C1_", 128),
+    _c_stage("C2_", 160),
+    _c_stage("C3_", 160),
+    _c_stage("C4_", 192),
+    ("D_", [
+        [_c(192, 1), _c(320, 3, stride=2)],
+        [_c(192, 1), _c(192, (1, 7), pad=(0, 3)),
+         _c(192, (7, 1), pad=(3, 0)), _c(192, 3, stride=2)],
+        ["maxpool"],
+    ]),
+    _e_stage("E1_"),
+    _e_stage("E2_"),
+)
+
+
+class _Mix(HybridBlock):
+    """Concat-on-channels over parallel branches from a table row."""
+
+    def __init__(self, branches, prefix=None):
+        super(_Mix, self).__init__(prefix=prefix)
         with self.name_scope():
-            self.branch1 = _make_branch(None, (320, 1, None, None))
-            self.branch2_stem = _make_branch(None, (384, 1, None, None))
-            self.branch2_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-            self.branch2_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-            self.branch3_stem = _make_branch(None, (448, 1, None, None),
-                                             (384, 3, None, 1))
-            self.branch3_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-            self.branch3_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-            self.branch4 = _make_branch("avg", (192, 1, None, None))
+            for rows in branches:
+                self.register_child(_branch(rows))
 
     def hybrid_forward(self, F, x):
-        b1 = self.branch1(x)
-        s2 = self.branch2_stem(x)
-        b2 = F.Concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
-        s3 = self.branch3_stem(x)
-        b3 = F.Concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
-        b4 = self.branch4(x)
-        return F.Concat(b1, b2, b3, b4, dim=1)
+        return F.Concat(*[b(x) for b in self._children.values()], dim=1)
+
+
+class _ExpandedMix(HybridBlock):
+    """Tail mix whose branches may fork: each entry is (stem rows,
+    fork branch lists or None); fork outputs concat before the outer
+    concat. Children register stem-then-forks per branch, the order the
+    parameter-name contract fixes."""
+
+    def __init__(self, spec, prefix=None):
+        super(_ExpandedMix, self).__init__(prefix=prefix)
+        self._plan = []
+        with self.name_scope():
+            for rows, forks in spec:
+                stem = _branch(rows)
+                self.register_child(stem)
+                arms = []
+                if forks:
+                    for fork_rows in forks:
+                        arm = _branch(fork_rows)
+                        self.register_child(arm)
+                        arms.append(arm)
+                self._plan.append((stem, arms))
+
+    def hybrid_forward(self, F, x):
+        outs = []
+        for stem, arms in self._plan:
+            y = stem(x)
+            if arms:
+                y = F.Concat(*[arm(y) for arm in arms], dim=1)
+            outs.append(y)
+        return F.Concat(*outs, dim=1)
 
 
 class Inception3(HybridBlock):
+    """Inception V3 assembled from the topology tables above
+    (reference: inception.py Inception3)."""
+
     def __init__(self, classes=1000, **kwargs):
         super(Inception3, self).__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_InceptionE("E1_"))
-            self.features.add(_InceptionE("E2_"))
+            for row in _STEM:
+                if row == "maxpool":
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                else:
+                    self.features.add(_cbr(row))
+            for stage in _STAGES:
+                if stage[1] == "expand":
+                    self.features.add(_ExpandedMix(stage[2],
+                                                   prefix=stage[0]))
+                else:
+                    self.features.add(_Mix(stage[1], prefix=stage[0]))
             self.features.add(nn.AvgPool2D(pool_size=8))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=None, root="~/.mxnet/models",
                  **kwargs):
+    """Reference: inception.py inception_v3."""
     net = Inception3(**kwargs)
     if pretrained:
-        net.load_parameters(get_model_file("inceptionv3", root=root), ctx=ctx)
+        net.load_parameters(get_model_file("inceptionv3", root=root),
+                            ctx=ctx)
     return net

@@ -1,6 +1,18 @@
-"""ResNet V1/V2 (reference: python/mxnet/gluon/model_zoo/vision/resnet.py).
+"""ResNet V1/V2 for the gluon model zoo.
 
-18/34/50/101/152 variants, both He2015 (v1) and pre-activation (v2)."""
+Capability parity with the reference zoo
+(python/mxnet/gluon/model_zoo/vision/resnet.py): depths 18/34/50/101/
+152 in both the He2015 post-activation (v1) and the pre-activation (v2)
+arrangements, same parameter names so published ``.params`` files load.
+
+Implementation is table-driven rather than one class per variant: each
+residual unit's conv stack is a row of ``_UNIT_TABLE`` keyed by
+(version, kind) — kernel size, where the stride lands, padding, the
+channel divisor, and whether the conv carries a bias (the reference's
+v1 bottleneck keeps biases on its 1x1 convs; preserved here because the
+parameter sets must match) — and a single ``_Unit``/``_ResNet`` pair
+interprets the table.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -14,225 +26,188 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+# Conv rows per residual unit: (kernel, takes_stride, padding,
+# channel_divisor, with_bias). The unit's output channel count divided
+# by ``channel_divisor`` gives the conv width; ``takes_stride`` marks
+# where the unit's stride is applied (v1 strides its first conv, v2
+# bottlenecks stride the middle 3x3 — the reference's arrangement).
+_UNIT_TABLE = {
+    (1, "basic"): ((3, True, 1, 1, False), (3, False, 1, 1, False)),
+    (1, "bottleneck"): ((1, True, 0, 4, True), (3, False, 1, 4, False),
+                        (1, False, 0, 1, True)),
+    (2, "basic"): ((3, True, 1, 1, False), (3, False, 1, 1, False)),
+    (2, "bottleneck"): ((1, False, 0, 4, False), (3, True, 1, 4, False),
+                        (1, False, 0, 1, False)),
+}
 
 
-class BasicBlockV1(HybridBlock):
-    """Reference: resnet.py BasicBlockV1 (resnet 18/34 v1)."""
+def _unit_conv(row, channels, stride, in_channels=0):
+    kernel, takes_stride, pad, div, bias = row
+    return nn.Conv2D(channels // div, kernel_size=kernel,
+                     strides=stride if takes_stride else 1, padding=pad,
+                     use_bias=bias, in_channels=in_channels)
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super(BasicBlockV1, self).__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+
+class _Unit(HybridBlock):
+    """One residual unit interpreting a ``_UNIT_TABLE`` row.
+
+    v1 wraps conv/BN pairs in a ``body`` Sequential with the ReLU
+    between pairs and adds the skip AFTER the last BN; v2 registers
+    BN->ReLU->conv triples flat (pre-activation) and draws the skip
+    from the first activation. Child registration order matches the
+    reference blocks so auto-generated parameter names line up."""
+
+    def __init__(self, version, kind, channels, stride, downsample=False,
+                 in_channels=0, **kwargs):
+        super(_Unit, self).__init__(**kwargs)
+        self._version = version
+        rows = _UNIT_TABLE[(version, kind)]
+        if version == 1:
+            self.body = nn.HybridSequential(prefix="")
+            for i, row in enumerate(rows):
+                ic = in_channels if i == 0 and row[0] == 3 else 0
+                self.body.add(_unit_conv(row, channels, stride, ic))
+                self.body.add(nn.BatchNorm())
+                if i + 1 < len(rows):
+                    self.body.add(nn.Activation("relu"))
+            if downsample:
+                self.downsample = nn.HybridSequential(prefix="")
+                self.downsample.add(nn.Conv2D(
+                    channels, kernel_size=1, strides=stride,
+                    use_bias=False, in_channels=in_channels))
+                self.downsample.add(nn.BatchNorm())
+            else:
+                self.downsample = None
         else:
-            self.downsample = None
+            self._steps = []
+            for i, row in enumerate(rows):
+                bn = nn.BatchNorm()
+                ic = in_channels if i == 0 and row[0] == 3 else 0
+                conv = _unit_conv(row, channels, stride, ic)
+                setattr(self, "bn%d" % (i + 1), bn)
+                setattr(self, "conv%d" % (i + 1), conv)
+                self._steps.append((bn, conv))
+            if downsample:
+                self.downsample = nn.Conv2D(
+                    channels, 1, stride, use_bias=False,
+                    in_channels=in_channels)
+            else:
+                self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+        if self._version == 1:
+            shortcut = x if self.downsample is None else self.downsample(x)
+            return F.Activation(self.body(x) + shortcut, act_type="relu")
+        shortcut = x
+        for i, (bn, conv) in enumerate(self._steps):
+            x = F.Activation(bn(x), act_type="relu")
+            if i == 0 and self.downsample is not None:
+                shortcut = self.downsample(x)
+            x = conv(x)
+        return x + shortcut
 
 
-class BottleneckV1(HybridBlock):
-    """Reference: resnet.py BottleneckV1 (resnet 50/101/152 v1)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
+def BasicBlockV1(channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super(BottleneckV1, self).__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+    """Reference parity: resnet.py BasicBlockV1 (resnet 18/34 v1)."""
+    return _Unit(1, "basic", channels, stride, downsample, in_channels,
+                 **kwargs)
 
 
-class BasicBlockV2(HybridBlock):
-    """Reference: resnet.py BasicBlockV2 (pre-activation)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
+def BottleneckV1(channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super(BasicBlockV2, self).__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+    """Reference parity: resnet.py BottleneckV1 (resnet 50/101/152 v1)."""
+    return _Unit(1, "bottleneck", channels, stride, downsample,
+                 in_channels, **kwargs)
 
 
-class BottleneckV2(HybridBlock):
-    """Reference: resnet.py BottleneckV2."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
+def BasicBlockV2(channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super(BottleneckV2, self).__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+    """Reference parity: resnet.py BasicBlockV2 (pre-activation)."""
+    return _Unit(2, "basic", channels, stride, downsample, in_channels,
+                 **kwargs)
 
 
-class ResNetV1(HybridBlock):
-    """Reference: resnet.py ResNetV1."""
+def BottleneckV2(channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+    """Reference parity: resnet.py BottleneckV2."""
+    return _Unit(2, "bottleneck", channels, stride, downsample,
+                 in_channels, **kwargs)
 
-    def __init__(self, block, layers, channels, classes=1000,
+
+class _ResNet(HybridBlock):
+    """Stem + staged residual units + classifier, for either version.
+
+    version 2 brackets the stages with the extra featureless BatchNorm
+    up front and BN->ReLU after (the pre-activation arrangement needs
+    its own final activation before pooling)."""
+
+    version = None
+
+    def __init__(self, kind, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
-        super(ResNetV1, self).__init__(**kwargs)
+        super(_ResNet, self).__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        v = self.version
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
+            if v == 2:
+                self.features.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
+                                            use_bias=False, in_channels=0))
             else:
                 self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
                                             use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-class ResNetV2(HybridBlock):
-    """Reference: resnet.py ResNetV2."""
-
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super(ResNetV2, self).__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+            in_ch = channels[0]
+            for i, n_units in enumerate(layers):
+                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                out_ch = channels[i + 1]
+                with stage.name_scope():
+                    for j in range(n_units):
+                        stage.add(_Unit(
+                            v, kind, out_ch,
+                            stride=(2 if i > 0 and j == 0 else 1),
+                            downsample=(j == 0 and out_ch != in_ch),
+                            in_channels=in_ch if j == 0 else out_ch,
+                            prefix=""))
+                self.features.add(stage)
+                in_ch = out_ch
+            if v == 2:
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
             self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
+            if v == 2:
+                self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes, in_units=in_ch)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
+
+
+class ResNetV1(_ResNet):
+    """Reference parity: resnet.py ResNetV1 (accepts a block factory
+    like the reference's class argument; the factory selects the
+    _UNIT_TABLE row)."""
+
+    version = 1
+
+    def __init__(self, block, layers, channels, **kwargs):
+        kind = "bottleneck" if block is BottleneckV1 else "basic"
+        super(ResNetV1, self).__init__(kind, layers, channels, **kwargs)
+
+
+class ResNetV2(_ResNet):
+    """Reference parity: resnet.py ResNetV2."""
+
+    version = 2
+
+    def __init__(self, block, layers, channels, **kwargs):
+        kind = "bottleneck" if block is BottleneckV2 else "basic"
+        super(ResNetV2, self).__init__(kind, layers, channels, **kwargs)
 
 
 resnet_spec = {
@@ -266,41 +241,22 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None,
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _variant(version, num_layers):
+    def build(**kwargs):
+        return get_resnet(version, num_layers, **kwargs)
+    build.__name__ = "resnet%d_v%d" % (num_layers, version)
+    build.__doc__ = "ResNet-%d v%d (reference: resnet.py %s)." % (
+        num_layers, version, build.__name__)
+    return build
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _variant(1, 18)
+resnet34_v1 = _variant(1, 34)
+resnet50_v1 = _variant(1, 50)
+resnet101_v1 = _variant(1, 101)
+resnet152_v1 = _variant(1, 152)
+resnet18_v2 = _variant(2, 18)
+resnet34_v2 = _variant(2, 34)
+resnet50_v2 = _variant(2, 50)
+resnet101_v2 = _variant(2, 101)
+resnet152_v2 = _variant(2, 152)
