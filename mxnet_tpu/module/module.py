@@ -128,9 +128,20 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, tuple(o.shape))
-                for n, o in zip(self._output_names, self._exec.outputs)] \
-            if self._exec.outputs else None
+        if self._exec.outputs:
+            return [(n, tuple(o.shape))
+                    for n, o in zip(self._output_names, self._exec.outputs)]
+        # before the first forward, infer statically from the bound
+        # input shapes (SequentialModule chains shapes at bind time)
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shape_kwargs.update({l.name: l.shape
+                                 for l in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        if out_shapes is None:
+            return None
+        return list(zip(self._output_names,
+                        [tuple(s) for s in out_shapes]))
 
     # -- parameters --------------------------------------------------------
     def get_params(self):

@@ -1,0 +1,157 @@
+"""PythonModule: module-API adapters for arbitrary Python computation.
+
+Capability parity with the reference
+(python/mxnet/module/python_module.py:28): ``PythonModule`` is the
+parameterless base that answers the module protocol (names, shapes,
+no-op update), and ``PythonLossModule`` turns a score->gradient
+function into a terminal loss module — the piece that lets a
+SequentialModule end in hand-written Python math.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..initializer import Uniform
+from ..io import DataDesc
+from ..ndarray.ndarray import NDArray, array as _nd_array
+from .base_module import BaseModule
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """Subclass and override ``forward``/``backward`` (and
+    ``_compute_output_shapes`` when outputs differ from inputs) to drop
+    arbitrary Python computation into a module stack (reference:
+    python_module.py PythonModule)."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super(PythonModule, self).__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._output_shapes
+
+    # a PythonModule owns no parameters (reference contract)
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        self.params_initialized = True
+
+    def update(self):
+        pass
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._label_shapes:
+            eval_metric.update(labels, self.get_outputs())
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = [ds if isinstance(ds, DataDesc)
+                             else DataDesc(*ds) for ds in data_shapes]
+        if label_shapes is not None:
+            self._label_shapes = [ls if isinstance(ls, DataDesc)
+                                  else DataDesc(*ls)
+                                  for ls in label_shapes]
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError()
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()
+
+
+class PythonLossModule(PythonModule):
+    """Terminal loss module: forward passes scores through, backward
+    produces d(loss)/d(scores) from ``grad_func(scores, labels)``
+    (reference: python_module.py PythonLossModule)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        assert len(data_names) == 1 and len(label_names) == 1
+        super(PythonLossModule, self).__init__(
+            data_names, label_names, [name + "_output"], logger=logger)
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        # a loss head emits the scores it receives
+        return [DataDesc(self._name + "_output",
+                         self._data_shapes[0].shape)]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        assert merge_multi_context
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, \
+            "a loss module takes no output gradients"
+        assert self.for_training
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "pass grad_func or override backward")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, NDArray):
+            grad = _nd_array(_np.asarray(grad))
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert merge_multi_context
+        return [self._scores_grad]

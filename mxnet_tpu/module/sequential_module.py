@@ -1,0 +1,217 @@
+"""SequentialModule: chain modules end to end.
+
+Capability parity with the reference container
+(python/mxnet/module/sequential_module.py:28): each added module
+consumes the previous module's outputs as its data; ``take_labels``
+marks the modules that also receive the batch labels (typically the
+last, the loss), and ``auto_wiring`` renames the previous outputs to
+the next module's data names. Intermediate modules are bound with
+``inputs_need_grad`` so gradients chain backward through the stack.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..initializer import Uniform
+from ..io import DataBatch, DataDesc
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """A container chaining sub-modules (reference:
+    sequential_module.py SequentialModule)."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super(SequentialModule, self).__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._data_shapes = None
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        """Append ``module``; kwargs are the META_* flags. Returns self
+        so adds chain."""
+        known = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        for key in kwargs:
+            if key not in known:
+                raise ValueError("unknown meta %r (have %s)"
+                                 % (key, sorted(known)))
+        self._modules.append(module)
+        self._metas.append(dict(kwargs))
+        # adding invalidates any existing binding state
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # -- shapes / names ----------------------------------------------------
+
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    # -- params ------------------------------------------------------------
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            arg, aux = module.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        seen = {}
+        for i, module in enumerate(self._modules):
+            module.init_params(initializer=initializer,
+                               arg_params=arg_params,
+                               aux_params=aux_params,
+                               allow_missing=allow_missing,
+                               force_init=force_init,
+                               allow_extra=allow_extra)
+            arg, aux = module.get_params()
+            for name in list(arg) + list(aux):
+                if name in seen:
+                    raise ValueError(
+                        "duplicate parameter %r in modules %d and %d — "
+                        "chained modules must have disjoint names"
+                        % (name, seen[name], i))
+                seen[name] = i
+        self.params_initialized = True
+
+    # -- bind / optimizer --------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        assert shared_module is None, \
+            "shared_module is not supported for SequentialModule"
+        assert self._modules, "add modules before binding"
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = [DataDesc(*ds) if not isinstance(ds, DataDesc)
+                             else ds for ds in data_shapes]
+        self._label_shapes = label_shapes
+
+        cur_shapes = self._data_shapes
+        last = len(self._modules) - 1
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            labels = label_shapes if meta.get(self.META_TAKE_LABELS) \
+                else None
+            # auto_wiring on THIS module renames the previous module's
+            # outputs to this module's own data names
+            if i > 0 and meta.get(self.META_AUTO_WIRING):
+                names = module.data_names
+                assert len(names) == len(cur_shapes), \
+                    "auto_wiring: %d outputs feed %d inputs" % (
+                        len(cur_shapes), len(names))
+                cur_shapes = [DataDesc(n, d.shape)
+                              for n, d in zip(names, cur_shapes)]
+            # every module except the first must produce input grads so
+            # the backward pass chains through
+            need_grad = inputs_need_grad if i == 0 else for_training
+            module.bind(data_shapes=cur_shapes, label_shapes=labels,
+                        for_training=for_training,
+                        inputs_need_grad=need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            if i < last:
+                cur_shapes = [os if isinstance(os, DataDesc)
+                              else DataDesc(*os)
+                              for os in module.output_shapes]
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = data_batch
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            batch = DataBatch(data=module.get_outputs(),
+                              label=data_batch.label,
+                              pad=getattr(data_batch, "pad", 0))
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for i, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads=grads)
+            if i == 0:
+                break
+            grads = module.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._modules[0].get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        assert self.binded and self.params_initialized
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                module.update_metric(eval_metric, labels,
+                                     pre_sliced=pre_sliced)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -166,3 +166,146 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
     assert mod.get_outputs()[0].shape == (4, 4)
+
+
+# ---------------------------------------------------------------------------
+# SequentialModule + PythonModule (reference:
+# python/mxnet/module/sequential_module.py:28, python_module.py:28)
+
+
+def test_sequential_module_fit_convergence():
+    """Two chained Modules (feature stack -> loss head) train through
+    SequentialModule.fit to the same accuracy bar as the monolith."""
+    from mxnet_tpu.module import SequentialModule
+
+    data, labels = _synthetic_mnist(n=1000)
+    train = io.NDArrayIter(data, labels, batch_size=100, shuffle=True)
+
+    d = mx.sym.Variable("data")
+    feat = mx.sym.Activation(
+        mx.sym.FullyConnected(d, name="fc1", num_hidden=64),
+        name="relu1", act_type="relu")
+    d2 = mx.sym.Variable("data")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d2, name="fc2", num_hidden=10),
+        name="softmax")
+
+    seq = SequentialModule()
+    seq.add(Module(feat, label_names=None, context=mx.cpu()))
+    seq.add(Module(head, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2}, num_epoch=4)
+    metric = mx.metric.Accuracy()
+    seq.score(io.NDArrayIter(data, labels, batch_size=100), metric)
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+def test_sequential_module_shapes_and_params():
+    from mxnet_tpu.module import SequentialModule
+
+    d = mx.sym.Variable("data")
+    feat = mx.sym.FullyConnected(d, name="fc1", num_hidden=8)
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc2",
+                              num_hidden=3), name="softmax")
+    seq = SequentialModule()
+    seq.add(Module(feat, label_names=None, context=mx.cpu()))
+    seq.add(Module(head, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    assert seq.data_names == ["data"]
+    assert tuple(seq.output_shapes[0][1]) == (4, 3)
+    args, _ = seq.get_params()
+    assert set(args) == {"fc1_weight", "fc1_bias",
+                         "fc2_weight", "fc2_bias"}
+
+
+def test_python_loss_module_trains_in_chain():
+    """A PythonLossModule (hand-written softmax-CE gradient) terminates
+    the chain; the feature module still learns."""
+    from mxnet_tpu.module import PythonLossModule, SequentialModule
+
+    rng = np.random.RandomState(0)
+    n, d, k = 400, 20, 4
+    centers = rng.randn(k, d).astype(np.float32) * 2.0
+    labels = rng.randint(0, k, size=n)
+    data = centers[labels] + rng.randn(n, d).astype(np.float32) * 0.5
+    it = io.NDArrayIter(data.astype(np.float32),
+                        labels.astype(np.float32), batch_size=50,
+                        shuffle=True)
+
+    scores_sym = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                       name="fc", num_hidden=k)
+
+    def softmax_ce_grad(scores, lab):
+        s = scores.asnumpy()
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        onehot = np.eye(k, dtype=np.float32)[lab.asnumpy().astype(int)]
+        return (p - onehot) / s.shape[0]
+
+    seq = SequentialModule()
+    seq.add(Module(scores_sym, label_names=None, context=mx.cpu()))
+    seq.add(PythonLossModule(grad_func=softmax_ce_grad),
+            take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params()
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    metric = mx.metric.Accuracy()
+    for _ in range(6):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+def test_bf16_end_to_end_convergence():
+    """Mixed-precision end-to-end training at bfloat16 reaches the
+    accuracy bar — the TPU analog of the reference's float16 training
+    check (tests/python/train/test_dtype.py): bf16 params/compute,
+    same convergence contract as fp32."""
+    from mxnet_tpu import gluon, autograd
+
+    data, labels = _synthetic_mnist(n=1000)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu"))
+        net.add(gluon.nn.Dense(10))
+    net.initialize()
+    net.cast("bfloat16")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    it = io.NDArrayIter(data, labels, batch_size=100, shuffle=True)
+    for _ in range(4):
+        it.reset()
+        for batch in it:
+            x = batch.data[0].astype("bfloat16")
+            y = batch.label[0]
+            with autograd.record():
+                out = loss_fn(net(x), y)
+            out.backward()
+            trainer.step(x.shape[0])
+
+    correct = total = 0
+    it.reset()
+    for batch in it:
+        pred = net(batch.data[0].astype("bfloat16")).asnumpy()
+        pred = pred.astype(np.float32).argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        n_real = batch.data[0].shape[0] - batch.pad
+        correct += (pred[:n_real] == lab[:n_real]).sum()
+        total += n_real
+    acc = correct / total
+    assert acc > 0.9, acc
