@@ -1,0 +1,40 @@
+/*
+ * C predict ABI for mxnet_tpu (implementation:
+ * src/native/c_predict_api.cc). Capability analog of the reference's
+ * include/mxnet/c_predict_api.h — the minimal inference surface
+ * language bindings link against (cpp-package predictor.hpp, the
+ * amalgamation build, and perl-package all consume this header's
+ * contract).
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+
+const char* MXGetLastError(void);
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, uint32_t size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t* shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -1,0 +1,117 @@
+/* XS glue for AI::MXNetTPU — the Perl binding over the C predict ABI.
+ *
+ * Capability analog of the reference's perl-package (AI::MXNet, which
+ * binds the full c_api.h through generated XS): this proof-of-design
+ * binding covers the inference surface, demonstrating that the flat C
+ * ABI + per-language thin glue pattern reaches Perl the same way it
+ * reaches C++ (cpp-package) and ctypes (Python).
+ *
+ * Data crosses as packed native-float strings (pack "f*", ...) so no
+ * non-core Perl modules are needed.
+ */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxnet_tpu/c_predict_api.h"
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+const char*
+last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+_create(symbol_json, param_bytes_sv, dev_type, dev_id, input_key, shape_av)
+    const char* symbol_json
+    SV* param_bytes_sv
+    int dev_type
+    int dev_id
+    const char* input_key
+    AV* shape_av
+  CODE:
+    STRLEN plen;
+    const char* pbytes = SvPVbyte(param_bytes_sv, plen);
+    SSize_t ndim = av_len(shape_av) + 1;
+    uint32_t indptr[2];
+    uint32_t* shape = (uint32_t*)malloc(sizeof(uint32_t) * (ndim > 0 ? ndim : 1));
+    SSize_t i;
+    for (i = 0; i < ndim; ++i) {
+      SV** elem = av_fetch(shape_av, i, 0);
+      shape[i] = (uint32_t)(elem ? SvUV(*elem) : 0);
+    }
+    indptr[0] = 0;
+    indptr[1] = (uint32_t)ndim;
+    const char* keys[1];
+    keys[0] = input_key;
+    PredictorHandle h = NULL;
+    int rc = MXPredCreate(symbol_json, pbytes, (int)plen, dev_type, dev_id,
+                          1, keys, indptr, shape, &h);
+    free(shape);
+    if (rc != 0) croak("MXPredCreate failed: %s", MXGetLastError());
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+_set_input(handle, key, packed_floats)
+    IV handle
+    const char* key
+    SV* packed_floats
+  CODE:
+    STRLEN len;
+    const char* buf = SvPVbyte(packed_floats, len);
+    if (MXPredSetInput(INT2PTR(PredictorHandle, handle), key,
+                       (const float*)buf,
+                       (uint32_t)(len / sizeof(float))) != 0)
+      croak("MXPredSetInput failed: %s", MXGetLastError());
+
+void
+_forward(handle)
+    IV handle
+  CODE:
+    if (MXPredForward(INT2PTR(PredictorHandle, handle)) != 0)
+      croak("MXPredForward failed: %s", MXGetLastError());
+
+void
+_output_shape(handle, index)
+    IV handle
+    UV index
+  PPCODE:
+    uint32_t shape[32];
+    uint32_t ndim = 0;
+    if (MXPredGetOutputShape(INT2PTR(PredictorHandle, handle),
+                             (uint32_t)index, shape, &ndim) != 0)
+      croak("MXPredGetOutputShape failed: %s", MXGetLastError());
+    uint32_t i;
+    EXTEND(SP, ndim);
+    for (i = 0; i < ndim; ++i) mPUSHu(shape[i]);
+
+SV*
+_output(handle, index, size)
+    IV handle
+    UV index
+    UV size
+  CODE:
+    SV* out = newSV(size * sizeof(float));
+    SvPOK_on(out);
+    if (MXPredGetOutput(INT2PTR(PredictorHandle, handle), (uint32_t)index,
+                        (float*)SvPVX(out), (uint32_t)size) != 0) {
+      SvREFCNT_dec(out);
+      croak("MXPredGetOutput failed: %s", MXGetLastError());
+    }
+    SvCUR_set(out, size * sizeof(float));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+_free(handle)
+    IV handle
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, handle));

@@ -1,0 +1,42 @@
+#!/usr/bin/perl
+# Build the AI::MXNetTPU XS extension (no non-core modules needed):
+# xsubpp the glue, compile with the toolchain g++, link against
+# build/native/libmxtpu_predict.so. Run from any cwd:
+#   perl perl-package/AI-MXNetTPU/build.pl
+# The loadable lands in blib/arch/auto/AI/MXNetTPU/ (DynaLoader layout);
+# use with  perl -I<pkg>/lib -I<pkg>/blib/arch ...
+use strict;
+use warnings;
+use Config;
+use File::Basename qw(dirname);
+use File::Path qw(make_path);
+use File::Spec;
+use ExtUtils::ParseXS;
+
+my $pkg  = File::Spec->rel2abs(dirname(__FILE__));
+my $root = dirname(dirname($pkg));
+my $native = File::Spec->catdir($root, "build", "native");
+
+die "build libmxtpu_predict.so first (make -C src/native)\n"
+    unless -e File::Spec->catfile($native, "libmxtpu_predict.so");
+
+my $arch_auto = File::Spec->catdir($pkg, "blib", "arch", "auto",
+                                   "AI", "MXNetTPU");
+make_path($arch_auto);
+
+my $typemap = File::Spec->catfile($Config{privlib}, "ExtUtils", "typemap");
+my $xs = File::Spec->catfile($pkg, "MXNetTPU.xs");
+my $c  = File::Spec->catfile($pkg, "MXNetTPU.c");
+ExtUtils::ParseXS->new->process_file(
+    filename => $xs, output => $c, typemap => $typemap);
+
+my $core = File::Spec->catdir($Config{archlib}, "CORE");
+my $so = File::Spec->catfile($arch_auto, "MXNetTPU.$Config{dlext}");
+my @cmd = ("g++", "-shared", "-fPIC", "-O2", $c,
+           "-I", $core, "-I", File::Spec->catdir($root, "include"),
+           split(" ", $Config{ccflags} || ""),
+           "-DVERSION=\"0.1.0\"", "-DXS_VERSION=\"0.1.0\"",
+           "-o", $so, "-L", $native, "-lmxtpu_predict",
+           "-Wl,-rpath,$native");
+system(@cmd) == 0 or die "compile failed: @cmd\n";
+print "built $so\n";

@@ -1,0 +1,83 @@
+package AI::MXNetTPU;
+
+# Perl binding for mxnet_tpu inference (capability analog of the
+# reference's perl-package AI::MXNet, scoped to the predict ABI as the
+# cheap-binding proof the flat C surface is designed for).
+#
+#   my $pred = AI::MXNetTPU::Predictor->new(
+#       symbol_json => $json, params => $param_bytes,
+#       input_name => "data", input_shape => [1, 4]);
+#   my @probs = $pred->predict(@values);
+
+use strict;
+use warnings;
+use DynaLoader ();
+
+our $VERSION = "0.1.0";
+our @ISA = ("DynaLoader");
+
+# the shared object is built by build.pl next to this tree
+sub dl_load_flags { 0x01 }    # RTLD_GLOBAL for the embedded CPython
+
+__PACKAGE__->bootstrap($VERSION);
+
+package AI::MXNetTPU::Predictor;
+
+use strict;
+use warnings;
+use Carp ();
+
+sub new {
+    my ($class, %args) = @_;
+    for my $req (qw(symbol_json params input_shape)) {
+        Carp::croak("missing required argument $req")
+            unless defined $args{$req};
+    }
+    my $handle = AI::MXNetTPU::_create(
+        $args{symbol_json}, $args{params},
+        $args{dev_type} // 1, $args{dev_id} // 0,
+        $args{input_name} // "data", $args{input_shape});
+    return bless {
+        handle     => $handle,
+        input_name => $args{input_name} // "data",
+    }, $class;
+}
+
+sub set_input {
+    my ($self, @values) = @_;
+    AI::MXNetTPU::_set_input($self->{handle}, $self->{input_name},
+                             pack("f*", @values));
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXNetTPU::_forward($self->{handle});
+    return $self;
+}
+
+sub output_shape {
+    my ($self, $index) = @_;
+    return AI::MXNetTPU::_output_shape($self->{handle}, $index // 0);
+}
+
+sub output {
+    my ($self, $index) = @_;
+    $index //= 0;
+    my $size = 1;
+    $size *= $_ for $self->output_shape($index);
+    return unpack("f*",
+                  AI::MXNetTPU::_output($self->{handle}, $index, $size));
+}
+
+sub predict {
+    my ($self, @values) = @_;
+    return $self->set_input(@values)->forward->output(0);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_free($self->{handle}) if defined $self->{handle};
+}
+
+1;

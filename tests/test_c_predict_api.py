@@ -7,6 +7,7 @@ cpp-package/include/mxnet_tpu_cpp/predictor.hpp, linked to
 build/native/libmxtpu_predict.so, run as a separate process.
 """
 import os
+import shutil
 import subprocess
 import sys
 
@@ -108,3 +109,53 @@ def test_c_predict_end_to_end(tmp_path, native_lib):
     assert lines[0].strip() == "shape 2 3"
     got = np.array([float(v) for v in lines[1].split()]).reshape(2, 3)
     np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_perl_binding_predicts(tmp_path, native_lib):
+    """perl-package proof (reference perl-package/ AI::MXNet analog):
+    the XS binding over the predict ABI builds with core-Perl tooling
+    only and reproduces the Python-side softmax probabilities."""
+    perl = shutil.which("perl")
+    if perl is None:
+        pytest.skip("no perl interpreter")
+    pkg = os.path.join(REPO, "perl-package", "AI-MXNetTPU")
+    r = subprocess.run([perl, os.path.join(pkg, "build.pl")],
+                       capture_output=True, text=True)
+    if r.returncode != 0 and "ExtUtils" in (r.stderr or ""):
+        pytest.skip("perl lacks ExtUtils::ParseXS: " + r.stderr[:200])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    json_path, params_path, expect = _build_artifacts(tmp_path)
+    script = tmp_path / "predict.pl"
+    script.write_text("""
+use strict; use warnings;
+use AI::MXNetTPU;
+my ($json_path, $params_path) = @ARGV;
+local $/;
+open(my $jf, "<", $json_path) or die $!;  my $json = <$jf>;
+open(my $pf, "<:raw", $params_path) or die $!;  my $params = <$pf>;
+my $pred = AI::MXNetTPU::Predictor->new(
+    symbol_json => $json, params => $params,
+    input_name => "data", input_shape => [2, 4]);
+my @out = $pred->predict(map { $_ * 0.25 } 0 .. 7);
+print join(" ", map { sprintf("%.6f", $_) } @out), "\\n";
+""")
+    env = _perl_env()
+    r = subprocess.run(
+        [perl, "-I", os.path.join(pkg, "lib"),
+         "-I", os.path.join(pkg, "blib", "arch"),
+         str(script), json_path, params_path],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.array([float(v) for v in r.stdout.split()]).reshape(2, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def _perl_env():
+    env = dict(os.environ)
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + site +
+                                        [env.get("PYTHONPATH", "")])
+    env.pop("PYTHONHOME", None)
+    env["MXNET_TPU_PLATFORM"] = "cpu"
+    return env
