@@ -113,6 +113,16 @@ class KVStore(object):
             raise MXNetError("kvstore server error: %s" % payload)
         return payload
 
+    def _server_profiler_command(self, cmd, payload):
+        """Route a profiler command to the PS server process
+        (reference: KVStoreServerProfilerCommand, kvstore.h:49;
+        exercised by tests/nightly/test_server_profiling.py)."""
+        if self._sock is None:
+            raise MXNetError(
+                "server profiler commands need a dist kvstore connected "
+                "to a PS server")
+        return self._ps_call("PROFILER", cmd, payload)
+
     # -- identity ----------------------------------------------------------
     @property
     def type(self):

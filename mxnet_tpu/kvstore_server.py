@@ -187,6 +187,21 @@ class KVStoreServer(object):
                         if now - self._last_seen.get(r, self._start_time)
                         > timeout]
             return ("OK", dead)
+        if op == "PROFILER":
+            # remote profiler control from workers (reference:
+            # KVStoreServerProfilerCommand kSetConfig/kState/kDump,
+            # include/mxnet/kvstore.h:49): runs against THIS server
+            # process's profiler so its own timeline is captured
+            from . import profiler as _prof
+            if key == "set_config":
+                _prof.set_config(**value)
+            elif key == "state":
+                _prof.set_state(value)
+            elif key == "dump":
+                _prof.dump(finished=bool(value))
+            else:
+                return ("ERR", "unknown profiler command %r" % key)
+            return ("OK", None)
         if op == "STOP":
             self._stop.set()
             with self._lock:
@@ -228,7 +243,15 @@ class KVStoreServer(object):
                     with self._lock:
                         self._last_seen[rank] = _t.monotonic()
                 try:
-                    resp = self._handle(*msg)
+                    from . import profiler as _prof
+                    if _prof.is_running() and msg[0] != "PROFILER":
+                        # server-side op timeline for the remote
+                        # profiler (reference: the PS server registers
+                        # its handlers with the process profiler)
+                        with _prof.scope("kvstore_" + msg[0], "kvstore"):
+                            resp = self._handle(*msg)
+                    else:
+                        resp = self._handle(*msg)
                 except Exception:
                     # surface handler failures to the worker instead of
                     # dropping the connection (the reference propagates

@@ -43,8 +43,36 @@ _xla_active = False
 _t0 = time.perf_counter()
 
 
+# dist kvstore used to route profile_process='server' commands to the
+# PS server process (reference: profiler.py set_kvstore_handle +
+# KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49)
+_kvstore = None
+
+
+def set_kvstore_handle(kv):
+    """Register the dist kvstore that carries server-profiler commands
+    (reference: profiler.py set_kvstore_handle)."""
+    global _kvstore
+    _kvstore = kv
+
+
+def _server_command(cmd, payload):
+    if _kvstore is None:
+        raise MXNetError(
+            "profile_process='server' needs a dist kvstore registered "
+            "via profiler.set_kvstore_handle(kv)")
+    _kvstore._server_profiler_command(cmd, payload)
+
+
 def set_config(**kwargs):
-    """Reference: profiler.py set_config."""
+    """Reference: profiler.py set_config. ``profile_process='server'``
+    forwards the config to the PS server process over the kvstore
+    connection (reference: MXSetProcessProfilerConfig + the kvstore
+    profiler command channel)."""
+    if kwargs.get("profile_process") == "server":
+        fwd = {k: v for k, v in kwargs.items() if k != "profile_process"}
+        _server_command("set_config", fwd)
+        return
     for k, v in kwargs.items():
         if k in ("filename", "profile_all", "profile_imperative",
                  "profile_symbolic", "profile_api", "profile_memory",
@@ -98,8 +126,12 @@ def resume():
     _paused = False
 
 
-def set_state(state="stop"):
-    """Reference: profiler.py set_state."""
+def set_state(state="stop", profile_process="worker"):
+    """Reference: profiler.py set_state; ``profile_process='server'``
+    starts/stops the PS server process's profiler remotely."""
+    if profile_process == "server":
+        _server_command("state", state)
+        return
     if state in ("run", "start"):
         start()
     elif state == "stop":
@@ -192,9 +224,14 @@ def dumps(reset=False):
     return "\n".join(lines)
 
 
-def dump(finished=True, filename=None):
+def dump(finished=True, filename=None, profile_process="worker"):
     """Write chrome://tracing JSON (reference: Profiler::DumpProfile,
-    profiler.h:304). Open in chrome://tracing or Perfetto."""
+    profiler.h:304). Open in chrome://tracing or Perfetto.
+    ``profile_process='server'`` dumps the PS server's timeline in the
+    server process."""
+    if profile_process == "server":
+        _server_command("dump", bool(finished))
+        return None
     path = filename or _config["filename"]
     with _events_lock:
         events = list(_events)

@@ -220,3 +220,74 @@ def test_launch_local_two_workers(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "worker 0 ok" in proc.stdout
     assert "worker 1 ok" in proc.stdout
+
+
+def test_server_profiler_remote_control(tmp_path):
+    """Remote profiler start/config/dump on the PS server PROCESS
+    (reference: KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49;
+    tests/nightly/test_server_profiling.py): the worker drives
+    profiler.set_config/set_state/dump with profile_process='server'
+    and the trace file appears, written by the server subprocess."""
+    import json
+    import time
+
+    profile_path = str(tmp_path / "server_profile.json")
+    port_file = str(tmp_path / "port.txt")
+    code = (
+        "import sys\n"
+        "from mxnet_tpu.kvstore_server import KVStoreServer\n"
+        "s = KVStoreServer(port=0, num_workers=1, sync_mode=True)\n"
+        "open(%r, 'w').write(str(s.port))\n"
+        "s.serve_forever()\n" % port_file
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    try:
+        for _ in range(100):
+            if os.path.exists(port_file) and open(port_file).read():
+                break
+            time.sleep(0.2)
+        port = int(open(port_file).read())
+
+        envvars = {"MXNET_TPU_PS_URI": "127.0.0.1",
+                   "MXNET_TPU_PS_PORT": str(port),
+                   "MXNET_TPU_RANK": "0", "MXNET_TPU_NUM_WORKERS": "1"}
+        old = {k: os.environ.get(k) for k in envvars}
+        os.environ.update(envvars)
+        try:
+            from mxnet_tpu import profiler
+            kv = mx.kv.create("dist_sync")
+            profiler.set_kvstore_handle(kv)
+            profiler.set_config(filename=profile_path, profile_all=True,
+                                profile_process="server")
+            profiler.set_state("run", profile_process="server")
+            kv.init("w", mx.nd.zeros((4,)))
+            kv.push("w", mx.nd.ones((4,)))
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)
+            profiler.set_state("stop", profile_process="server")
+            profiler.dump(profile_process="server")
+            kv._ps_call("STOP")
+        finally:
+            profiler.set_kvstore_handle(None)
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    finally:
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    assert os.path.exists(profile_path)
+    with open(profile_path) as f:
+        trace = json.load(f)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert any(n and n.startswith("kvstore_") for n in names), names
+    # events carry the SERVER process pid, not the worker's
+    pids = {e.get("pid") for e in trace["traceEvents"]}
+    assert os.getpid() not in pids
