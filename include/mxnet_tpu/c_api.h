@@ -136,6 +136,47 @@ int MXSetProcessProfilerConfig(int num_params, const char** keys,
 /* state: 0 = stop, 1 = run */
 int MXSetProcessProfilerState(int state);
 int MXDumpProcessProfile(int finished);
+int MXProcessProfilePause(int paused);
+/* aggregate per-op stats table; string valid until next call on this
+ * thread */
+int MXAggregateProfileStatsPrint(const char** out_str, int reset);
+
+/* ---- runtime misc ------------------------------------------------ */
+int MXGetVersion(int* out);
+/* accelerator device count (reference counts CUDA devices) */
+int MXGetGPUCount(int* out);
+int MXRandomSeed(int seed);
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size);
+int MXNDArrayWaitAll(void);
+
+/* ---- NDArray views / queries ------------------------------------- */
+int MXNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                   NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle h, uint32_t idx, NDArrayHandle* out);
+int MXNDArrayReshape(NDArrayHandle h, int ndim, const int* dims,
+                     NDArrayHandle* out);
+/* dev_type codes: 1 cpu, 2 gpu (reference); 3 tpu (extension) */
+int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                        int* out_dev_id);
+/* storage codes: 0 default, 1 row_sparse, 2 csr (reference ids) */
+int MXNDArrayGetStorageType(NDArrayHandle h, int* out);
+
+/* ---- symbol extras ----------------------------------------------- */
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_num,
+                        const char*** out_names);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t* out_num,
+                                const char*** out_names);
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success);
+/* flat [k0, v0, k1, v1, ...]; *out_num = number of pairs */
+int MXSymbolListAttr(SymbolHandle sym, uint32_t* out_num,
+                     const char*** out_kv);
+
+/* ---- kvstore extras ---------------------------------------------- */
+int MXKVStoreSetOptimizer(KVStoreHandle h, const char* name,
+                          int num_params, const char** keys,
+                          const char** vals);
+int MXKVStoreBarrier(KVStoreHandle h);
 
 #ifdef __cplusplus
 }

@@ -32,6 +32,11 @@ __all__ = [
     "iter_list", "iter_create", "iter_next", "iter_reset", "iter_data",
     "iter_label", "iter_pad",
     "profiler_set_config", "profiler_set_state", "profiler_dump",
+    "version", "device_count", "random_seed", "nd_slice", "nd_at",
+    "nd_reshape", "nd_context", "nd_storage_type", "nd_wait_all",
+    "symbol_list_outputs", "symbol_list_aux", "symbol_get_attr",
+    "symbol_list_attr", "kv_set_optimizer", "kv_barrier",
+    "engine_set_bulk_size", "profiler_pause", "profiler_stats_print",
 ]
 
 _DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
@@ -315,3 +320,116 @@ def profiler_dump(finished):
     from . import profiler
     profiler.dump(finished=bool(finished))
     return 0
+
+
+# -- batch-2 surfaces: runtime misc, NDArray views, symbol attrs,
+#    kvstore optimizer/barrier, profiler pause/stats (reference: c_api.cc) --
+
+
+def version():
+    from . import libinfo
+    return int("".join("%02d" % int(x)
+                       for x in libinfo.__version__.split(".")[:3]))
+
+
+def device_count():
+    import jax
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def random_seed(seed):
+    from . import random as _random
+    _random.seed(int(seed))
+    return 0
+
+
+def nd_slice(arr, begin, end):
+    # MXNDArraySlice slices the leading axis (reference: MXNDArraySlice)
+    return arr.slice(begin=(int(begin),), end=(int(end),))
+
+
+def nd_at(arr, idx):
+    return arr[int(idx)]
+
+
+def nd_reshape(arr, shape):
+    return arr.reshape(tuple(int(s) for s in shape))
+
+
+def nd_context(arr):
+    ctx = arr.context
+    return (ctx.device_type, int(ctx.device_id))
+
+
+def nd_storage_type(arr):
+    # reference codes (_STORAGE_TYPE_STR_TO_ID): default 0, rsp 1, csr 2
+    stype = getattr(arr, "stype", "default")
+    return {"default": 0, "row_sparse": 1, "csr": 2}.get(stype, -1)
+
+
+def nd_wait_all():
+    from .ndarray import waitall
+    waitall()
+    return 0
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(key)
+    return "" if v is None else str(v)
+
+
+def symbol_list_attr(sym):
+    attrs = sym.list_attr() or {}
+    out = []
+    for k in sorted(attrs):
+        out.append(str(k))
+        out.append(str(attrs[k]))
+    return out
+
+
+def kv_set_optimizer(kv, name, keys, vals):
+    import ast as _ast
+    from . import optimizer as _opt
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = _ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    kv.set_optimizer(_opt.create(name, **kwargs))
+    return 0
+
+
+def kv_barrier(kv):
+    kv.barrier()
+    return 0
+
+
+def engine_set_bulk_size(size):
+    from . import engine as _engine
+    return int(_engine.set_bulk_size(int(size)))
+
+
+def profiler_pause(paused):
+    from . import profiler as _prof
+    if paused:
+        _prof.pause()
+    else:
+        _prof.resume()
+    return 0
+
+
+def profiler_stats_print(reset):
+    from . import profiler as _prof
+    return _prof.dumps(reset=bool(reset))

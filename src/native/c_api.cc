@@ -782,3 +782,254 @@ MXTPU_API int MXDumpProcessProfile(int finished) {
   Py_DECREF(r);
   return 0;
 }
+
+// ------------------------------------------------------------ runtime misc
+
+MXTPU_API int MXGetVersion(int* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("version", nullptr);
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXGetGPUCount(int* out) {
+  // device count of the attached accelerator backend (the reference
+  // counts CUDA devices; here it is the jax device count)
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("device_count", nullptr);
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXRandomSeed(int seed) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* r = bridge_call("random_seed", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", bulk_size);
+  PyObject* r = bridge_call("engine_set_bulk_size", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (prev_bulk_size != nullptr)
+    *prev_bulk_size = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitAll(void) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("nd_wait_all", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------- NDArray views
+
+static int nd_unary_handle(const char* fn, PyObject* args,
+                           NDArrayHandle* out) {
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                             NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return nd_unary_handle(
+      "nd_slice",
+      Py_BuildValue("(OII)", reinterpret_cast<PyObject*>(h), begin, end),
+      out);
+}
+
+MXTPU_API int MXNDArrayAt(NDArrayHandle h, uint32_t idx,
+                          NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return nd_unary_handle(
+      "nd_at",
+      Py_BuildValue("(OI)", reinterpret_cast<PyObject*>(h), idx), out);
+}
+
+MXTPU_API int MXNDArrayReshape(NDArrayHandle h, int ndim, const int* dims,
+                               NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pshape = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(pshape, i, PyLong_FromLong(dims[i]));
+  return nd_unary_handle(
+      "nd_reshape",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(h), pshape), out);
+}
+
+MXTPU_API int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                                  int* out_dev_id) {
+  // dev_type codes: 1 cpu, 2 gpu (reference); 3 tpu (extension)
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_context", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  const char* dev = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  *out_dev_type = dev && std::strcmp(dev, "cpu") == 0 ? 1
+                : dev && std::strcmp(dev, "gpu") == 0 ? 2 : 3;
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetStorageType(NDArrayHandle h, int* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_storage_type", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ symbol extras
+
+static int sym_string_list(const char* fn, SymbolHandle sym,
+                           uint32_t* out_num, const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out_names = stash_strings(r, out_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_num,
+                                  const char*** out_names) {
+  return sym_string_list("symbol_list_outputs", sym, out_num, out_names);
+}
+
+MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle sym,
+                                          uint32_t* out_num,
+                                          const char*** out_names) {
+  return sym_string_list("symbol_list_aux", sym, out_num, out_names);
+}
+
+MXTPU_API int MXSymbolGetAttr(SymbolHandle sym, const char* key,
+                              const char** out, int* success) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(sym),
+                                 key);
+  PyObject* r = bridge_call("symbol_get_attr", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  const char* v = PyUnicode_AsUTF8(r);
+  if (v != nullptr && v[0] != '\0') {
+    tl_strings.clear();
+    tl_cstrs.clear();
+    tl_strings.emplace_back(v);
+    tl_cstrs.push_back(tl_strings.back().c_str());
+    *out = tl_cstrs[0];
+    *success = 1;
+  } else {
+    *out = nullptr;
+    *success = 0;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAttr(SymbolHandle sym, uint32_t* out_num,
+                               const char*** out_kv) {
+  // flat [key0, val0, key1, val1, ...]; out_num = number of PAIRS
+  uint32_t n = 0;
+  int rc = sym_string_list("symbol_list_attr", sym, &n, out_kv);
+  if (rc == 0) *out_num = n / 2;
+  return rc;
+}
+
+// ------------------------------------------------------------ kvstore extras
+
+MXTPU_API int MXKVStoreSetOptimizer(KVStoreHandle h, const char* name,
+                                    int num_params, const char** keys,
+                                    const char** vals) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(OsNN)", reinterpret_cast<PyObject*>(h),
+                                 name, pkeys, pvals);
+  PyObject* r = bridge_call("kv_set_optimizer", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreBarrier(KVStoreHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("kv_barrier", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ profiler extras
+
+MXTPU_API int MXProcessProfilePause(int paused) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", paused);
+  PyObject* r = bridge_call("profiler_pause", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str,
+                                           int reset) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* r = bridge_call("profiler_stats_print", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+  tl_cstrs.push_back(tl_strings.back().c_str());
+  *out_str = tl_cstrs[0];
+  Py_DECREF(r);
+  return 0;
+}

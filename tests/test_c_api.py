@@ -216,3 +216,78 @@ def test_c_api_data_iter_surface(tmp_path, c_api_lib):
     assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(names)) == 0
     listed = {names[i].decode() for i in range(n.value)}
     assert {"ImageRecordIter", "MNISTIter", "CSVIter"} <= listed
+
+
+def test_c_api_batch2_surfaces(tmp_path, c_api_lib):
+    """Batch-2 ABI functions at the ctypes level: version/device/seed,
+    NDArray views + context/storage queries, symbol listings and attrs,
+    engine bulk size, profiler pause + aggregate stats."""
+    import ctypes
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0 and v.value == 100
+    n = ctypes.c_int()
+    assert lib.MXGetGPUCount(ctypes.byref(n)) == 0 and n.value >= 0
+    assert lib.MXRandomSeed(7) == 0
+    prev = ctypes.c_int()
+    assert lib.MXEngineSetBulkSize(16, ctypes.byref(prev)) == 0
+
+    # NDArray (3, 4) zeros -> slice/at/reshape/context/storage
+    shape = (ctypes.c_uint32 * 2)(3, 4)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, b"cpu", 0,
+                               ctypes.byref(h)) == 0
+    out = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(h, 1, 3, ctypes.byref(out)) == 0
+    ndim = ctypes.c_uint32()
+    dims = (ctypes.c_uint32 * 32)()
+    assert lib.MXNDArrayGetShape(out, ctypes.byref(ndim), dims) == 0
+    assert (ndim.value, dims[0], dims[1]) == (2, 2, 4)
+    lib.MXNDArrayFree(out)
+    assert lib.MXNDArrayAt(h, 0, ctypes.byref(out)) == 0
+    assert lib.MXNDArrayGetShape(out, ctypes.byref(ndim), dims) == 0
+    assert (ndim.value, dims[0]) == (1, 4)
+    lib.MXNDArrayFree(out)
+    rdims = (ctypes.c_int * 2)(4, 3)
+    assert lib.MXNDArrayReshape(h, 2, rdims, ctypes.byref(out)) == 0
+    assert lib.MXNDArrayGetShape(out, ctypes.byref(ndim), dims) == 0
+    assert (dims[0], dims[1]) == (4, 3)
+    lib.MXNDArrayFree(out)
+    dt = ctypes.c_int()
+    di = ctypes.c_int()
+    assert lib.MXNDArrayGetContext(h, ctypes.byref(dt),
+                                   ctypes.byref(di)) == 0
+    assert dt.value in (1, 2, 3) and di.value == 0
+    st = ctypes.c_int()
+    assert lib.MXNDArrayGetStorageType(h, ctypes.byref(st)) == 0
+    assert st.value == 0
+    assert lib.MXNDArrayWaitAll() == 0
+    lib.MXNDArrayFree(h)
+
+    # symbol listings + attr
+    import mxnet_tpu as mx2
+    bn = mx2.sym.BatchNorm(mx2.sym.var("data"), name="bn0")
+    sym = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(bn.tojson().encode(),
+                                      ctypes.byref(sym)) == 0
+    cnt = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListOutputs(sym, ctypes.byref(cnt),
+                                   ctypes.byref(names)) == 0
+    outs = [names[i].decode() for i in range(cnt.value)]
+    assert outs and outs[0].startswith("bn0")
+    assert lib.MXSymbolListAuxiliaryStates(sym, ctypes.byref(cnt),
+                                           ctypes.byref(names)) == 0
+    aux = [names[i].decode() for i in range(cnt.value)]
+    assert "bn0_moving_mean" in aux
+
+    # profiler pause + aggregate stats string
+    assert lib.MXSetProcessProfilerState(1) == 0
+    assert lib.MXProcessProfilePause(1) == 0
+    assert lib.MXProcessProfilePause(0) == 0
+    assert lib.MXSetProcessProfilerState(0) == 0
+    s = ctypes.c_char_p()
+    assert lib.MXAggregateProfileStatsPrint(ctypes.byref(s), 0) == 0
+    assert s.value is not None
