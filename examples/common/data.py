@@ -108,6 +108,11 @@ def get_rec_iter(args, kv=None):
         num_parts=nworker, part_index=rank,
         brightness=args.brightness, contrast=args.contrast,
         saturation=args.saturation, pca_noise=args.pca_noise,
+        # native C++ decode pool + background prefetch feed the chip
+        # (reference: iter_image_recordio_2.cc preprocess_threads +
+        # prefetcher); color jitter forces the Python fallback path
+        preprocess_threads=args.data_nthreads,
+        prefetch_buffer=2,
     )
     val = None
     if args.data_val:
@@ -119,5 +124,7 @@ def get_rec_iter(args, kv=None):
             mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
             std_r=std[0], std_g=std[1], std_b=std[2],
             num_parts=nworker, part_index=rank,
+            preprocess_threads=args.data_nthreads,
+            prefetch_buffer=2,
         )
     return train, val
