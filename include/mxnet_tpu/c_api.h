@@ -177,6 +177,41 @@ int MXKVStoreSetOptimizer(KVStoreHandle h, const char* name,
                           int num_params, const char** keys,
                           const char** vals);
 int MXKVStoreBarrier(KVStoreHandle h);
+int MXKVStorePushPull(KVStoreHandle h, uint32_t num, const char** keys,
+                      NDArrayHandle* vals, NDArrayHandle* outs,
+                      int priority);
+
+/* ---- profiler objects (reference: MXProfileCreate* family) ------- */
+typedef void* ProfileHandle;
+
+int MXProfileCreateDomain(const char* name, ProfileHandle* out);
+int MXProfileCreateTask(ProfileHandle domain, const char* name,
+                        ProfileHandle* out);
+int MXProfileCreateFrame(ProfileHandle domain, const char* name,
+                         ProfileHandle* out);
+int MXProfileCreateCounter(ProfileHandle domain, const char* name,
+                           ProfileHandle* out);
+int MXProfileDestroyHandle(ProfileHandle h);
+int MXProfileDurationStart(ProfileHandle h);
+int MXProfileDurationStop(ProfileHandle h);
+int MXProfileSetCounter(ProfileHandle h, uint64_t value);
+int MXProfileAdjustCounter(ProfileHandle h, int64_t delta);
+int MXProfileSetMarker(ProfileHandle domain, const char* name,
+                       const char* scope);
+
+/* ---- raw-bytes NDArray IO + device copy -------------------------- */
+/* buffer valid until the next call on this thread */
+int MXNDArraySaveRawBytes(NDArrayHandle h, size_t* out_size,
+                          const char** out_buf);
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src);
+
+/* ---- executor reshape -------------------------------------------- */
+int MXExecutorReshape(ExecutorHandle exec, uint32_t num_inputs,
+                      const char** input_names,
+                      NDArrayHandle* input_examples,
+                      ExecutorHandle* out);
 
 #ifdef __cplusplus
 }

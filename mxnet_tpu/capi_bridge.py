@@ -433,3 +433,71 @@ def profiler_pause(paused):
 def profiler_stats_print(reset):
     from . import profiler as _prof
     return _prof.dumps(reset=bool(reset))
+
+
+# -- batch-3 surfaces: profiler objects, raw-bytes NDArray serialization,
+#    kvstore pushpull, executor reshape (reference: c_api_profile.cc
+#    MXProfileCreate* family; c_api.cc MXNDArraySaveRawBytes,
+#    MXKVStorePushPull, MXExecutorReshape) --------------------------------
+
+def profile_create(kind, domain, name):
+    from . import profiler as _prof
+    cls = {"domain": _prof.Domain, "task": _prof.Task,
+           "frame": _prof.Frame, "counter": _prof.Counter}[kind]
+    if kind == "domain":
+        return cls(name)
+    return cls(domain, name)
+
+
+def profile_duration(obj, start):
+    if start:
+        obj.start()
+    else:
+        obj.stop()
+    return 0
+
+
+def profile_counter_set(obj, value):
+    obj.set_value(float(value))
+    return 0
+
+
+def profile_counter_adjust(obj, delta):
+    obj.increment(float(delta))
+    return 0
+
+
+def profile_marker(domain, name, scope):
+    from . import profiler as _prof
+    _prof.Marker(domain, name).mark(scope)
+    return 0
+
+
+def nd_save_raw(arr):
+    from .ndarray import mxnet_format as _fmt
+    return _fmt.dumps([("", arr)], keyed=False)
+
+
+def nd_load_raw(buf):
+    from .ndarray import mxnet_format as _fmt
+    _keys, arrs = _fmt.loads(bytes(buf))
+    if not arrs:
+        raise MXNetError("empty NDArray byte stream")
+    return arrs[0]
+
+
+def nd_copy_from_ndarray(dst, src):
+    dst[:] = src.todense() if hasattr(src, "todense") and \
+        getattr(src, "stype", "default") != "default" else src
+    return 0
+
+
+def kv_pushpull(kv, keys, vals, outs, priority):
+    kv.pushpull(list(keys), list(vals), out=list(outs),
+                priority=int(priority))
+    return 0
+
+
+def executor_reshape(w, names, shape_arrs):
+    shapes = {n: tuple(a.shape) for n, a in zip(names, shape_arrs)}
+    return _ExecWrap(w.exe.reshape(**shapes))

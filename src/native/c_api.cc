@@ -1033,3 +1033,212 @@ MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str,
   Py_DECREF(r);
   return 0;
 }
+
+// ---------------------------------------------------- profiler objects
+// (reference: src/c_api/c_api_profile.cc MXProfileCreate* family; a
+//  handle is a strong PyObject* to the profiler.py object)
+
+typedef void* ProfileHandle;
+
+static int profile_create(const char* kind, ProfileHandle domain,
+                          const char* name, ProfileHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* dom = domain ? reinterpret_cast<PyObject*>(domain) : Py_None;
+  PyObject* args = Py_BuildValue("(sOs)", kind, dom, name);
+  PyObject* r = bridge_call("profile_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXProfileCreateDomain(const char* name, ProfileHandle* out) {
+  return profile_create("domain", nullptr, name, out);
+}
+
+MXTPU_API int MXProfileCreateTask(ProfileHandle domain, const char* name,
+                                  ProfileHandle* out) {
+  return profile_create("task", domain, name, out);
+}
+
+MXTPU_API int MXProfileCreateFrame(ProfileHandle domain, const char* name,
+                                   ProfileHandle* out) {
+  return profile_create("frame", domain, name, out);
+}
+
+MXTPU_API int MXProfileCreateCounter(ProfileHandle domain,
+                                     const char* name,
+                                     ProfileHandle* out) {
+  return profile_create("counter", domain, name, out);
+}
+
+MXTPU_API int MXProfileDestroyHandle(ProfileHandle h) {
+  return MXNDArrayFree(h);
+}
+
+static int profile_duration(ProfileHandle h, int start) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(h),
+                                 start);
+  PyObject* r = bridge_call("profile_duration", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXProfileDurationStart(ProfileHandle h) {
+  return profile_duration(h, 1);
+}
+
+MXTPU_API int MXProfileDurationStop(ProfileHandle h) {
+  return profile_duration(h, 0);
+}
+
+MXTPU_API int MXProfileSetCounter(ProfileHandle h, uint64_t value) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(OK)", reinterpret_cast<PyObject*>(h),
+                                 (unsigned long long)value);
+  PyObject* r = bridge_call("profile_counter_set", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXProfileAdjustCounter(ProfileHandle h, int64_t delta) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(OL)", reinterpret_cast<PyObject*>(h),
+                                 (long long)delta);
+  PyObject* r = bridge_call("profile_counter_adjust", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXProfileSetMarker(ProfileHandle domain, const char* name,
+                                 const char* scope) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* dom = domain ? reinterpret_cast<PyObject*>(domain) : Py_None;
+  PyObject* args = Py_BuildValue("(Oss)", dom, name,
+                                 scope ? scope : "process");
+  PyObject* r = bridge_call("profile_marker", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------- raw-bytes NDArray IO
+// (reference: MXNDArraySaveRawBytes / MXNDArrayLoadFromRawBytes)
+
+MXTPU_API int MXNDArraySaveRawBytes(NDArrayHandle h, size_t* out_size,
+                                    const char** out_buf) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_save_raw", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  char* data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(data, (size_t)n);
+  *out_buf = tl_strings.back().data();
+  *out_size = (size_t)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                                        NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(buf), (Py_ssize_t)size);
+  PyObject* args = Py_BuildValue("(N)", bytes);
+  PyObject* r = bridge_call("nd_load_raw", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst,
+                                           NDArrayHandle src) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(dst),
+                                 reinterpret_cast<PyObject*>(src));
+  PyObject* r = bridge_call("nd_copy_from_ndarray", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// --------------------------------------------------------- kvstore batch 3
+
+MXTPU_API int MXKVStorePushPull(KVStoreHandle h, uint32_t num,
+                                const char** keys, NDArrayHandle* vals,
+                                NDArrayHandle* outs, int priority) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = PyList_New(num);
+  PyObject* pvals = PyList_New(num);
+  PyObject* pouts = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyObject* v = reinterpret_cast<PyObject*>(vals[i]);
+    PyObject* o = reinterpret_cast<PyObject*>(outs[i]);
+    Py_INCREF(v);
+    Py_INCREF(o);
+    PyList_SetItem(pvals, i, v);
+    PyList_SetItem(pouts, i, o);
+  }
+  PyObject* args = Py_BuildValue("(ONNNi)", reinterpret_cast<PyObject*>(h),
+                                 pkeys, pvals, pouts, priority);
+  PyObject* r = bridge_call("kv_pushpull", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------ executor batch 3
+
+MXTPU_API int MXExecutorReshape(ExecutorHandle exec, uint32_t num_inputs,
+                                const char** input_names,
+                                NDArrayHandle* input_examples,
+                                ExecutorHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pnames = PyList_New(num_inputs);
+  PyObject* parrs = PyList_New(num_inputs);
+  for (uint32_t i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(pnames, i, PyUnicode_FromString(input_names[i]));
+    PyObject* o = reinterpret_cast<PyObject*>(input_examples[i]);
+    Py_INCREF(o);
+    PyList_SetItem(parrs, i, o);
+  }
+  PyObject* args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject*>(exec),
+                                 pnames, parrs);
+  PyObject* r = bridge_call("executor_reshape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}

@@ -388,3 +388,72 @@ def test_cpp_executor_trains_from_symbol_json(tmp_path, c_api_lib):
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "EXEC TRAIN OK" in r.stdout, r.stdout
+
+
+def test_c_api_batch3_surfaces(tmp_path, c_api_lib):
+    """Batch-3 ABI: profiler objects, raw-bytes NDArray round-trip,
+    device-side copy, kvstore pushpull, executor reshape."""
+    import ctypes
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArraySaveRawBytes.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXNDArrayLoadFromRawBytes.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p)]
+
+    # profiler objects
+    dom = ctypes.c_void_p()
+    assert lib.MXProfileCreateDomain(b"dom", ctypes.byref(dom)) == 0
+    task = ctypes.c_void_p()
+    assert lib.MXProfileCreateTask(dom, b"work", ctypes.byref(task)) == 0
+    assert lib.MXSetProcessProfilerState(1) == 0
+    assert lib.MXProfileDurationStart(task) == 0
+    assert lib.MXProfileDurationStop(task) == 0
+    ctr = ctypes.c_void_p()
+    assert lib.MXProfileCreateCounter(dom, b"cnt", ctypes.byref(ctr)) == 0
+    assert lib.MXProfileSetCounter(ctr, 5) == 0
+    assert lib.MXProfileAdjustCounter(ctr, -2) == 0
+    assert lib.MXProfileSetMarker(dom, b"mark", b"process") == 0
+    assert lib.MXSetProcessProfilerState(0) == 0
+    lib.MXProfileDestroyHandle(task)
+    lib.MXProfileDestroyHandle(ctr)
+    lib.MXProfileDestroyHandle(dom)
+
+    # raw bytes round-trip + copy-from-ndarray
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, b"cpu", 0,
+                               ctypes.byref(h)) == 0
+    vals = (ctypes.c_float * 6)(*[float(i) for i in range(6)])
+    assert lib.MXNDArraySyncCopyFromCPU(h, vals, 6 * 4) == 0
+    size = ctypes.c_size_t()
+    buf = ctypes.c_char_p()
+    assert lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                     ctypes.byref(buf)) == 0
+    raw = ctypes.string_at(buf, size.value)
+    h2 = ctypes.c_void_p()
+    assert lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                         ctypes.byref(h2)) == 0
+    got = (ctypes.c_float * 6)()
+    assert lib.MXNDArraySyncCopyToCPU(h2, got, 6 * 4) == 0
+    assert list(got) == [float(i) for i in range(6)]
+    h3 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, b"cpu", 0,
+                               ctypes.byref(h3)) == 0
+    assert lib.MXNDArraySyncCopyFromNDArray(h3, h2) == 0
+    assert lib.MXNDArraySyncCopyToCPU(h3, got, 6 * 4) == 0
+    assert list(got) == [float(i) for i in range(6)]
+
+    # kvstore pushpull
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    keys = (ctypes.c_char_p * 1)(b"w")
+    arrs = (ctypes.c_void_p * 1)(h.value)
+    assert lib.MXKVStoreInit(kv, 1, keys, arrs) == 0
+    outs = (ctypes.c_void_p * 1)(h3.value)
+    assert lib.MXKVStorePushPull(kv, 1, keys, arrs, outs, 0) == 0
+    assert lib.MXKVStoreBarrier(kv) == 0
+    lib.MXKVStoreFree(kv)
+    for hh in (h, h2, h3):
+        lib.MXNDArrayFree(hh)
