@@ -581,6 +581,46 @@ def train_transformer_lm(batch=8, seq=1024, dtype="bfloat16", iters=10,
     return tok_s, extra
 
 
+def decode_transformer_lm(batch=8, prompt=32, steps=128, dtype="bfloat16",
+                          iters=3, d_model=1024, n_heads=16, n_layers=12,
+                          d_ff=4096, vocab=32768):
+    """Autoregressive decode throughput (KV cache, one compiled scan):
+    generated tokens/s on the single chip. TPU-first capability metric
+    (the reference has no transformer decode path); reported without a
+    vs_baseline."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from .parallel.transformer import (
+        TransformerConfig, init_transformer_params, transformer_generate)
+
+    max_len = prompt + steps
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_len=max_len,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (batch, prompt)), jnp.int32)
+
+    def run():
+        return transformer_generate(params, tokens, steps, cfg,
+                                    max_len=max_len)
+
+    t0 = time.time()
+    dt = _timeit(run, warmup=1, iters=iters)
+    log("compile+warmup+bench wall: %.1fs" % (time.time() - t0))
+    tok_s = batch * steps / dt
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    return tok_s, {"ms_per_step": round(dt * 1e3, 1), "dtype": dtype,
+                   "batch": batch, "prompt": prompt, "steps": steps,
+                   "n_params": n_params,
+                   "path": "kv-cache greedy decode, one jitted scan"}
+
+
 def train_mlp(batch=64, iters=50):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run."""
@@ -744,6 +784,12 @@ def _job_data_pipeline():
                    host_metric=True)
 
 
+def _job_transformer_decode():
+    v, x = decode_transformer_lm()
+    return persist("transformer_decode_tokens_per_sec", v,
+                   "tok/s (GPT ~185M kv-cache decode, batch 8, bf16)", x)
+
+
 def _job_data_pipeline_native():
     v, x = data_pipeline_native()
     return persist("data_pipeline_native_img_per_sec", v,
@@ -774,6 +820,7 @@ JOBS = {
     "transformer_lm": _job_transformer_lm,
     "data_pipeline_native": _job_data_pipeline_native,
     "e2e_train": _job_e2e_train,
+    "transformer_decode": _job_transformer_decode,
     "inception-v3_train": _job_inception_train,
     "resnet50_train": _job_resnet50_train,
     "resnet50_train_bf16": _job_resnet50_train_bf16,
@@ -796,6 +843,7 @@ JOB_PRIORITY = [
     "resnet50_train_bf16",
     "transformer_lm",
     "e2e_train",
+    "transformer_decode",
     "resnet50_infer",
     "resnet50_infer_bf16",
     "resnet50_train_b128",

@@ -36,7 +36,9 @@ from .ring_attention import _ring_attention_local
 from .moe import top_k_gating
 
 __all__ = ["TransformerConfig", "init_transformer_params",
-           "make_transformer_train_step", "transformer_forward_single"]
+           "make_transformer_train_step", "transformer_forward_single",
+           "init_kv_cache", "transformer_decode_step",
+           "transformer_prefill", "transformer_generate"]
 
 AXES = ("dp", "sp", "tp", "pp", "ep")
 
@@ -412,3 +414,129 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
             x = x + f
     x = _ln(x, params["lnf_g"], params["lnf_b"])
     return x @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
+# KV-cache autoregressive decode (TPU-first addition: the reference's
+# inference story is feedforward/RNN serving; a transformer framework
+# needs an O(1)-per-token decode path. Static shapes throughout — the
+# cache is (layers, b, h, max_len, hd) with a position mask, so the
+# whole generation loop is ONE compiled lax.scan program.)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: TransformerConfig, batch, max_len=None):
+    """Zeroed K/V cache: dict of (pp, lps, b, heads, max_len, hd)."""
+    max_len = max_len or cfg.max_len
+    hd = cfg.d_model // cfg.n_heads
+    # layer stacking mirrors the params layout (pp, lps, ...)
+    n_l = cfg.n_layers
+    shape = (n_l, batch, cfg.n_heads, max_len, hd)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def transformer_decode_step(params, cache, tokens_t, pos,
+                            cfg: TransformerConfig):
+    """One decode step: tokens_t (b,) int32 at position ``pos`` (traced
+    scalar) -> (logits (b, V), updated cache). Attention reads the full
+    static cache under a <= pos mask, so shapes never change and the
+    step compiles once."""
+    layers = params["layers"]
+    pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
+    hd = cfg.d_model // cfg.n_heads
+    b = tokens_t.shape[0]
+    max_len = cache["k"].shape[3]
+
+    x = params["embed"][tokens_t]                     # (b, d)
+    x = x + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
+                                         keepdims=False)
+    kpos = jnp.arange(max_len)
+    visible = (kpos <= pos)[None, None, :]            # (1, 1, max_len)
+    li_flat = 0
+    for st in range(pp):
+        for li in range(lps):
+            lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
+            h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(b, cfg.n_heads, hd)
+            k_t = (h @ lp["wk"]).reshape(b, cfg.n_heads, hd)
+            v_t = (h @ lp["wv"]).reshape(b, cfg.n_heads, hd)
+            # write this step's K/V at [li_flat, :, :, pos]
+            cache = {
+                "k": cache["k"].at[li_flat, :, :, pos].set(
+                    k_t.astype(cache["k"].dtype)),
+                "v": cache["v"].at[li_flat, :, :, pos].set(
+                    v_t.astype(cache["v"].dtype)),
+            }
+            kc = cache["k"][li_flat]                  # (b, h, max_len, hd)
+            vc = cache["v"][li_flat]
+            sc = jnp.einsum("bhd,bhkd->bhk", q, kc) / np.sqrt(hd)
+            sc = jnp.where(visible, sc, -1e30)
+            o = jnp.einsum("bhk,bhkd->bhd", jax.nn.softmax(sc, -1), vc)
+            x = x + o.reshape(b, cfg.d_model) @ lp["wo"]
+            h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
+            if cfg.num_experts:
+                logits = h2 @ lp["gate"]
+                cap = max(1, int(cfg.capacity_factor * b
+                                 * min(cfg.moe_top_k, 2)
+                                 / cfg.num_experts))
+                disp, comb, _ = top_k_gating(logits, cfg.num_experts,
+                                             cap, k=cfg.moe_top_k)
+                exp_in = jnp.einsum("nec,nd->ecd", disp.astype(x.dtype),
+                                    h2)
+                hh = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", exp_in,
+                                            lp["we1"]))
+                eo = jnp.einsum("ecf,efd->ecd", hh, lp["we2"])
+                f = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), eo)
+            else:
+                f = jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + f
+            li_flat += 1
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["embed"].T, cache
+
+
+def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
+    """Fill the cache from a prompt by scanning decode steps (compiles
+    to one program; prompt length is static). Returns (last_logits,
+    cache)."""
+    b, s = tokens.shape
+
+    def body(carry, t):
+        cache, _ = carry
+        logits, cache = transformer_decode_step(
+            params, cache, tokens[:, t], t, cfg)
+        return (cache, logits), None
+
+    logits0 = jnp.zeros((b, cfg.vocab_size), jnp.float32)
+    (cache, logits), _ = jax.lax.scan(
+        body, (cache, logits0), jnp.arange(s))
+    return logits, cache
+
+
+def transformer_generate(params, prompt, steps, cfg: TransformerConfig,
+                         max_len=None):
+    """Greedy generation: prompt (b, s) int32 -> (b, steps) int32.
+    Prefill + decode run as ONE jitted lax.scan program; per-token cost
+    is O(1) in generated length (KV cache, static shapes)."""
+    b, s = prompt.shape
+    max_len = max_len or cfg.max_len
+    assert s + steps <= max_len, "prompt + steps exceeds max_len"
+
+    @jax.jit
+    def run(params, prompt):
+        cache = init_kv_cache(cfg, b, max_len)
+        logits, cache = transformer_prefill(params, prompt, cache, cfg)
+        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def body(carry, t):
+            cache, tok = carry
+            logits, cache = transformer_decode_step(
+                params, cache, tok, s + t, cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), tok
+
+        (_, _), toks = jax.lax.scan(
+            body, (cache, tok0), jnp.arange(steps))
+        return jnp.moveaxis(toks, 0, 1)               # (b, steps)
+
+    return run(params, prompt)
