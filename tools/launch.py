@@ -13,12 +13,18 @@ Usage:
     python tools/launch.py -n 2 [--sync-mode sync|async] \
         python my_training_script.py --kv-store dist_async
 
+    # multi-host over ssh (reference: dmlc-core tracker ssh.py): the
+    # parameter server runs HERE; workers round-robin over --hostfile
+    python tools/launch.py -n 4 --launcher ssh --hostfile hosts.txt \
+        python my_training_script.py --kv-store dist_async
+
 Env exported to children (reference: DMLC_ROLE / DMLC_PS_ROOT_URI):
     MXNET_TPU_ROLE, MXNET_TPU_PS_URI, MXNET_TPU_PS_PORT,
     MXNET_TPU_NUM_WORKERS, MXNET_TPU_RANK, MXNET_TPU_PS_MODE
 """
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -34,10 +40,42 @@ def _free_port():
     return port
 
 
+def _local_uri():
+    """A routable address for remote workers to reach the PS."""
+    try:
+        # a UDP connect picks the outbound interface without sending
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        uri = s.getsockname()[0]
+        s.close()
+        return uri
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _ssh_worker_cmd(host, ssh_port, env, command, cwd):
+    """Build the ssh invocation for one remote worker: environment is
+    passed inline (sshd's AcceptEnv rarely covers custom vars)."""
+    exports = " ".join("%s=%s" % (k, shlex.quote(str(v)))
+                       for k, v in sorted(env.items()))
+    remote = "cd %s && env %s %s" % (
+        shlex.quote(cwd), exports,
+        " ".join(shlex.quote(c) for c in command))
+    return ["ssh", "-p", str(ssh_port), "-o", "StrictHostKeyChecking=no",
+            "-o", "BatchMode=yes", host, remote]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh"])
+    ap.add_argument("--hostfile",
+                    help="ssh launcher: file with one host per line")
+    ap.add_argument("--ssh-port", type=int, default=22)
+    ap.add_argument("--ps-uri", default=None,
+                    help="address workers use to reach the PS "
+                         "(default: auto-detect; 127.0.0.1 for local)")
     ap.add_argument("--sync-mode", default="sync",
                     choices=["sync", "async"])
     ap.add_argument("--env", action="append", default=[],
@@ -49,14 +87,26 @@ def main():
     if not args.command:
         ap.error("no command given")
 
+    hosts = None
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh requires --hostfile")
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()
+                     and not ln.startswith("#")]
+        if not hosts:
+            ap.error("hostfile %s has no hosts" % args.hostfile)
+
     port = _free_port()
+    ps_uri = args.ps_uri or ("127.0.0.1" if args.launcher == "local"
+                             else _local_uri())
     base_env = dict(os.environ)
     for kv in args.env:
         k, _, v = kv.partition("=")
         base_env[k] = v
     import uuid
     base_env.update({
-        "MXNET_TPU_PS_URI": "127.0.0.1",
+        "MXNET_TPU_PS_URI": ps_uri,
         "MXNET_TPU_PS_PORT": str(port),
         "MXNET_TPU_NUM_WORKERS": str(args.num_workers),
         "MXNET_TPU_PS_MODE": args.sync_mode,
@@ -95,7 +145,17 @@ def main():
         for rank in range(args.num_workers):
             wenv = dict(base_env, MXNET_TPU_ROLE="worker",
                         MXNET_TPU_RANK=str(rank))
-            workers.append(subprocess.Popen(args.command, env=wenv))
+            if hosts is not None:
+                # the remote side gets ONLY the contract env inline;
+                # its login shell provides the rest
+                contract = {k: wenv[k] for k in wenv
+                            if k.startswith("MXNET_TPU_")}
+                cmd = _ssh_worker_cmd(hosts[rank % len(hosts)],
+                                      args.ssh_port, contract,
+                                      args.command, os.getcwd())
+                workers.append(subprocess.Popen(cmd))
+            else:
+                workers.append(subprocess.Popen(args.command, env=wenv))
         for w in workers:
             rc |= w.wait()
     finally:
