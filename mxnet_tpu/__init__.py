@@ -43,7 +43,8 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "profiler", "parallel", "test_utils", "image", "recordio", "engine",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
-         "config", "rnn", "mod", "name", "attribute", "log", "libinfo")
+         "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
+         "util", "registry", "misc")
 
 
 def __getattr__(name):

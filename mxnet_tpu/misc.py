@@ -1,0 +1,32 @@
+"""Deprecated learning-rate scheduler shims
+(reference: python/mxnet/misc.py — superseded by lr_scheduler.py there
+too; kept so old import paths keep working)."""
+from __future__ import annotations
+
+import warnings
+
+from . import lr_scheduler as _lr
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]
+
+
+def _warn(name):
+    warnings.warn(
+        "mxnet_tpu.misc.%s is deprecated; use mxnet_tpu.lr_scheduler"
+        % name, DeprecationWarning, stacklevel=3)
+
+
+class LearningRateScheduler(_lr.LRScheduler):
+    """Deprecated alias of lr_scheduler.LRScheduler."""
+
+    def __init__(self, *args, **kwargs):
+        _warn("LearningRateScheduler")
+        super().__init__(*args, **kwargs)
+
+
+class FactorScheduler(_lr.FactorScheduler):
+    """Deprecated alias of lr_scheduler.FactorScheduler."""
+
+    def __init__(self, *args, **kwargs):
+        _warn("FactorScheduler")
+        super().__init__(*args, **kwargs)

@@ -1,0 +1,87 @@
+"""Generic object-registry factories (reference: python/mxnet/registry.py).
+
+The reference manufactures ``register``/``alias``/``create`` functions
+per base class (optimizers, initializers, ...) and stores the mapping in
+the C registry; here the mapping is a plain per-class dict, and create()
+keeps the same creation grammar: a name, ``"name"``/``("name", kwargs)``
+pairs, or a JSON string ``'["name", {...}]'``.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def get_registry(base_class):
+    """The (copy of the) name -> class mapping for ``base_class``."""
+    return dict(_REGISTRIES.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """A decorator registering subclasses of ``base_class`` by
+    lower-cased class name (or an explicit name)."""
+    reg = _REGISTRIES.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError("cannot register %s: not a subclass of %s"
+                             % (klass.__name__, base_class.__name__))
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+
+    register.__name__ = "register_" + nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """A decorator adding extra registry names for a class."""
+    reg = _REGISTRIES.setdefault(base_class, {})
+
+    def alias(*aliases):
+        def wrap(klass):
+            for a in aliases:
+                reg[a.lower()] = klass
+            return klass
+        return wrap
+
+    alias.__name__ = "alias_" + nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A factory accepting an instance (pass-through), a registered
+    name, a (name, kwargs) pair, or a JSON '["name", {...}]' string —
+    the reference's creation grammar."""
+    reg = _REGISTRIES.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError(
+                    "%s is already an instance; extra arguments are not "
+                    "allowed" % nickname)
+            return args[0]
+        if not args:
+            raise MXNetError("need a %s name to create" % nickname)
+        name, args = args[0], args[1:]
+        if isinstance(name, str) and name.startswith("["):
+            if args or kwargs:
+                raise MXNetError("JSON spec carries its own kwargs")
+            spec = json.loads(name)
+            name = spec[0]
+            kwargs = spec[1] if len(spec) > 1 else {}
+        key = str(name).lower()
+        if key not in reg:
+            raise MXNetError("%s %r is not registered (have: %s)"
+                             % (nickname, name, ", ".join(sorted(reg))))
+        return reg[key](*args, **kwargs)
+
+    create.__name__ = "create_" + nickname
+    return create

@@ -1,0 +1,12 @@
+"""Small shared utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["makedirs"]
+
+
+def makedirs(d):
+    """Create ``d`` and parents if missing (reference: util.py
+    makedirs; the py2 fallback is gone — this build is py3-only)."""
+    os.makedirs(d, exist_ok=True)

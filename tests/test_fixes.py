@@ -5,6 +5,8 @@ include/mxnet/imperative.h:148-153), side-effect-free autograd.grad,
 higher-order grad, multinomial get_prob, reshape reverse codes, RNN dropout
 / projection, topk mask on a non-last axis.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -320,3 +322,46 @@ def test_onnx_batchnorm_fix_gamma_unbound_raises(tmp_path):
     with pytest.raises(ValueError, match="fix_gamma"):
         mxonnx.export_model(bn, params, (1, 3, 4, 4),
                             onnx_file_path=str(tmp_path / "bn.onnx"))
+
+
+def test_registry_util_misc_parity_modules():
+    """mx.registry generic factories, mx.util.makedirs, deprecated
+    mx.misc schedulers (reference: registry.py, util.py, misc.py)."""
+    import tempfile
+    import warnings
+
+    class Animal(object):
+        def __init__(self, legs=4):
+            self.legs = legs
+
+    reg = mx.registry.get_register_func(Animal, "animal")
+    alias = mx.registry.get_alias_func(Animal, "animal")
+    create = mx.registry.get_create_func(Animal, "animal")
+
+    @alias("doggo")
+    class Dog(Animal):
+        pass
+
+    reg(Dog)
+    assert isinstance(create("dog"), Dog)
+    assert isinstance(create("doggo"), Dog)
+    a = create('["dog", {"legs": 3}]')
+    assert isinstance(a, Dog) and a.legs == 3
+    inst = Dog()
+    assert create(inst) is inst
+    with pytest.raises(mx.MXNetError):
+        create("cat")
+    assert "dog" in mx.registry.get_registry(Animal)
+
+    d = tempfile.mkdtemp()
+    mx.util.makedirs(d + "/a/b")
+    assert os.path.isdir(d + "/a/b")
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sched = mx.misc.FactorScheduler(step=2, factor=0.5)
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    sched.base_lr = 1.0
+    # reference FactorScheduler count semantics: drops past each step
+    assert abs(sched(4) - 0.5) < 1e-6
+    assert abs(sched(5) - 0.25) < 1e-6
