@@ -44,7 +44,7 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
-         "util", "registry", "misc")
+         "util", "registry", "misc", "executor_manager")
 
 
 def __getattr__(name):

@@ -1,0 +1,175 @@
+"""Data-parallel executor groups (reference:
+python/mxnet/executor_manager.py — the pre-Module training plumbing:
+slice the batch over devices, run one executor per device, walk the
+per-device gradient lists).
+
+The TPU-first Module trains DP through ONE jitted program on a device
+mesh (module/module.py); this module keeps the reference's
+executor-group surface for code written against it: explicit
+per-device executors, host-side batch slicing, per-parameter lists of
+per-device gradients.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from .io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup", "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Batch -> per-device slices proportional to work_load_list
+    (reference: executor_manager.py _split_input_slice)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i + 1 == len(work_load_list):
+            stop = batch_size
+        else:
+            stop = min(batch_size, start + int(round(batch_size * w
+                                                     / total)))
+        if stop <= start:
+            raise MXNetError(
+                "too many devices for batch size %d" % batch_size)
+        slices.append(slice(start, stop))
+        start = stop
+    return slices
+
+
+class DataParallelExecutorGroup(object):
+    """One executor per device over sliced batch shapes
+    (reference: executor_manager.py DataParallelExecutorGroup)."""
+
+    def __init__(self, sym, arg_names, param_names, ctx, slices,
+                 train_data, shared_group=None):
+        self.sym = sym
+        self.data_names = [x[0] for x in train_data.provide_data]
+        self.label_names = [x[0] for x in train_data.provide_label] \
+            if train_data.provide_label else []
+        self.aux_names = sym.list_auxiliary_states()
+        self.param_names = [n for n in arg_names if n in set(param_names)]
+        self.slices = slices
+        self.train_execs = []
+        for i, ctxi in enumerate(ctx):
+            n = slices[i].stop - slices[i].start
+            shapes = {}
+            types = {}
+            for x in (list(train_data.provide_data)
+                      + list(train_data.provide_label or [])):
+                shapes[x[0]] = (n,) + tuple(x[1][1:])
+                if isinstance(x, DataDesc):
+                    types[x.name] = x.dtype
+            reqs = {a: ("write" if a in self.param_names else "null")
+                    for a in arg_names}
+            exe = sym.simple_bind(ctxi, grad_req=reqs, type_dict=types,
+                                  **shapes)
+            if shared_group is not None:
+                # parameter sharing with an existing group (bucketing)
+                src = shared_group.train_execs[i]
+                for name in self.param_names:
+                    exe.arg_dict[name][:] = src.arg_dict[name]
+                for name in self.aux_names:
+                    exe.aux_dict[name][:] = src.aux_dict[name]
+            self.train_execs.append(exe)
+
+        self.param_arrays = [[e.arg_dict[n] for e in self.train_execs]
+                             for n in self.param_names]
+        self.grad_arrays = [[e.grad_dict[n] for e in self.train_execs]
+                            for n in self.param_names]
+        self.aux_arrays = [[e.aux_dict[n] for e in self.train_execs]
+                           for n in self.aux_names]
+
+    def load_data_batch(self, data_batch):
+        """Slice the host batch into each executor's input arrays."""
+        for name, arr in zip(self.data_names, data_batch.data):
+            for sl, exe in zip(self.slices, self.train_execs):
+                exe.arg_dict[name][:] = arr[sl]
+        if self.label_names and data_batch.label:
+            for name, arr in zip(self.label_names, data_batch.label):
+                for sl, exe in zip(self.slices, self.train_execs):
+                    exe.arg_dict[name][:] = arr[sl]
+
+    def forward(self, is_train=False):
+        for exe in self.train_execs:
+            exe.forward(is_train=is_train)
+
+    def backward(self):
+        for exe in self.train_execs:
+            exe.backward()
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        for i, (exe, sl) in enumerate(zip(self.train_execs, self.slices)):
+            part = labels[i] if pre_sliced else [lbl[sl] for lbl in labels]
+            metric.update(part, exe.outputs)
+
+
+class DataParallelExecutorManager(object):
+    """Slices batches over devices and delegates to the (possibly
+    bucketed) executor group (reference: executor_manager.py
+    DataParallelExecutorManager)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        self.logger = logger or logging
+        batch_size = train_data.batch_size
+        if work_load_list is None:
+            work_load_list = [1] * len(ctx)
+        if len(work_load_list) != len(ctx):
+            raise MXNetError("work_load_list must match ctx length")
+        self.slices = _split_input_slice(batch_size, work_load_list)
+        self.ctx = ctx
+        self.arg_names = arg_names or symbol.list_arguments()
+        if param_names is None:
+            inputs = {x[0] for x in train_data.provide_data} | \
+                {x[0] for x in (train_data.provide_label or [])}
+            param_names = [n for n in self.arg_names if n not in inputs]
+        self.param_names = param_names
+        self.sym_gen = sym_gen
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.arg_names, self.param_names, ctx, self.slices,
+            train_data)
+        self.execgrp_bucket = {}
+        self.curr_execgrp = self.execgrp
+
+    @property
+    def param_arrays(self):
+        return self.execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.execgrp.aux_arrays
+
+    def set_params(self, arg_params, aux_params):
+        for exe in self.execgrp.train_execs:
+            exe.copy_params_from(arg_params, aux_params,
+                                 allow_extra_params=True)
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None:
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                sym = self.sym_gen(key)
+                self.execgrp_bucket[key] = DataParallelExecutorGroup(
+                    sym, self.arg_names, self.param_names, self.ctx,
+                    self.slices, data_batch, shared_group=self.execgrp)
+            self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        self.curr_execgrp.update_metric(metric, labels, pre_sliced)

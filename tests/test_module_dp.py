@@ -136,3 +136,55 @@ def test_module_dp_bf16_convergence():
     score = mod.score(io.NDArrayIter(arr, labels.astype(np.float32),
                                      batch_size=50), "acc")
     assert score[0][1] > 0.95, score
+
+
+def test_executor_manager_group_matches_single_device():
+    """DataParallelExecutorManager (reference executor_manager.py): two
+    per-device executors over sliced batches; summed per-device grads
+    equal the single-executor grads on the full batch."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import io
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 5).astype(np.float32)
+    labels = rng.randint(0, 3, size=8).astype(np.float32)
+    it = io.NDArrayIter(data, labels, batch_size=8)
+
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    mgr = DataParallelExecutorManager(sym, [mx.cpu(0), mx.cpu(1)], it)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = np.zeros(3, np.float32)
+    mgr.set_params({"fc_weight": mx.nd.array(w),
+                    "fc_bias": mx.nd.array(b)}, {})
+    batch = next(it)
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    metric = mx.metric.Accuracy()
+    mgr.update_metric(metric, batch.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+
+    # reference single-device executor on the full batch
+    exe = sym.simple_bind(mx.cpu(0),
+                          grad_req={"fc_weight": "write",
+                                    "fc_bias": "write", "data": "null",
+                                    "softmax_label": "null"},
+                          data=(8, 5), softmax_label=(8,))
+    exe.arg_dict["fc_weight"][:] = mx.nd.array(w)
+    exe.arg_dict["fc_bias"][:] = mx.nd.array(b)
+    exe.arg_dict["data"][:] = batch.data[0]
+    exe.arg_dict["softmax_label"][:] = batch.label[0]
+    exe.forward(is_train=True)
+    exe.backward()
+    for pname, parts in zip(mgr.execgrp.param_names, mgr.grad_arrays):
+        # SoftmaxOutput gradients SUM over the batch (reference
+        # normalization='null' default), so per-device parts sum to the
+        # full-batch gradient
+        summed = sum(p.asnumpy() for p in parts)
+        np.testing.assert_allclose(summed,
+                                   exe.grad_dict[pname].asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
