@@ -115,7 +115,10 @@ class DataParallelExecutorManager(object):
                  param_names=None, aux_names=None, work_load_list=None,
                  logger=None, sym_gen=None):
         self.logger = logger or logging
-        batch_size = train_data.batch_size
+        # train_data may be a DataIter or anything with provide_data
+        # (the reference accepts "DataIter or DataBatch")
+        batch_size = getattr(train_data, "batch_size", None) or \
+            train_data.provide_data[0][1][0]
         if work_load_list is None:
             work_load_list = [1] * len(ctx)
         if len(work_load_list) != len(ctx):
@@ -135,22 +138,38 @@ class DataParallelExecutorManager(object):
         self.execgrp_bucket = {}
         self.curr_execgrp = self.execgrp
 
+    # param/grad/aux lists always refer to the group that actually ran
+    # (the reference shares parameter STORAGE across bucket groups; JAX
+    # arrays are immutable, so here updates are applied to the current
+    # group and synchronized into the next group on bucket switch)
     @property
     def param_arrays(self):
-        return self.execgrp.param_arrays
+        return self.curr_execgrp.param_arrays
 
     @property
     def grad_arrays(self):
-        return self.execgrp.grad_arrays
+        return self.curr_execgrp.grad_arrays
 
     @property
     def aux_arrays(self):
-        return self.execgrp.aux_arrays
+        return self.curr_execgrp.aux_arrays
 
     def set_params(self, arg_params, aux_params):
-        for exe in self.execgrp.train_execs:
-            exe.copy_params_from(arg_params, aux_params,
-                                 allow_extra_params=True)
+        for grp in [self.execgrp] + list(self.execgrp_bucket.values()):
+            for exe in grp.train_execs:
+                exe.copy_params_from(arg_params, aux_params,
+                                     allow_extra_params=True)
+
+    def _sync_groups(self, src, dst):
+        """Carry the freshest parameters from the last-trained group
+        into the group about to run."""
+        if src is dst:
+            return
+        for s_exe, d_exe in zip(src.train_execs, dst.train_execs):
+            for name in dst.param_names:
+                d_exe.arg_dict[name][:] = s_exe.arg_dict[name]
+            for name in dst.aux_names:
+                d_exe.aux_dict[name][:] = s_exe.aux_dict[name]
 
     def load_data_batch(self, data_batch):
         if self.sym_gen is not None:
@@ -159,8 +178,11 @@ class DataParallelExecutorManager(object):
                 sym = self.sym_gen(key)
                 self.execgrp_bucket[key] = DataParallelExecutorGroup(
                     sym, self.arg_names, self.param_names, self.ctx,
-                    self.slices, data_batch, shared_group=self.execgrp)
-            self.curr_execgrp = self.execgrp_bucket[key]
+                    self.slices, data_batch,
+                    shared_group=self.curr_execgrp)
+            nxt = self.execgrp_bucket[key]
+            self._sync_groups(self.curr_execgrp, nxt)
+            self.curr_execgrp = nxt
         else:
             self.curr_execgrp = self.execgrp
         self.curr_execgrp.load_data_batch(data_batch)

@@ -496,31 +496,72 @@ def transformer_decode_step(params, cache, tokens_t, pos,
 
 
 def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
-    """Fill the cache from a prompt by scanning decode steps (compiles
-    to one program; prompt length is static). Returns (last_logits,
-    cache)."""
+    """Fill the cache from a prompt with ONE batched causal forward —
+    all prompt K/V per layer come from full-width matmuls (MXU-sized
+    work), not s sequential decode steps. Returns (last_logits, cache)."""
     b, s = tokens.shape
+    layers = params["layers"]
+    pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
+    hd = cfg.d_model // cfg.n_heads
 
-    def body(carry, t):
-        cache, _ = carry
-        logits, cache = transformer_decode_step(
-            params, cache, tokens[:, t], t, cfg)
-        return (cache, logits), None
+    x = params["embed"][tokens] + params["pos"][:s]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    li_flat = 0
+    for st in range(pp):
+        for li in range(lps):
+            lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
+            h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
+            k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, hd)
+            v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, hd)
+            # (b, s, h, d) -> cache layout (b, h, s, d), written at [:s]
+            cache = {
+                "k": cache["k"].at[li_flat, :, :, :s].set(
+                    k.transpose(0, 2, 1, 3).astype(cache["k"].dtype)),
+                "v": cache["v"].at[li_flat, :, :, :s].set(
+                    v.transpose(0, 2, 1, 3).astype(cache["v"].dtype)),
+            }
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            sc = jnp.where(mask[None, None], sc, -1e30)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
+            x = x + o.reshape(b, s, cfg.d_model) @ lp["wo"]
+            h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
+            if cfg.num_experts:
+                tok = h2.reshape(b * s, cfg.d_model)
+                logits_g = tok @ lp["gate"]
+                cap = max(1, int(cfg.capacity_factor * tok.shape[0]
+                                 * min(cfg.moe_top_k, 2)
+                                 / cfg.num_experts))
+                disp, comb, _ = top_k_gating(logits_g, cfg.num_experts,
+                                             cap, k=cfg.moe_top_k)
+                exp_in = jnp.einsum("nec,nd->ecd", disp.astype(x.dtype),
+                                    tok)
+                hh = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", exp_in,
+                                            lp["we1"]))
+                eo = jnp.einsum("ecf,efd->ecd", hh, lp["we2"])
+                f = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype),
+                               eo).reshape(b, s, cfg.d_model)
+            else:
+                f = jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + f
+            li_flat += 1
+    xl = _ln(x[:, -1], params["lnf_g"], params["lnf_b"])
+    return xl @ params["embed"].T, cache
 
-    logits0 = jnp.zeros((b, cfg.vocab_size), jnp.float32)
-    (cache, logits), _ = jax.lax.scan(
-        body, (cache, logits0), jnp.arange(s))
-    return logits, cache
+
+# compiled generation programs, keyed on everything that shapes the
+# trace — rebuilding the jitted closure per call would re-compile the
+# whole prefill+decode program every time
+_GENERATE_CACHE = {}
 
 
-def transformer_generate(params, prompt, steps, cfg: TransformerConfig,
-                         max_len=None):
-    """Greedy generation: prompt (b, s) int32 -> (b, steps) int32.
-    Prefill + decode run as ONE jitted lax.scan program; per-token cost
-    is O(1) in generated length (KV cache, static shapes)."""
-    b, s = prompt.shape
-    max_len = max_len or cfg.max_len
-    assert s + steps <= max_len, "prompt + steps exceeds max_len"
+def _generate_program(cfg: TransformerConfig, b, s, steps, max_len):
+    key = (id(type(cfg)), cfg.vocab_size, cfg.d_model, cfg.n_heads,
+           cfg.n_layers, cfg.d_ff, cfg.num_experts, cfg.moe_top_k,
+           cfg.capacity_factor, str(cfg.dtype), b, s, steps, max_len)
+    fn = _GENERATE_CACHE.get(key)
+    if fn is not None:
+        return fn
 
     @jax.jit
     def run(params, prompt):
@@ -539,4 +580,17 @@ def transformer_generate(params, prompt, steps, cfg: TransformerConfig,
             body, (cache, tok0), jnp.arange(steps))
         return jnp.moveaxis(toks, 0, 1)               # (b, steps)
 
-    return run(params, prompt)
+    _GENERATE_CACHE[key] = run
+    return run
+
+
+def transformer_generate(params, prompt, steps, cfg: TransformerConfig,
+                         max_len=None):
+    """Greedy generation: prompt (b, s) int32 -> (b, steps) int32.
+    Prefill (one batched causal forward) + decode run as ONE jitted
+    program, compiled once per (config, shape) and cached; per-token
+    decode cost is O(1) in generated length (KV cache, static shapes)."""
+    b, s = prompt.shape
+    max_len = max_len or cfg.max_len
+    assert s + steps <= max_len, "prompt + steps exceeds max_len"
+    return _generate_program(cfg, b, s, steps, max_len)(params, prompt)

@@ -24,11 +24,11 @@
 
 namespace {
 
-std::mutex g_err_mutex;
-std::string g_last_error;
+// per-thread, like the reference's MXAPIThreadLocalEntry: the pointer
+// returned by MXGetLastError must stay valid while other threads fail
+thread_local std::string g_last_error;
 
 void set_last_error(const std::string& msg) {
-  std::lock_guard<std::mutex> lock(g_err_mutex);
   g_last_error = msg;
 }
 
@@ -120,7 +120,6 @@ void** stash_handles(PyObject* list, uint32_t* out_num) {
 }  // namespace
 
 MXTPU_API const char* MXGetLastError(void) {
-  std::lock_guard<std::mutex> lock(g_err_mutex);
   return g_last_error.c_str();
 }
 

@@ -188,3 +188,51 @@ def test_executor_manager_group_matches_single_device():
         np.testing.assert_allclose(summed,
                                    exe.grad_dict[pname].asnumpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_executor_manager_bucketed_updates_propagate():
+    """Regression (round-5 review): with sym_gen bucketing, grad_arrays
+    must come from the group that ran backward, and parameter updates
+    must carry across bucket switches."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import io
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    def sym_gen(seq_len):
+        d = mx.sym.var("data")
+        pooled = mx.sym.mean(d, axis=1, keepdims=True)
+        return mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(pooled, num_hidden=2, name="fc"),
+            name="softmax")
+
+    def make_batch(key):
+        return io.DataBatch(
+            data=[mx.nd.ones((4, key))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[io.DataDesc("data", (4, key))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+
+    mgr = DataParallelExecutorManager(
+        sym_gen(8), [mx.cpu(0), mx.cpu(1)], make_batch(8),
+        sym_gen=sym_gen)
+    mgr.set_params({"fc_weight": mx.nd.zeros((2, 1)),
+                    "fc_bias": mx.nd.zeros((2,))}, {})
+    w_before = None
+    for key in [8, 16, 8]:
+        mgr.load_data_batch(make_batch(key))
+        mgr.forward(is_train=True)
+        mgr.backward()
+        # grads from the group that RAN (non-zero for the wrong class)
+        gsum = sum(float(np.abs(g.asnumpy()).sum())
+                   for parts in mgr.grad_arrays for g in parts)
+        assert gsum > 0, "zero grads from bucket group (key=%d)" % key
+        # sgd step on the current group's params
+        for parts, gparts in zip(mgr.param_arrays, mgr.grad_arrays):
+            for p, g in zip(parts, gparts):
+                p[:] = p - 0.1 * g
+        w_now = mgr.param_arrays[0][0].asnumpy().copy()
+        if w_before is not None:
+            assert not np.allclose(w_now, w_before), \
+                "updates lost across bucket switch"
+        w_before = w_now
