@@ -280,3 +280,22 @@ def test_kv_cache_decode_moe():
         logits, cache = transformer_decode_step(
             params, cache, tokens[:, t], t, cfg)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_transformer_lm_example_cli_with_generation():
+    """The 5D LM example trains and then greedy-decodes through the
+    KV-cache path (subprocess, as a user runs it)."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "examples", "train_transformer_lm.py"),
+         "--mesh", "1,1,1,1,1", "--steps", "6", "--d-model", "32",
+         "--n-layers", "2", "--d-ff", "64", "--seq-len", "64",
+         "--generate", "8"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "generated 8 tokens" in r.stdout, r.stdout
