@@ -1,11 +1,10 @@
 """PythonModule: module-API adapters for arbitrary Python computation.
 
 Capability parity with the reference
-(python/mxnet/module/python_module.py:28): ``PythonModule`` is the
-parameterless base that answers the module protocol (names, shapes,
-no-op update), and ``PythonLossModule`` turns a score->gradient
-function into a terminal loss module — the piece that lets a
-SequentialModule end in hand-written Python math.
+(python/mxnet/module/python_module.py:28). Layout here: one base class
+carrying all the protocol plumbing driven by a small ``_spec`` table
+(names + shape transform), and the loss head as a minimal subclass
+whose state is a single (scores, labels, grad) triple.
 """
 from __future__ import annotations
 
@@ -21,46 +20,56 @@ from .base_module import BaseModule
 __all__ = ["PythonModule", "PythonLossModule"]
 
 
+def _descs(shapes):
+    if shapes is None:
+        return None
+    return [s if isinstance(s, DataDesc) else DataDesc(*s)
+            for s in shapes]
+
+
 class PythonModule(BaseModule):
     """Subclass and override ``forward``/``backward`` (and
     ``_compute_output_shapes`` when outputs differ from inputs) to drop
     arbitrary Python computation into a module stack (reference:
-    python_module.py PythonModule)."""
+    python_module.py PythonModule). Owns no parameters; update and
+    optimizer init are accepted no-ops so generic training drivers run
+    unchanged."""
 
     def __init__(self, data_names, label_names, output_names,
                  logger=logging):
         super(PythonModule, self).__init__(logger=logger)
-        self._data_names = list(data_names)
-        self._label_names = list(label_names or [])
-        self._output_names = list(output_names)
-        self._data_shapes = None
-        self._label_shapes = None
-        self._output_shapes = None
+        self._spec = {
+            "data": list(data_names),
+            "label": list(label_names or []),
+            "output": list(output_names),
+        }
+        self._shape_table = {"data": None, "label": None, "output": None}
 
     @property
     def data_names(self):
-        return self._data_names
+        return self._spec["data"]
 
     @property
     def output_names(self):
-        return self._output_names
+        return self._spec["output"]
+
+    def _shapes(self, kind):
+        assert self.binded
+        return self._shape_table[kind]
 
     @property
     def data_shapes(self):
-        assert self.binded
-        return self._data_shapes
+        return self._shapes("data")
 
     @property
     def label_shapes(self):
-        assert self.binded
-        return self._label_shapes
+        return self._shapes("label")
 
     @property
     def output_shapes(self):
-        assert self.binded
-        return self._output_shapes
+        return self._shapes("output")
 
-    # a PythonModule owns no parameters (reference contract)
+    # no parameters by contract
     def get_params(self):
         return {}, {}
 
@@ -78,7 +87,7 @@ class PythonModule(BaseModule):
         self.optimizer_initialized = True
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        if self._label_shapes:
+        if self._spec["label"]:
             eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -89,14 +98,10 @@ class PythonModule(BaseModule):
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self._data_shapes = [ds if isinstance(ds, DataDesc)
-                             else DataDesc(*ds) for ds in data_shapes]
-        if label_shapes is not None:
-            self._label_shapes = [ls if isinstance(ls, DataDesc)
-                                  else DataDesc(*ls)
-                                  for ls in label_shapes]
-        self._output_shapes = self._compute_output_shapes()
+        self._shape_table["data"] = _descs(data_shapes)
+        self._shape_table["label"] = _descs(label_shapes)
         self.binded = True
+        self._shape_table["output"] = self._compute_output_shapes()
 
     def _compute_output_shapes(self):
         raise NotImplementedError()
@@ -106,52 +111,49 @@ class PythonModule(BaseModule):
 
 
 class PythonLossModule(PythonModule):
-    """Terminal loss module: forward passes scores through, backward
+    """Terminal loss head: forward passes scores through, backward
     produces d(loss)/d(scores) from ``grad_func(scores, labels)``
     (reference: python_module.py PythonLossModule)."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        assert len(data_names) == 1 and len(label_names) == 1
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise ValueError("a loss head takes one score and one label")
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
         super(PythonLossModule, self).__init__(
             data_names, label_names, [name + "_output"], logger=logger)
         self._name = name
-        self._scores = None
-        self._labels = None
-        self._scores_grad = None
-        if grad_func is not None and not callable(grad_func):
-            raise TypeError("grad_func must be callable")
         self._grad_func = grad_func
+        self._state = {"scores": None, "labels": None, "grad": None}
 
     def _compute_output_shapes(self):
-        # a loss head emits the scores it receives
+        # a loss head emits whatever scores it receives
         return [DataDesc(self._name + "_output",
-                         self._data_shapes[0].shape)]
+                         self.data_shapes[0].shape)]
 
     def forward(self, data_batch, is_train=None):
-        self._scores = data_batch.data[0]
-        if is_train is None:
-            is_train = self.for_training
-        if is_train:
-            self._labels = data_batch.label[0]
+        self._state["scores"] = data_batch.data[0]
+        if is_train if is_train is not None else self.for_training:
+            self._state["labels"] = data_batch.label[0]
 
     def get_outputs(self, merge_multi_context=True):
         assert merge_multi_context
-        return [self._scores]
+        return [self._state["scores"]]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, \
-            "a loss module takes no output gradients"
+        if out_grads is not None:
+            raise ValueError("a loss head takes no output gradients")
         assert self.for_training
         if self._grad_func is None:
             raise NotImplementedError(
                 "pass grad_func or override backward")
-        grad = self._grad_func(self._scores, self._labels)
-        if not isinstance(grad, NDArray):
-            grad = _nd_array(_np.asarray(grad))
-        self._scores_grad = grad
+        g = self._grad_func(self._state["scores"], self._state["labels"])
+        if not isinstance(g, NDArray):
+            g = _nd_array(_np.asarray(g))
+        self._state["grad"] = g
 
     def get_input_grads(self, merge_multi_context=True):
         assert merge_multi_context
-        return [self._scores_grad]
+        return [self._state["grad"]]

@@ -1,22 +1,33 @@
 """SequentialModule: chain modules end to end.
 
 Capability parity with the reference container
-(python/mxnet/module/sequential_module.py:28): each added module
-consumes the previous module's outputs as its data; ``take_labels``
-marks the modules that also receive the batch labels (typically the
-last, the loss), and ``auto_wiring`` renames the previous outputs to
-the next module's data names. Intermediate modules are bound with
-``inputs_need_grad`` so gradients chain backward through the stack.
+(python/mxnet/module/sequential_module.py:28). Design here: the chain
+is a list of ``_Link(module, flags)`` records and every lifecycle verb
+is expressed through one ``_each`` traversal; shapes are threaded at
+bind time through a single fold instead of per-module bookkeeping.
+
+Semantics kept from the reference: ``take_labels`` marks the modules
+that receive the batch labels (typically the loss head) for bind and
+metric updates; ``auto_wiring`` renames the previous module's outputs
+to the next module's data names; intermediate modules always produce
+input gradients while training so backward chains through the stack.
 """
 from __future__ import annotations
 
 import logging
+from collections import namedtuple
 
 from ..initializer import Uniform
 from ..io import DataBatch, DataDesc
 from .base_module import BaseModule
 
 __all__ = ["SequentialModule"]
+
+_Link = namedtuple("_Link", ["module", "flags"])
+
+
+def _desc(x):
+    return x if isinstance(x, DataDesc) else DataDesc(*x)
 
 
 class SequentialModule(BaseModule):
@@ -28,8 +39,7 @@ class SequentialModule(BaseModule):
 
     def __init__(self, logger=logging):
         super(SequentialModule, self).__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._chain = []
         self._data_shapes = None
         self._label_shapes = None
 
@@ -37,27 +47,42 @@ class SequentialModule(BaseModule):
         """Append ``module``; kwargs are the META_* flags. Returns self
         so adds chain."""
         known = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
-        for key in kwargs:
-            if key not in known:
-                raise ValueError("unknown meta %r (have %s)"
-                                 % (key, sorted(known)))
-        self._modules.append(module)
-        self._metas.append(dict(kwargs))
-        # adding invalidates any existing binding state
+        bad = set(kwargs) - known
+        if bad:
+            raise ValueError("unknown meta %s (known: %s)"
+                             % (sorted(bad), sorted(known)))
+        self._chain.append(_Link(module,
+                                 {k for k, v in kwargs.items() if v}))
+        # growing the chain invalidates any binding state
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
-    # -- shapes / names ----------------------------------------------------
+    # -- traversal helpers -------------------------------------------------
+
+    def _each(self, fn, reverse=False):
+        links = reversed(self._chain) if reverse else self._chain
+        for link in links:
+            fn(link)
+
+    @property
+    def _head(self):
+        return self._chain[0].module
+
+    @property
+    def _tail(self):
+        return self._chain[-1].module
+
+    # -- names / shapes ----------------------------------------------------
 
     @property
     def data_names(self):
-        return self._modules[0].data_names if self._modules else []
+        return self._head.data_names if self._chain else []
 
     @property
     def output_names(self):
-        return self._modules[-1].output_names if self._modules else []
+        return self._tail.output_names if self._chain else []
 
     @property
     def data_shapes(self):
@@ -72,18 +97,21 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._tail.output_shapes
 
-    # -- params ------------------------------------------------------------
+    # -- parameters --------------------------------------------------------
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params, aux_params = {}, {}
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return arg_params, aux_params
+        merged = ({}, {})
+
+        def collect(link):
+            arg, aux = link.module.get_params()
+            merged[0].update(arg)
+            merged[1].update(aux)
+
+        self._each(collect)
+        return merged
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -91,22 +119,20 @@ class SequentialModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        seen = {}
-        for i, module in enumerate(self._modules):
-            module.init_params(initializer=initializer,
-                               arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init,
-                               allow_extra=allow_extra)
-            arg, aux = module.get_params()
-            for name in list(arg) + list(aux):
-                if name in seen:
+        owners = {}
+        for pos, link in enumerate(self._chain):
+            link.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init, allow_extra=allow_extra)
+            arg, aux = link.module.get_params()
+            for pname in list(arg) + list(aux):
+                if pname in owners:
                     raise ValueError(
-                        "duplicate parameter %r in modules %d and %d — "
-                        "chained modules must have disjoint names"
-                        % (name, seen[name], i))
-                seen[name] = i
+                        "parameter %r appears in chained modules %d and "
+                        "%d; names must be disjoint"
+                        % (pname, owners[pname], pos))
+                owners[pname] = pos
         self.params_initialized = True
 
     # -- bind / optimizer --------------------------------------------------
@@ -119,38 +145,34 @@ class SequentialModule(BaseModule):
             return
         assert shared_module is None, \
             "shared_module is not supported for SequentialModule"
-        assert self._modules, "add modules before binding"
+        assert self._chain, "add modules before binding"
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self._data_shapes = [DataDesc(*ds) if not isinstance(ds, DataDesc)
-                             else ds for ds in data_shapes]
+        self._data_shapes = [_desc(d) for d in data_shapes]
         self._label_shapes = label_shapes
 
-        cur_shapes = self._data_shapes
-        last = len(self._modules) - 1
-        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
-            labels = label_shapes if meta.get(self.META_TAKE_LABELS) \
-                else None
-            # auto_wiring on THIS module renames the previous module's
-            # outputs to this module's own data names
-            if i > 0 and meta.get(self.META_AUTO_WIRING):
-                names = module.data_names
-                assert len(names) == len(cur_shapes), \
+        feeding = self._data_shapes
+        for pos, link in enumerate(self._chain):
+            if pos and self.META_AUTO_WIRING in link.flags:
+                names = link.module.data_names
+                assert len(names) == len(feeding), \
                     "auto_wiring: %d outputs feed %d inputs" % (
-                        len(cur_shapes), len(names))
-                cur_shapes = [DataDesc(n, d.shape)
-                              for n, d in zip(names, cur_shapes)]
-            # every module except the first must produce input grads so
-            # the backward pass chains through
-            need_grad = inputs_need_grad if i == 0 else for_training
-            module.bind(data_shapes=cur_shapes, label_shapes=labels,
-                        for_training=for_training,
-                        inputs_need_grad=need_grad,
-                        force_rebind=force_rebind, grad_req=grad_req)
-            if i < last:
-                cur_shapes = [os if isinstance(os, DataDesc)
-                              else DataDesc(*os)
-                              for os in module.output_shapes]
+                        len(feeding), len(names))
+                feeding = [DataDesc(n, d.shape)
+                           for n, d in zip(names, feeding)]
+            link.module.bind(
+                data_shapes=feeding,
+                label_shapes=(label_shapes
+                              if self.META_TAKE_LABELS in link.flags
+                              else None),
+                for_training=for_training,
+                # non-first modules must emit input grads so backward
+                # can ride the chain
+                inputs_need_grad=(inputs_need_grad if pos == 0
+                                  else for_training),
+                force_rebind=force_rebind, grad_req=grad_req)
+            if pos + 1 < len(self._chain):
+                feeding = [_desc(o) for o in link.module.output_shapes]
         self.binded = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -159,10 +181,9 @@ class SequentialModule(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        self._each(lambda link: link.module.init_optimizer(
+            kvstore=kvstore, optimizer=optimizer,
+            optimizer_params=optimizer_params, force_init=force_init))
         self.optimizer_initialized = True
 
     # -- compute -----------------------------------------------------------
@@ -170,48 +191,48 @@ class SequentialModule(BaseModule):
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         batch = data_batch
-        for i, module in enumerate(self._modules):
-            module.forward(batch, is_train=is_train)
-            if i + 1 == len(self._modules):
-                break
-            batch = DataBatch(data=module.get_outputs(),
-                              label=data_batch.label,
-                              pad=getattr(data_batch, "pad", 0))
+        for pos, link in enumerate(self._chain):
+            link.module.forward(batch, is_train=is_train)
+            if pos + 1 < len(self._chain):
+                batch = DataBatch(data=link.module.get_outputs(),
+                                  label=data_batch.label,
+                                  pad=getattr(data_batch, "pad", 0))
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         grads = out_grads
-        for i, module in reversed(list(enumerate(self._modules))):
+        for pos in range(len(self._chain) - 1, -1, -1):
+            module = self._chain[pos].module
             module.backward(out_grads=grads)
-            if i == 0:
-                break
-            grads = module.get_input_grads()
+            if pos:
+                grads = module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        self._each(lambda link: link.module.update())
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(
+        return self._tail.get_outputs(
             merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized \
             and self.inputs_need_grad
-        return self._modules[0].get_input_grads(
+        return self._head.get_input_grads(
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         assert self.binded and self.params_initialized
-        for module, meta in zip(self._modules, self._metas):
-            if meta.get(self.META_TAKE_LABELS):
-                module.update_metric(eval_metric, labels,
-                                     pre_sliced=pre_sliced)
+
+        def upd(link):
+            if self.META_TAKE_LABELS in link.flags:
+                link.module.update_metric(eval_metric, labels,
+                                          pre_sliced=pre_sliced)
+
+        self._each(upd)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        self._each(lambda link: link.module.install_monitor(mon))
