@@ -9,4 +9,6 @@ from . import text                    # noqa: F401
 from . import svrg_optimization      # noqa: F401
 from . import tensorboard             # noqa: F401
 from . import onnx                    # noqa: F401
+from . import autograd               # noqa: F401
+from . import io                      # noqa: F401
 from .quantization import quantize_model  # noqa: F401

@@ -1,0 +1,91 @@
+"""contrib autograd: the pre-1.0 experimental surface (reference:
+python/mxnet/contrib/autograd.py), expressed over the first-class
+``mxnet_tpu.autograd``. Kept so code written against the old names
+(train_section, mark_variables-with-gradients, grad_and_loss) runs."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Reference: contrib/autograd.py set_is_training. Returns the
+    previous state."""
+    prev = _ag.set_recording(bool(is_train))
+    _ag.set_training(bool(is_train))
+    return prev
+
+
+def train_section():
+    """``with train_section():`` == autograd.record()."""
+    return _ag.record()
+
+
+def test_section():
+    """``with test_section():`` == autograd.pause()."""
+    return _ag.pause()
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (the old API passes them explicitly)."""
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    _ag.mark_variables(list(variables), list(gradients),
+                       grad_reqs=grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    _ag.backward(list(outputs), head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Reference: contrib/autograd.py compute_gradient — backward, then
+    collect the marked variables' gradients (the new-API entry point
+    returns them directly)."""
+    backward(outputs)
+    return None
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate ``func`` to return (gradients, loss) w.r.t. its inputs
+    (reference: contrib/autograd.py grad_and_loss)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            sel = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in sel]
+        for x in variables:
+            if not isinstance(x, NDArray):
+                raise MXNetError("arguments must be NDArrays")
+        grads = [x.zeros_like() for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            out = func(*args)
+        backward([out] if isinstance(out, NDArray) else out)
+        return grads, out
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorate ``func`` to return gradients only (reference:
+    contrib/autograd.py grad)."""
+    fn = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return fn(*args)[0]
+
+    return wrapped

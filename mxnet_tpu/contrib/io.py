@@ -1,0 +1,55 @@
+"""contrib IO: DataIter adapters (reference:
+python/mxnet/contrib/io.py — DataLoaderIter wraps a gluon DataLoader
+in the classic DataIter protocol so Module.fit consumes it)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io import DataBatch, DataDesc, DataIter
+from ..ndarray.ndarray import NDArray, array as _nd_array
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Present a ``gluon.data.DataLoader`` as a DataIter (reference:
+    contrib/io.py DataLoaderIter): each loader item must be a
+    (data, label) pair; shapes come from the first batch."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        self._loader = loader
+        self._iter = iter(loader)
+        self._dtype = dtype
+        first = next(self._iter)
+        data, label = self._as_pair(first)
+        super().__init__(batch_size=data.shape[0])
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)]
+        self._pending = (data, label)
+
+    @staticmethod
+    def _as_pair(item):
+        data, label = item
+
+        def nd(x):
+            if isinstance(x, NDArray):
+                return x
+            return _nd_array(_np.asarray(x))
+
+        return nd(data), nd(label)
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._pending = None
+
+    def next(self):
+        if self._pending is not None:
+            data, label = self._pending
+            self._pending = None
+        else:
+            try:
+                data, label = self._as_pair(next(self._iter))
+            except StopIteration:
+                raise
+        return DataBatch(data=[data], label=[label], pad=0)

@@ -167,3 +167,50 @@ def test_onnx_unsupported_op_raises_cleanly():
     with pytest.raises(MXNetError):
         mx.contrib.onnx.export_model(bad, {}, (1, 4),
                                      onnx_file_path=None)
+
+
+def test_contrib_autograd_legacy_surface():
+    """Old experimental autograd API (reference: contrib/autograd.py):
+    grad_and_loss / grad decorators over the first-class tape."""
+    from mxnet_tpu.contrib import autograd as cag
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+
+    @cag.grad_and_loss
+    def loss_fn(x):
+        return (x * x).sum()
+
+    grads, loss = loss_fn(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2.0, 4.0, 6.0],
+                               rtol=1e-6)
+    assert abs(float(loss.asnumpy()) - 14.0) < 1e-5
+
+    g_only = cag.grad(loss_fn.__wrapped__)(x)
+    np.testing.assert_allclose(g_only[0].asnumpy(), [2.0, 4.0, 6.0],
+                               rtol=1e-6)
+
+    with cag.train_section():
+        assert mx.autograd.is_recording()
+    with cag.test_section():
+        assert not mx.autograd.is_training()
+
+
+def test_contrib_dataloader_iter():
+    """DataLoaderIter adapts a gluon DataLoader to the DataIter
+    protocol (reference: contrib/io.py) — Module.fit consumes it."""
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(20, 4).astype(np.float32)
+    labels = rng.randint(0, 2, 20).astype(np.float32)
+    dl = DataLoader(ArrayDataset(data, labels), batch_size=5)
+    it = DataLoaderIter(dl)
+    assert it.provide_data[0].shape == (5, 4)
+    seen = 0
+    for batch in it:
+        seen += batch.data[0].shape[0]
+    assert seen == 20
+    it.reset()
+    assert next(it).data[0].shape == (5, 4)
