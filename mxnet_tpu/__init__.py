@@ -44,7 +44,8 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "executor", "model", "monitor", "visualization", "rtc", "contrib",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
-         "util", "registry", "misc", "executor_manager")
+         "util", "registry", "misc", "executor_manager", "ndarray_doc",
+         "symbol_doc")
 
 
 def __getattr__(name):

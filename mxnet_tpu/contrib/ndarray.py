@@ -1,0 +1,7 @@
+"""contrib ndarray namespace alias (reference:
+python/mxnet/contrib/ndarray.py re-exports the contrib op surface):
+``from mxnet_tpu.contrib import ndarray`` mirrors ``mx.nd.contrib``."""
+from ..ndarray.contrib import *          # noqa: F401,F403
+from ..ndarray import contrib as _c
+
+__all__ = list(getattr(_c, "__all__", []))

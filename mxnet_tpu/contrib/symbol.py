@@ -1,0 +1,7 @@
+"""contrib symbol namespace alias (reference:
+python/mxnet/contrib/symbol.py): ``from mxnet_tpu.contrib import
+symbol`` mirrors ``mx.sym.contrib``."""
+from ..symbol.contrib import *           # noqa: F401,F403
+from ..symbol import contrib as _c
+
+__all__ = list(getattr(_c, "__all__", []))

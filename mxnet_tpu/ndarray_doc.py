@@ -1,0 +1,51 @@
+"""Supplementary operator documentation for the ndarray namespace
+(reference: python/mxnet/ndarray_doc.py — per-op example docstrings
+merged into the generated bindings).
+
+Here extra docs are a plain table consumed by ``augment_doc``; the op
+registry's own docstrings (ops/registry.py) are the primary source, so
+this module carries only worked examples.
+"""
+from __future__ import annotations
+
+__all__ = ["NDArrayDoc", "augment_doc", "EXAMPLES"]
+
+
+class NDArrayDoc(object):
+    """Marker base class kept for reference-API compatibility."""
+
+
+EXAMPLES = {
+    "reshape": """
+Examples
+--------
+>>> x = mx.nd.array([1, 2, 3, 4])
+>>> mx.nd.reshape(x, shape=(2, 2)).shape
+(2, 2)
+
+``0`` copies a dimension from the input; ``-1`` infers it:
+>>> mx.nd.ones((2, 3, 4)).reshape((0, -1)).shape
+(2, 12)
+""",
+    "concat": """
+Examples
+--------
+>>> a = mx.nd.ones((2, 2))
+>>> mx.nd.concat(a, a, dim=0).shape
+(4, 2)
+""",
+    "dot": """
+Examples
+--------
+>>> a = mx.nd.ones((2, 3))
+>>> b = mx.nd.ones((3, 4))
+>>> mx.nd.dot(a, b).shape
+(2, 4)
+""",
+}
+
+
+def augment_doc(name, doc):
+    """Append the worked example for ``name`` (if any) to ``doc``."""
+    extra = EXAMPLES.get(name)
+    return (doc or "") + (extra or "")

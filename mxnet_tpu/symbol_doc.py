@@ -1,0 +1,43 @@
+"""Supplementary operator documentation for the symbol namespace
+(reference: python/mxnet/symbol_doc.py). Same table-driven design as
+ndarray_doc; symbolic examples only."""
+from __future__ import annotations
+
+__all__ = ["SymbolDoc", "augment_doc", "EXAMPLES"]
+
+
+class SymbolDoc(object):
+    """Marker base class kept for reference-API compatibility."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer output shapes as a name->shape dict (the one utility
+        the reference class carries)."""
+        _, out_shapes, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), out_shapes))
+
+
+EXAMPLES = {
+    "FullyConnected": """
+Examples
+--------
+>>> data = mx.sym.Variable('data')
+>>> fc = mx.sym.FullyConnected(data, num_hidden=128, name='fc1')
+>>> fc.list_arguments()
+['data', 'fc1_weight', 'fc1_bias']
+""",
+    "Concat": """
+Examples
+--------
+>>> a = mx.sym.Variable('a')
+>>> b = mx.sym.Variable('b')
+>>> mx.sym.Concat(a, b, dim=0).list_arguments()
+['a', 'b']
+""",
+}
+
+
+def augment_doc(name, doc):
+    """Append the worked example for ``name`` (if any) to ``doc``."""
+    extra = EXAMPLES.get(name)
+    return (doc or "") + (extra or "")
