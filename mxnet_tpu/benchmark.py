@@ -733,6 +733,72 @@ def infer_score(model="resnet50", batch=32, dtype="float32", iters=30):
     return img_s, extra
 
 
+def infer_quantized(model="resnet50", batch=32, iters=30):
+    """INT8 scoring throughput: the zoo model is traced to a Symbol,
+    quantized with naive calibration (contrib/quantization.py
+    quantize_model — int8 operands, int32 MXU accumulation), and timed
+    through a bound executor with per-iteration fetch sync. The
+    capability analog of the reference's quantization example
+    (example/quantization/imagenet_gen_qsym.py); no published reference
+    int8 throughput row exists, so no vs_baseline."""
+    import mxnet_tpu as mx
+    from .gluon.model_zoo.vision import get_model
+    from .ndarray.ndarray import array as nd_array
+
+    size = 224
+    zoo = {"resnet50": "resnet50_v1", "resnet18": "resnet18_v1"}[model]
+    net = get_model(zoo, classes=1000)
+    net.initialize()
+    net(nd_array(np.zeros((1, 3, size, size), np.float32)))
+    sym = mx.sym.softmax(net._trace_symbol(), name="prob")
+
+    params = {}
+    for name, p in net.collect_params().items():
+        params[name] = p.data()
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in params.items() if k in arg_names}
+    aux_params = {k: v for k, v in params.items() if k in aux_names}
+
+    rng = np.random.RandomState(0)
+    calib = mx.io.NDArrayIter(
+        rng.randn(batch, 3, size, size).astype(np.float32),
+        np.zeros((batch,), np.float32), batch_size=batch)
+    qsym, qarg, qaux = mx.contrib.quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive",
+        calib_data=calib, num_calib_examples=batch,
+        excluded_sym_names=())
+    exe = qsym.simple_bind(mx.context.current_context(),
+                           grad_req="null", data=(batch, 3, size, size))
+    exe.copy_params_from(qarg, qaux, allow_extra_params=True)
+    x = nd_array(rng.randn(batch, 3, size, size).astype(np.float32))
+
+    state = {"feed": x}
+
+    def one():
+        exe.forward(is_train=False, data=state["feed"])
+        out = exe.outputs[0]
+        # chain the next input on this output (same trust model as
+        # infer_score: a non-blocking transport cannot drop iterations)
+        state["feed"] = x + out.reshape((-1,))[0:1] * 0
+        return out._data
+
+    dt = _timeit(one, warmup=3, iters=iters)
+    img_s = batch / dt
+    gflop = MODEL_GFLOP_PER_IMG.get(model)
+    extra = {"ms_per_batch": round(dt * 1e3, 2), "dtype": "int8",
+             "batch": batch, "calib": "naive"}
+    if gflop:
+        tflops = img_s * gflop * 1e9
+        if tflops > 2.1 * peak_flops("int8"):
+            # int8 peak is ~2x bf16 on the MXU generations that have it
+            raise RuntimeError(
+                "implausible int8 measurement: %.0f img/s" % img_s)
+        extra.update(_mfu_extra(tflops / peak_flops("int8"),
+                                peak_flops("int8")))
+    return img_s, extra
+
+
 # ---------------------------------------------------------------------------
 # job registry + CLI
 
@@ -803,6 +869,12 @@ def _job_e2e_train():
                    "img/s (resnet50 bf16 train, data pipeline in loop)", x)
 
 
+def _job_infer_int8():
+    v, x = infer_quantized("resnet50")
+    return persist("resnet50_infer_int8_img_per_sec", v,
+                   "img/s (batch 32, int8 quantized, 1 chip)", x)
+
+
 def _make_infer_job(model, dtype, batch=32):
     def job():
         v, x = infer_score(model, batch, dtype)
@@ -821,6 +893,7 @@ JOBS = {
     "data_pipeline_native": _job_data_pipeline_native,
     "e2e_train": _job_e2e_train,
     "transformer_decode": _job_transformer_decode,
+    "resnet50_infer_int8": _job_infer_int8,
     "inception-v3_train": _job_inception_train,
     "resnet50_train": _job_resnet50_train,
     "resnet50_train_bf16": _job_resnet50_train_bf16,
@@ -851,6 +924,7 @@ JOB_PRIORITY = [
     "inception-v3_train",
     "resnet50_infer_b1",
     "resnet50_infer_b128",
+    "resnet50_infer_int8",
     "alexnet_infer",
     "vgg16_infer",
     "resnet152_infer",
