@@ -207,6 +207,17 @@ int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
                               NDArrayHandle* out);
 int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src);
 
+/* ---- symbol construction (reference: c_api_symbolic.cc) ---------- */
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+/* op symbol with free (auto-variable) inputs; wire them with Compose */
+int MXSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_params,
+                               const char** keys, const char** vals,
+                               const char* name, SymbolHandle* out);
+/* keys NULL = positional wiring of the free variables */
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args);
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out);
+
 /* ---- executor reshape -------------------------------------------- */
 int MXExecutorReshape(ExecutorHandle exec, uint32_t num_inputs,
                       const char** input_names,

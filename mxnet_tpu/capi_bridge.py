@@ -501,3 +501,38 @@ def kv_pushpull(kv, keys, vals, outs, priority):
 def executor_reshape(w, names, shape_arrs):
     shapes = {n: tuple(a.shape) for n, a in zip(names, shape_arrs)}
     return _ExecWrap(w.exe.reshape(**shapes))
+
+
+# -- batch-4: symbol construction (reference: c_api_symbolic.cc
+#    MXSymbolCreateVariable / MXSymbolCreateAtomicSymbol /
+#    MXSymbolCompose / MXSymbolCopy) ---------------------------------------
+
+def symbol_create_variable(name):
+    from .symbol.symbol import var
+    return var(name)
+
+
+def symbol_create_atomic(op_name, keys, vals, name):
+    """An op symbol with its inputs left as free (auto) variables;
+    Compose wires them (the reference's two-phase graph building)."""
+    from . import symbol as _sym_ns
+    fn = getattr(_sym_ns, op_name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError("no symbolic operator %r" % op_name)
+    attrs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
+    if name:
+        attrs["name"] = name
+    return fn(**attrs)
+
+
+def symbol_compose(sym, name, keys, args):
+    """Wire ``args`` into ``sym``'s free variables, in place."""
+    if keys:
+        sym._compose(name=name or None, **dict(zip(keys, args)))
+    else:
+        sym._compose(*args, name=name or None)
+    return 0
+
+
+def symbol_copy(sym):
+    return sym.copy()

@@ -1241,3 +1241,81 @@ MXTPU_API int MXExecutorReshape(ExecutorHandle exec, uint32_t num_inputs,
   *out = r;
   return 0;
 }
+
+// ------------------------------------------------- symbol construction
+// (reference: src/c_api/c_api_symbolic.cc — two-phase graph building:
+//  atomic op symbols with free inputs, wired by Compose)
+
+MXTPU_API int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* r = bridge_call("symbol_create_variable", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolCreateAtomicSymbol(const char* op_name,
+                                         uint32_t num_params,
+                                         const char** keys,
+                                         const char** vals,
+                                         const char* name,
+                                         SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (uint32_t i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNNs)", op_name, pkeys, pvals,
+                                 name ? name : "");
+  PyObject* r = bridge_call("symbol_create_atomic", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char* name,
+                              uint32_t num_args, const char** keys,
+                              SymbolHandle* args_handles) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys;
+  if (keys != nullptr) {
+    pkeys = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i)
+      PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+  } else {
+    pkeys = PyList_New(0);
+  }
+  PyObject* pargs = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(args_handles[i]);
+    Py_INCREF(o);
+    PyList_SetItem(pargs, i, o);
+  }
+  PyObject* call_args = Py_BuildValue(
+      "(OsNN)", reinterpret_cast<PyObject*>(sym), name ? name : "",
+      pkeys, pargs);
+  PyObject* r = bridge_call("symbol_compose", call_args);
+  Py_DECREF(call_args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_copy", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}

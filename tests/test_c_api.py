@@ -457,3 +457,55 @@ def test_c_api_batch3_surfaces(tmp_path, c_api_lib):
     lib.MXKVStoreFree(kv)
     for hh in (h, h2, h3):
         lib.MXNDArrayFree(hh)
+
+
+def test_c_api_symbol_construction(tmp_path, c_api_lib):
+    """Graphs built purely through the ABI (CreateVariable /
+    CreateAtomicSymbol / Compose) bind and run like JSON-built ones."""
+    import ctypes
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    data = ctypes.c_void_p()
+    assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    assert lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, keys, vals, b"fc", ctypes.byref(fc)) == 0
+    ckeys = (ctypes.c_char_p * 1)(b"data")
+    cargs = (ctypes.c_void_p * 1)(data.value)
+    assert lib.MXSymbolCompose(fc, b"fc", 1, ckeys, cargs) == 0
+
+    n = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                     ctypes.byref(names)) == 0
+    got = [names[i].decode() for i in range(n.value)]
+    assert got == ["data", "fc_weight", "fc_bias"], got
+
+    # bind + forward through the executor surface
+    shape = (ctypes.c_uint32 * 2)(2, 5)
+    x = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, 2, 0, b"cpu", 0,
+                               ctypes.byref(x)) == 0
+    in_names = (ctypes.c_char_p * 1)(b"data")
+    in_arrs = (ctypes.c_void_p * 1)(x.value)
+    exe = ctypes.c_void_p()
+    assert lib.MXExecutorSimpleBind(fc, 1, in_names, in_arrs,
+                                    ctypes.byref(exe)) == 0
+    assert lib.MXExecutorForward(exe, 0) == 0
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXExecutorOutputs(exe, ctypes.byref(n),
+                                 ctypes.byref(outs)) == 0
+    ndim = ctypes.c_uint32()
+    dims = (ctypes.c_uint32 * 32)()
+    # outs[0] is a bare int; wrap it or ctypes truncates the pointer
+    out0 = ctypes.c_void_p(outs[0])
+    assert lib.MXNDArrayGetShape(out0, ctypes.byref(ndim), dims) == 0
+    assert (dims[0], dims[1]) == (2, 3)
+    cp = ctypes.c_void_p()
+    assert lib.MXSymbolCopy(fc, ctypes.byref(cp)) == 0
+    lib.MXExecutorFree(exe)
+    for h in (data, fc, cp, x):
+        lib.MXNDArrayFree(h)
