@@ -5,6 +5,7 @@
 #ifndef MXNET_TPU_CPP_EXECUTOR_HPP_
 #define MXNET_TPU_CPP_EXECUTOR_HPP_
 
+#include <map>
 #include <string>
 #include <vector>
 
@@ -18,6 +19,42 @@ class Symbol {
     Symbol s;
     Check(MXSymbolCreateFromJSON(json.c_str(), &s.handle_));
     return s;
+  }
+
+  static Symbol Variable(const std::string& name) {
+    Symbol s;
+    Check(MXSymbolCreateVariable(name.c_str(), &s.handle_));
+    return s;
+  }
+
+  // op node with free inputs; wire them with Compose (the reference's
+  // two-phase mxnet-cpp Symbol building)
+  static Symbol Atomic(const std::string& op,
+                       const std::map<std::string, std::string>& attrs,
+                       const std::string& name = "") {
+    std::vector<const char*> ks, vs;
+    for (const auto& kv : attrs) {
+      ks.push_back(kv.first.c_str());
+      vs.push_back(kv.second.c_str());
+    }
+    Symbol s;
+    Check(MXSymbolCreateAtomicSymbol(
+        op.c_str(), static_cast<uint32_t>(ks.size()), ks.data(),
+        vs.data(), name.empty() ? nullptr : name.c_str(), &s.handle_));
+    return s;
+  }
+
+  void Compose(const std::map<std::string, const Symbol*>& inputs,
+               const std::string& name = "") {
+    std::vector<const char*> ks;
+    std::vector<SymbolHandle> hs;
+    for (const auto& kv : inputs) {
+      ks.push_back(kv.first.c_str());
+      hs.push_back(kv.second->handle());
+    }
+    Check(MXSymbolCompose(handle_, name.empty() ? nullptr : name.c_str(),
+                          static_cast<uint32_t>(ks.size()), ks.data(),
+                          hs.data()));
   }
 
   Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
