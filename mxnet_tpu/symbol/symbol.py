@@ -264,7 +264,12 @@ class Symbol(object):
     # -- attributes --------------------------------------------------------
     def attr(self, key):
         if len(self._entries) == 1:
-            return self._entries[0][0].attrs.get(key)
+            attrs = self._entries[0][0].attrs
+            if key in attrs:
+                return attrs[key]
+            # annotation attrs (ctx_group, lr_mult, ...) are stored
+            # dunder-prefixed; the reference API looks them up bare
+            return attrs.get("__%s__" % key)
         return None
 
     def list_attr(self):

@@ -370,3 +370,36 @@ def test_control_flow_capture_aux_and_inner_shapes():
 
     with pytest.raises(TypeError):
         bool(mx.sym.var("q") > 0)
+
+
+def test_thread_local_scopes_isolated():
+    """Per-thread isolation of naming/attr/context/autograd scopes
+    (reference: tests/python/unittest/test_thread_local.py)."""
+    import threading
+
+    results = {}
+
+    def worker():
+        with mx.name.Prefix("w_"):
+            with mx.AttrScope(ctx_group="dev9"):
+                s = mx.sym.FullyConnected(mx.sym.var("wd"), num_hidden=2)
+                results["name"] = s.name
+                results["attr"] = s.attr("ctx_group")
+        with mx.Context("cpu", 1):
+            results["ctx"] = mx.context.current_context().device_id
+        results["recording"] = mx.autograd.is_recording()
+
+    with mx.name.Prefix("main_"):
+        with mx.AttrScope(ctx_group="dev0"):
+            with mx.autograd.record():
+                t = threading.Thread(target=worker)
+                t.start()
+                t.join(timeout=30)
+            s_main = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=2)
+    assert results["name"].startswith("w_"), results
+    assert results["attr"] == "dev9"
+    assert results["ctx"] == 1
+    assert results["recording"] is False      # record() is per-thread
+    assert s_main.name.startswith("main_")
+    assert s_main.attr("ctx_group") == "dev0"
+    assert mx.context.current_context().device_id == 0
