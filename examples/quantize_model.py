@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Quantize a zoo model to int8 and compare scoring accuracy/speed.
+
+Capability analog of the reference's quantization example
+(example/quantization/imagenet_gen_qsym.py + imagenet_inference.py):
+trace a gluon zoo model to a Symbol, calibrate + rewrite it with
+contrib.quantization.quantize_model (int8 operands, int32 MXU
+accumulation), then score both graphs on synthetic data.
+
+Smoke run:
+    JAX_PLATFORMS=cpu python examples/quantize_model.py \
+        --model resnet18_v1 --batch-size 4 --num-batches 2
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-batches", type=int, default=4)
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.ndarray.ndarray import array as nd_array
+
+    b, hw = args.batch_size, args.image_size
+    net = get_model(args.model, classes=1000)
+    net.initialize()
+    net(nd_array(np.zeros((1, 3, hw, hw), np.float32)))
+    sym = mx.sym.softmax(net._trace_symbol(), name="prob")
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in params.items() if k in arg_names}
+    aux_params = {k: v for k, v in params.items() if k in aux_names}
+
+    rng = np.random.RandomState(0)
+    calib_x = rng.randn(b, 3, hw, hw).astype(np.float32)
+    calib = mx.io.NDArrayIter(calib_x, np.zeros((b,), np.float32),
+                              batch_size=b)
+    qsym, qarg, qaux = mx.contrib.quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive",
+        calib_data=calib, num_calib_examples=b)
+    n_int8 = sum(1 for v in qarg.values()
+                 if str(getattr(v, "dtype", "")) == "int8")
+    print("quantized args holding int8 data: %d/%d" % (n_int8, len(qarg)))
+
+    ctx = mx.context.current_context()
+    fexe = sym.simple_bind(ctx, grad_req="null", data=(b, 3, hw, hw))
+    fexe.copy_params_from(arg_params, aux_params)
+    qexe = qsym.simple_bind(ctx, grad_req="null", data=(b, 3, hw, hw))
+    qexe.copy_params_from(qarg, qaux, allow_extra_params=True)
+
+    agree = total = 0
+    t_f = t_q = 0.0
+    for _ in range(args.num_batches):
+        x = nd_array(rng.randn(b, 3, hw, hw).astype(np.float32))
+        t0 = time.time()
+        fexe.forward(is_train=False, data=x)
+        p_f = fexe.outputs[0].asnumpy()
+        t_f += time.time() - t0
+        t0 = time.time()
+        qexe.forward(is_train=False, data=x)
+        p_q = qexe.outputs[0].asnumpy()
+        t_q += time.time() - t0
+        agree += (p_f.argmax(1) == p_q.argmax(1)).sum()
+        total += b
+    print("fp32: %.1f img/s   int8: %.1f img/s"
+          % (total / t_f, total / t_q))
+    print("top-1 agreement int8 vs fp32: %.3f" % (agree / total))
+
+
+if __name__ == "__main__":
+    main()
