@@ -521,9 +521,11 @@ using namespace mxnet_tpu_cpp;  // NOLINT
 
 int main() {
   Symbol data = Symbol::Variable("data");
-  Symbol fc = Symbol::Atomic("FullyConnected",
-                             {{"num_hidden", "4"}}, "fc");
-  fc.Compose({{"data", &data}});
+  Symbol w = Symbol::Variable("fc_weight");
+  // generated symbolic wrapper (op::sym namespace); the optional bias
+  // input stays a free auto-variable
+  Symbol fc = op::sym::FullyConnected(data, w,
+                                      {{"num_hidden", "4"}}, "fc");
   auto args = fc.ListArguments();
   if (args.size() != 3) { std::printf("BAD ARGS\n"); return 1; }
   NDArray x({2, 6});
