@@ -79,7 +79,6 @@ def test_train_gate_rejects_above_peak(bank):
 
     with pytest.raises(RuntimeError, match="implausible"):
         # claim 10^12 img/s: MFU gate must refuse to bank
-        bank._measure_train.__wrapped__ if False else None
         import time as _time
         real_time = _time.time
         ticks = iter([0.0, 0.0, 1e-9])
