@@ -516,6 +516,11 @@ def symbol_create_atomic(op_name, keys, vals, name):
     """An op symbol with its inputs left as free (auto) variables;
     Compose wires them (the reference's two-phase graph building)."""
     from . import symbol as _sym_ns
+    # only REGISTERED operators resolve — module-level helpers on the
+    # symbol namespace (load, Group, var, ...) must not be reachable
+    # through the C ABI's op entry point
+    if op_name not in _reg.list_ops():
+        raise MXNetError("no symbolic operator %r" % op_name)
     fn = getattr(_sym_ns, op_name, None)
     if fn is None or not callable(fn):
         raise MXNetError("no symbolic operator %r" % op_name)

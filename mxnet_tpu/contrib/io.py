@@ -48,8 +48,5 @@ class DataLoaderIter(DataIter):
             data, label = self._pending
             self._pending = None
         else:
-            try:
-                data, label = self._as_pair(next(self._iter))
-            except StopIteration:
-                raise
+            data, label = self._as_pair(next(self._iter))
         return DataBatch(data=[data], label=[label], pad=0)

@@ -87,7 +87,9 @@ class PythonModule(BaseModule):
         self.optimizer_initialized = True
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        if self._spec["label"]:
+        # gate on BOUND label shapes (a module bound without labels —
+        # scoring mode — must no-op, reference contract)
+        if self._shape_table["label"]:
             eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -100,8 +102,15 @@ class PythonModule(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         self._shape_table["data"] = _descs(data_shapes)
         self._shape_table["label"] = _descs(label_shapes)
+        # binded flips early so _compute_output_shapes can read the
+        # shape properties, but a failure there must not leave the
+        # module stuck in the bound state
         self.binded = True
-        self._shape_table["output"] = self._compute_output_shapes()
+        try:
+            self._shape_table["output"] = self._compute_output_shapes()
+        except Exception:
+            self.binded = False
+            raise
 
     def _compute_output_shapes(self):
         raise NotImplementedError()
