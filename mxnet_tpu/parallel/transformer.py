@@ -43,11 +43,25 @@ __all__ = ["TransformerConfig", "init_transformer_params",
 AXES = ("dp", "sp", "tp", "pp", "ep")
 
 
+def _kv_heads(cfg):
+    return cfg.n_kv_heads or cfg.n_heads
+
+
+def _expand_kv(t, groups, head_axis):
+    """Repeat each K/V head ``groups`` times along ``head_axis`` so
+    grouped K/V line up with the query heads (GQA -> MHA view)."""
+    return t if groups == 1 else jnp.repeat(t, groups, axis=head_axis)
+
+
 @dataclass
 class TransformerConfig:
     vocab_size: int = 256
     d_model: int = 64
     n_heads: int = 4
+    # grouped-query attention: number of shared K/V heads (None = MHA).
+    # Shrinks the KV cache by n_heads/n_kv_heads — the long-context
+    # decode memory lever (n_kv_heads=1 is multi-query attention).
+    n_kv_heads: int = None
     n_layers: int = 4
     d_ff: int = 256
     max_len: int = 512
@@ -109,8 +123,10 @@ def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
         "ln1_b": jnp.zeros((pp, lps, d), cfg.dtype),
         "ln2_g": jnp.ones((pp, lps, d), cfg.dtype),
         "ln2_b": jnp.zeros((pp, lps, d), cfg.dtype),
-        "wq": rand(pp, lps, d, d), "wk": rand(pp, lps, d, d),
-        "wv": rand(pp, lps, d, d), "wo": rand(pp, lps, d, d),
+        "wq": rand(pp, lps, d, d),
+        "wk": rand(pp, lps, d, _kv_heads(cfg) * (d // cfg.n_heads)),
+        "wv": rand(pp, lps, d, _kv_heads(cfg) * (d // cfg.n_heads)),
+        "wo": rand(pp, lps, d, d),
     }
     if cfg.num_experts:
         layers["gate"] = rand(pp, lps, d, cfg.num_experts)
@@ -155,23 +171,29 @@ def _ln(x, g, b, eps=1e-5):
 
 
 def _attention_local(lp, x, cfg, heads_local):
-    """x: (B_l, S_l, d) -> (B_l, S_l, d) partial over tp (pre-psum)."""
+    """x: (B_l, S_l, d) -> (B_l, S_l, d) partial over tp (pre-psum).
+    With GQA the K/V projections carry n_kv_heads/tp local heads,
+    expanded to the query head count before the attention kernel."""
     b, s, d = x.shape
     hd = d // cfg.n_heads
+    kv_local = heads_local * _kv_heads(cfg) // cfg.n_heads
     q = x @ lp["wq"]                                      # (b, s, d_tp)
     k = x @ lp["wk"]
     v = x @ lp["wv"]
 
-    def split(t):
-        return t.reshape(b, s, heads_local, hd).transpose(0, 2, 1, 3)
+    def split(t, nh=heads_local):
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    def split_kv(t):
+        return _expand_kv(split(t, kv_local), heads_local // kv_local, 1)
 
     if cfg.sp_attn == "ulysses":
         from .ulysses import _ulysses_local
-        o = _ulysses_local(split(q), split(k), split(v), "sp",
+        o = _ulysses_local(split(q), split_kv(k), split_kv(v), "sp",
                            causal=True, sm_scale=1.0 / np.sqrt(hd),
                            impl="auto", interpret=None)
     else:
-        o = _ring_attention_local(split(q), split(k), split(v), "sp",
+        o = _ring_attention_local(split(q), split_kv(k), split_kv(v), "sp",
                                   causal=True, sm_scale=1.0 / np.sqrt(hd))
     o = o.transpose(0, 2, 1, 3).reshape(b, s, heads_local * hd)
     return o @ lp["wo"]                                   # partial (b, s, d)
@@ -346,6 +368,14 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         if ax not in mesh.axis_names:
             raise ValueError("mesh is missing axis %r" % ax)
     mesh_shape = {a: mesh.shape[a] for a in AXES}
+    if cfg.n_heads % _kv_heads(cfg):
+        raise ValueError("n_heads=%d must divide by n_kv_heads=%d"
+                         % (cfg.n_heads, _kv_heads(cfg)))
+    if _kv_heads(cfg) % mesh_shape["tp"]:
+        raise ValueError(
+            "GQA: n_kv_heads=%d must divide by tp=%d (K/V projections "
+            "are tp-sharded on the head dim)"
+            % (_kv_heads(cfg), mesh_shape["tp"]))
     if cfg.sp_attn == "ulysses":
         heads_local = cfg.n_heads // mesh_shape["tp"]
         if heads_local % mesh_shape["sp"]:
@@ -382,14 +412,17 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
     layers = params["layers"]
     pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
     hd = cfg.d_model // cfg.n_heads
+    groups = cfg.n_heads // _kv_heads(cfg)
     for st in range(pp):
         for li in range(lps):
             lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
             h = _ln(x, lp["ln1_g"], lp["ln1_b"])
             b, s, d = h.shape
             q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
-            k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, hd)
-            v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, hd)
+            k = _expand_kv((h @ lp["wk"]).reshape(b, s, _kv_heads(cfg),
+                                                  hd), groups, 2)
+            v = _expand_kv((h @ lp["wv"]).reshape(b, s, _kv_heads(cfg),
+                                                  hd), groups, 2)
             sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             mask = jnp.tril(jnp.ones((s, s), bool))
             sc = jnp.where(mask, sc, -1e30)
@@ -425,12 +458,14 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
 # ---------------------------------------------------------------------------
 
 def init_kv_cache(cfg: TransformerConfig, batch, max_len=None):
-    """Zeroed K/V cache: dict of (pp, lps, b, heads, max_len, hd)."""
+    """Zeroed K/V cache: dict of (layers, b, KV heads, max_len, hd) —
+    GQA stores only the shared heads, an n_heads/n_kv_heads memory
+    saving at long context."""
     max_len = max_len or cfg.max_len
     hd = cfg.d_model // cfg.n_heads
     # layer stacking mirrors the params layout (pp, lps, ...)
     n_l = cfg.n_layers
-    shape = (n_l, batch, cfg.n_heads, max_len, hd)
+    shape = (n_l, batch, _kv_heads(cfg), max_len, hd)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -458,8 +493,8 @@ def transformer_decode_step(params, cache, tokens_t, pos,
             lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
             h = _ln(x, lp["ln1_g"], lp["ln1_b"])
             q = (h @ lp["wq"]).reshape(b, cfg.n_heads, hd)
-            k_t = (h @ lp["wk"]).reshape(b, cfg.n_heads, hd)
-            v_t = (h @ lp["wv"]).reshape(b, cfg.n_heads, hd)
+            k_t = (h @ lp["wk"]).reshape(b, _kv_heads(cfg), hd)
+            v_t = (h @ lp["wv"]).reshape(b, _kv_heads(cfg), hd)
             # write this step's K/V at [li_flat, :, :, pos]
             cache = {
                 "k": cache["k"].at[li_flat, :, :, pos].set(
@@ -467,8 +502,9 @@ def transformer_decode_step(params, cache, tokens_t, pos,
                 "v": cache["v"].at[li_flat, :, :, pos].set(
                     v_t.astype(cache["v"].dtype)),
             }
-            kc = cache["k"][li_flat]                  # (b, h, max_len, hd)
-            vc = cache["v"][li_flat]
+            groups = cfg.n_heads // _kv_heads(cfg)
+            kc = _expand_kv(cache["k"][li_flat], groups, 1)
+            vc = _expand_kv(cache["v"][li_flat], groups, 1)
             sc = jnp.einsum("bhd,bhkd->bhk", q, kc) / np.sqrt(hd)
             sc = jnp.where(visible, sc, -1e30)
             o = jnp.einsum("bhk,bhkd->bhd", jax.nn.softmax(sc, -1), vc)
@@ -512,15 +548,18 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
             lp = jax.tree_util.tree_map(lambda p: p[st, li], layers)
             h = _ln(x, lp["ln1_g"], lp["ln1_b"])
             q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
-            k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, hd)
-            v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, hd)
-            # (b, s, h, d) -> cache layout (b, h, s, d), written at [:s]
+            kg = (h @ lp["wk"]).reshape(b, s, _kv_heads(cfg), hd)
+            vg = (h @ lp["wv"]).reshape(b, s, _kv_heads(cfg), hd)
+            # (b, s, hk, d) -> cache layout (b, hk, s, d), written [:s]
             cache = {
                 "k": cache["k"].at[li_flat, :, :, :s].set(
-                    k.transpose(0, 2, 1, 3).astype(cache["k"].dtype)),
+                    kg.transpose(0, 2, 1, 3).astype(cache["k"].dtype)),
                 "v": cache["v"].at[li_flat, :, :, :s].set(
-                    v.transpose(0, 2, 1, 3).astype(cache["v"].dtype)),
+                    vg.transpose(0, 2, 1, 3).astype(cache["v"].dtype)),
             }
+            groups = cfg.n_heads // _kv_heads(cfg)
+            k = _expand_kv(kg, groups, 2)
+            v = _expand_kv(vg, groups, 2)
             sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             sc = jnp.where(mask[None, None], sc, -1e30)
             o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
@@ -557,6 +596,7 @@ _GENERATE_CACHE = {}
 
 def _generate_program(cfg: TransformerConfig, b, s, steps, max_len):
     key = (id(type(cfg)), cfg.vocab_size, cfg.d_model, cfg.n_heads,
+           _kv_heads(cfg),
            cfg.n_layers, cfg.d_ff, cfg.num_experts, cfg.moe_top_k,
            cfg.capacity_factor, str(cfg.dtype), b, s, steps, max_len)
     fn = _GENERATE_CACHE.get(key)

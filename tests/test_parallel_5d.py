@@ -299,3 +299,70 @@ def test_transformer_lm_example_cli_with_generation():
         capture_output=True, text=True, timeout=420, env=env, cwd=root)
     assert r.returncode == 0, r.stderr[-1500:]
     assert "generated 8 tokens" in r.stdout, r.stdout
+
+
+def test_gqa_decode_matches_full_forward_and_shrinks_cache():
+    """Grouped-query attention: cached decode equals the full causal
+    forward, and the KV cache holds only n_kv_heads heads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.transformer import (
+        TransformerConfig, init_transformer_params, init_kv_cache,
+        transformer_decode_step, transformer_forward_single,
+        transformer_generate)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=8,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_len=16)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh, seed=5)
+    assert params["layers"]["wk"].shape[-1] == 2 * (32 // 8)
+
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 6)), jnp.int32)
+    full = transformer_forward_single(params, tokens, cfg)
+    cache = init_kv_cache(cfg, 2, max_len=16)
+    assert cache["k"].shape == (2, 2, 2, 16, 4)   # (L, b, KV heads, T, hd)
+    for t in range(6):
+        logits, cache = transformer_decode_step(
+            params, cache, tokens[:, t], t, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, t]), rtol=2e-4,
+                                   atol=2e-4)
+    gen = transformer_generate(params, tokens[:, :3], steps=2, cfg=cfg)
+    assert gen.shape == (2, 2)
+
+
+def test_gqa_train_step_tp_sharded():
+    """GQA trains under tensor parallelism when tp divides n_kv_heads;
+    an indivisible layout raises a clear error."""
+    import jax
+    import numpy as np
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.transformer import (
+        TransformerConfig, init_transformer_params,
+        make_transformer_train_step)
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=8,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_len=32)
+    mesh = make_mesh((2, 1, 2, 1, 1),
+                     axis_names=("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh, seed=0)
+    step = make_transformer_train_step(cfg, mesh, lr=0.05)
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 32, (4, 16)).astype(np.int32)
+    tgt = rng.randint(0, 32, (4, 16)).astype(np.int32)
+    params, l1 = step(params, tok, tgt)
+    params, l2 = step(params, tok, tgt)
+    assert float(l2) < float(l1)
+
+    import pytest as _pytest
+    bad = TransformerConfig(vocab_size=32, d_model=32, n_heads=8,
+                            n_kv_heads=1, n_layers=2, d_ff=64,
+                            max_len=32)
+    with _pytest.raises(ValueError, match="n_kv_heads"):
+        make_transformer_train_step(bad, mesh, lr=0.05)
