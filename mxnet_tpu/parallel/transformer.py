@@ -53,6 +53,22 @@ def _expand_kv(t, groups, head_axis):
     return t if groups == 1 else jnp.repeat(t, groups, axis=head_axis)
 
 
+def _rope(t, positions, base):
+    """Rotary position embedding over the trailing head_dim: pairs
+    (even, odd) rotate by position-scaled angles. t: (..., S, hd) with
+    positions (S,) broadcastable against the seq axis."""
+    hd = t.shape[-1]
+    half = hd // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[..., :, None].astype(jnp.float32) * freqs    # (S, half)
+    cos = jnp.cos(ang).astype(t.dtype)
+    sin = jnp.sin(ang).astype(t.dtype)
+    t1 = t[..., :half]
+    t2 = t[..., half:]
+    return jnp.concatenate([t1 * cos - t2 * sin,
+                            t1 * sin + t2 * cos], axis=-1)
+
+
 @dataclass
 class TransformerConfig:
     vocab_size: int = 256
@@ -71,6 +87,11 @@ class TransformerConfig:
     dtype: object = jnp.float32
     sp_attn: str = "ring"         # "ring" (ppermute) | "ulysses" (a2a)
     remat: bool = False           # jax.checkpoint each block (long-seq)
+    # position encoding: "learned" adds a trained table; "rope" rotates
+    # q/k per head-dim pair (no length-bound table — the long-context
+    # default; extrapolates past training length)
+    pos_type: str = "learned"
+    rope_base: float = 10000.0
 
 
 # ---------------------------------------------------------------------------
@@ -187,13 +208,21 @@ def _attention_local(lp, x, cfg, heads_local):
     def split_kv(t):
         return _expand_kv(split(t, kv_local), heads_local // kv_local, 1)
 
+    qh, kh, vh = split(q), split_kv(k), split_kv(v)
+    if cfg.pos_type == "rope":
+        # absolute positions of this sequence shard (ring/Ulysses move
+        # K/V AFTER projection, so rotating here is globally correct)
+        pos = jax.lax.axis_index("sp") * s + jnp.arange(s)
+        qh = _rope(qh, pos, cfg.rope_base)
+        kh = _rope(kh, pos, cfg.rope_base)
+
     if cfg.sp_attn == "ulysses":
         from .ulysses import _ulysses_local
-        o = _ulysses_local(split(q), split_kv(k), split_kv(v), "sp",
+        o = _ulysses_local(qh, kh, vh, "sp",
                            causal=True, sm_scale=1.0 / np.sqrt(hd),
                            impl="auto", interpret=None)
     else:
-        o = _ring_attention_local(split(q), split_kv(k), split_kv(v), "sp",
+        o = _ring_attention_local(qh, kh, vh, "sp",
                                   causal=True, sm_scale=1.0 / np.sqrt(hd))
     o = o.transpose(0, 2, 1, 3).reshape(b, s, heads_local * hd)
     return o @ lp["wo"]                                   # partial (b, s, d)
@@ -333,7 +362,9 @@ def _lm_local_loss(params, tokens, targets, cfg, mesh_shape,
     pos0 = sp_i * s_loc
 
     x = params["embed"][tokens]                       # (b, s_loc, d)
-    x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos0, s_loc, 0)
+    if cfg.pos_type == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos0,
+                                             s_loc, 0)
 
     # tp shard the head/ffn dims of the layer stacks locally: shard_map
     # already sliced them via in_specs; layers leaves arrive local.
@@ -408,7 +439,8 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
     """Single-device reference forward (used by tests to validate the
     sharded step; also the flagship single-chip inference path)."""
     x = params["embed"][tokens]
-    x = x + params["pos"][: tokens.shape[1]]
+    if cfg.pos_type == "learned":
+        x = x + params["pos"][: tokens.shape[1]]
     layers = params["layers"]
     pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
     hd = cfg.d_model // cfg.n_heads
@@ -423,6 +455,13 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
                                                   hd), groups, 2)
             v = _expand_kv((h @ lp["wv"]).reshape(b, s, _kv_heads(cfg),
                                                   hd), groups, 2)
+            if cfg.pos_type == "rope":
+                pos = jnp.arange(s)
+                # heads sit on axis 2 here; rope acts on (S, hd) pairs
+                q = _rope(q.transpose(0, 2, 1, 3), pos,
+                          cfg.rope_base).transpose(0, 2, 1, 3)
+                k = _rope(k.transpose(0, 2, 1, 3), pos,
+                          cfg.rope_base).transpose(0, 2, 1, 3)
             sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             mask = jnp.tril(jnp.ones((s, s), bool))
             sc = jnp.where(mask, sc, -1e30)
@@ -483,8 +522,9 @@ def transformer_decode_step(params, cache, tokens_t, pos,
     max_len = cache["k"].shape[3]
 
     x = params["embed"][tokens_t]                     # (b, d)
-    x = x + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
-                                         keepdims=False)
+    if cfg.pos_type == "learned":
+        x = x + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
+                                             keepdims=False)
     kpos = jnp.arange(max_len)
     visible = (kpos <= pos)[None, None, :]            # (1, 1, max_len)
     li_flat = 0
@@ -495,6 +535,11 @@ def transformer_decode_step(params, cache, tokens_t, pos,
             q = (h @ lp["wq"]).reshape(b, cfg.n_heads, hd)
             k_t = (h @ lp["wk"]).reshape(b, _kv_heads(cfg), hd)
             v_t = (h @ lp["wv"]).reshape(b, _kv_heads(cfg), hd)
+            if cfg.pos_type == "rope":
+                p1 = jnp.asarray(pos)[None]
+                q = _rope(q[..., None, :], p1, cfg.rope_base)[..., 0, :]
+                k_t = _rope(k_t[..., None, :], p1,
+                            cfg.rope_base)[..., 0, :]
             # write this step's K/V at [li_flat, :, :, pos]
             cache = {
                 "k": cache["k"].at[li_flat, :, :, pos].set(
@@ -540,7 +585,9 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
     pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
     hd = cfg.d_model // cfg.n_heads
 
-    x = params["embed"][tokens] + params["pos"][:s]
+    x = params["embed"][tokens]
+    if cfg.pos_type == "learned":
+        x = x + params["pos"][:s]
     mask = jnp.tril(jnp.ones((s, s), bool))
     li_flat = 0
     for st in range(pp):
@@ -550,6 +597,14 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
             q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
             kg = (h @ lp["wk"]).reshape(b, s, _kv_heads(cfg), hd)
             vg = (h @ lp["wv"]).reshape(b, s, _kv_heads(cfg), hd)
+            if cfg.pos_type == "rope":
+                # rotate BEFORE caching: decode stores rotated keys, so
+                # prefill must too (q rotates here as well)
+                pos = jnp.arange(s)
+                q = _rope(q.transpose(0, 2, 1, 3), pos,
+                          cfg.rope_base).transpose(0, 2, 1, 3)
+                kg = _rope(kg.transpose(0, 2, 1, 3), pos,
+                           cfg.rope_base).transpose(0, 2, 1, 3)
             # (b, s, hk, d) -> cache layout (b, hk, s, d), written [:s]
             cache = {
                 "k": cache["k"].at[li_flat, :, :, :s].set(
@@ -596,7 +651,7 @@ _GENERATE_CACHE = {}
 
 def _generate_program(cfg: TransformerConfig, b, s, steps, max_len):
     key = (id(type(cfg)), cfg.vocab_size, cfg.d_model, cfg.n_heads,
-           _kv_heads(cfg),
+           _kv_heads(cfg), cfg.pos_type, cfg.rope_base,
            cfg.n_layers, cfg.d_ff, cfg.num_experts, cfg.moe_top_k,
            cfg.capacity_factor, str(cfg.dtype), b, s, steps, max_len)
     fn = _GENERATE_CACHE.get(key)
