@@ -649,26 +649,41 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
 _GENERATE_CACHE = {}
 
 
-def _generate_program(cfg: TransformerConfig, b, s, steps, max_len):
+def _pick_token(logits, rng_t, temperature, top_k):
+    """Next-token rule: greedy at temperature 0, else (top-k filtered)
+    categorical sampling. Static branch — part of the compiled scan."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    return jax.random.categorical(rng_t, scaled, axis=-1).astype(jnp.int32)
+
+
+def _generate_program(cfg: TransformerConfig, b, s, steps, max_len,
+                      temperature, top_k):
     key = (id(type(cfg)), cfg.vocab_size, cfg.d_model, cfg.n_heads,
            _kv_heads(cfg), cfg.pos_type, cfg.rope_base,
            cfg.n_layers, cfg.d_ff, cfg.num_experts, cfg.moe_top_k,
-           cfg.capacity_factor, str(cfg.dtype), b, s, steps, max_len)
+           cfg.capacity_factor, str(cfg.dtype), b, s, steps, max_len,
+           temperature, top_k)
     fn = _GENERATE_CACHE.get(key)
     if fn is not None:
         return fn
 
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng):
         cache = init_kv_cache(cfg, b, max_len)
         logits, cache = transformer_prefill(params, prompt, cache, cfg)
-        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok0 = _pick_token(logits, rng, temperature, top_k)
 
         def body(carry, t):
             cache, tok = carry
             logits, cache = transformer_decode_step(
                 params, cache, tok, s + t, cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = _pick_token(logits, jax.random.fold_in(rng, t),
+                              temperature, top_k)
             return (cache, nxt), tok
 
         (_, _), toks = jax.lax.scan(
@@ -680,12 +695,16 @@ def _generate_program(cfg: TransformerConfig, b, s, steps, max_len):
 
 
 def transformer_generate(params, prompt, steps, cfg: TransformerConfig,
-                         max_len=None):
-    """Greedy generation: prompt (b, s) int32 -> (b, steps) int32.
-    Prefill (one batched causal forward) + decode run as ONE jitted
-    program, compiled once per (config, shape) and cached; per-token
-    decode cost is O(1) in generated length (KV cache, static shapes)."""
+                         max_len=None, temperature=0.0, top_k=0, seed=0):
+    """Generation: prompt (b, s) int32 -> (b, steps) int32. Greedy by
+    default; ``temperature>0`` samples (optionally top-k filtered) from
+    a fold_in-derived per-step PRNG stream. Prefill (one batched causal
+    forward) + decode run as ONE jitted program, compiled once per
+    (config, shape, decode rule) and cached; per-token decode cost is
+    O(1) in generated length (KV cache, static shapes)."""
     b, s = prompt.shape
     max_len = max_len or cfg.max_len
     assert s + steps <= max_len, "prompt + steps exceeds max_len"
-    return _generate_program(cfg, b, s, steps, max_len)(params, prompt)
+    fn = _generate_program(cfg, b, s, steps, max_len,
+                           float(temperature), int(top_k))
+    return fn(params, prompt, jax.random.PRNGKey(seed))

@@ -412,3 +412,40 @@ def test_rope_decode_matches_full_forward():
     want = -np.take_along_axis(np.asarray(logp),
                                np.asarray(tgt)[..., None], -1).mean()
     np.testing.assert_allclose(float(loss), want, rtol=2e-3)
+
+
+def test_generate_sampling_modes():
+    """temperature/top_k decode rules: greedy default unchanged;
+    sampling is deterministic per seed, varies across seeds, and top-k
+    restricts to high-probability tokens."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.transformer import (
+        TransformerConfig, init_transformer_params, transformer_generate)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_len=24)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh, seed=7)
+    rng = np.random.RandomState(3)
+    prompt = jnp.asarray(rng.randint(0, 64, (2, 6)), jnp.int32)
+
+    g1 = transformer_generate(params, prompt, 6, cfg)
+    g2 = transformer_generate(params, prompt, 6, cfg)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+    s1 = transformer_generate(params, prompt, 6, cfg, temperature=1.0,
+                              seed=1)
+    s2 = transformer_generate(params, prompt, 6, cfg, temperature=1.0,
+                              seed=1)
+    s3 = transformer_generate(params, prompt, 6, cfg, temperature=1.0,
+                              seed=9)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s3))
+
+    t1 = transformer_generate(params, prompt, 6, cfg, temperature=1.0,
+                              top_k=1, seed=4)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(g1))
