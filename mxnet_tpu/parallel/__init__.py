@@ -11,3 +11,8 @@ from .mesh import make_mesh, current_mesh, set_mesh, data_parallel_sharding
 from .trainer import make_train_step, ShardedTrainer
 from .ring_attention import ring_attention, ring_self_attention
 from .ulysses import ulysses_attention, ulysses_self_attention
+from .transformer import (TransformerConfig, init_transformer_params,
+                          make_transformer_train_step,
+                          transformer_forward_single, init_kv_cache,
+                          transformer_decode_step, transformer_prefill,
+                          transformer_generate)
