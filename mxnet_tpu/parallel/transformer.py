@@ -194,7 +194,12 @@ def _ln(x, g, b, eps=1e-5):
 def _attention_local(lp, x, cfg, heads_local):
     """x: (B_l, S_l, d) -> (B_l, S_l, d) partial over tp (pre-psum).
     With GQA the K/V projections carry n_kv_heads/tp local heads,
-    expanded to the query head count before the attention kernel."""
+    expanded to the query head count before the attention kernel.
+
+    Note: expansion happens before the sp exchange, so ring/Ulysses
+    move the EXPANDED tensors — correct, but GQA's ICI saving
+    (rotating grouped K/V and expanding per chunk) is left on the
+    table; revisit if sp-sharded GQA training becomes a hot path."""
     b, s, d = x.shape
     hd = d // cfg.n_heads
     kv_local = heads_local * _kv_heads(cfg) // cfg.n_heads
