@@ -53,6 +53,24 @@ def _expand_kv(t, groups, head_axis):
     return t if groups == 1 else jnp.repeat(t, groups, axis=head_axis)
 
 
+def _validate_heads(cfg):
+    kvh = cfg.n_kv_heads
+    if kvh is not None:
+        if not isinstance(kvh, int) or kvh < 1:
+            raise ValueError("n_kv_heads must be a positive int, got %r"
+                             % (kvh,))
+        if cfg.n_heads % kvh:
+            raise ValueError("n_heads=%d must divide by n_kv_heads=%d"
+                             % (cfg.n_heads, kvh))
+
+
+def _rope_bshd(t, positions, base):
+    """RoPE for (b, s, h, hd) tensors: move heads out, rotate, move
+    back — the one place the layout convention lives."""
+    return _rope(t.transpose(0, 2, 1, 3), positions,
+                 base).transpose(0, 2, 1, 3)
+
+
 def _rope(t, positions, base):
     """Rotary position embedding over the trailing head_dim: pairs
     (even, odd) rotate by position-scaled angles. t: (..., S, hd) with
@@ -115,12 +133,14 @@ def _param_specs(cfg, pp):
     else:
         lyr.update({"w1": P("pp", None, None, "tp"),
                     "w2": P("pp", None, "tp", None)})
-    return {
+    specs = {
         "embed": P(None, None),
-        "pos": P(None, None),
         "lnf_g": P(None,), "lnf_b": P(None,),
         "layers": lyr,
     }
+    if cfg.pos_type == "learned":
+        specs["pos"] = P(None, None)
+    return specs
 
 
 def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
@@ -129,6 +149,7 @@ def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
     Layer stacks have shape (pp, layers_per_stage, ...) so the leading
     axis shards over pipeline stages.
     """
+    _validate_heads(cfg)
     pp = mesh.shape.get("pp", 1)
     assert cfg.n_layers % pp == 0, "n_layers must divide pp"
     lps = cfg.n_layers // pp
@@ -158,11 +179,13 @@ def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
         layers["w2"] = rand(pp, lps, f, d)
     params = {
         "embed": rand(V, d),
-        "pos": rand(cfg.max_len, d),
         "lnf_g": jnp.ones((d,), cfg.dtype),
         "lnf_b": jnp.zeros((d,), cfg.dtype),
         "layers": layers,
     }
+    if cfg.pos_type == "learned":
+        # rope has no length-bound table; don't allocate/shard/update one
+        params["pos"] = rand(cfg.max_len, d)
     specs = _param_specs(cfg, pp)
     shard = {k: (jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp),
                                         specs[k])
@@ -404,9 +427,7 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         if ax not in mesh.axis_names:
             raise ValueError("mesh is missing axis %r" % ax)
     mesh_shape = {a: mesh.shape[a] for a in AXES}
-    if cfg.n_heads % _kv_heads(cfg):
-        raise ValueError("n_heads=%d must divide by n_kv_heads=%d"
-                         % (cfg.n_heads, _kv_heads(cfg)))
+    _validate_heads(cfg)
     if _kv_heads(cfg) % mesh_shape["tp"]:
         raise ValueError(
             "GQA: n_kv_heads=%d must divide by tp=%d (K/V projections "
@@ -462,11 +483,8 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
                                                   hd), groups, 2)
             if cfg.pos_type == "rope":
                 pos = jnp.arange(s)
-                # heads sit on axis 2 here; rope acts on (S, hd) pairs
-                q = _rope(q.transpose(0, 2, 1, 3), pos,
-                          cfg.rope_base).transpose(0, 2, 1, 3)
-                k = _rope(k.transpose(0, 2, 1, 3), pos,
-                          cfg.rope_base).transpose(0, 2, 1, 3)
+                q = _rope_bshd(q, pos, cfg.rope_base)
+                k = _rope_bshd(k, pos, cfg.rope_base)
             sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
             mask = jnp.tril(jnp.ones((s, s), bool))
             sc = jnp.where(mask, sc, -1e30)
@@ -552,12 +570,16 @@ def transformer_decode_step(params, cache, tokens_t, pos,
                 "v": cache["v"].at[li_flat, :, :, pos].set(
                     v_t.astype(cache["v"].dtype)),
             }
+            # grouped attention straight against the compact cache —
+            # expanding it per step would materialize the very tensor
+            # GQA exists to avoid
             groups = cfg.n_heads // _kv_heads(cfg)
-            kc = _expand_kv(cache["k"][li_flat], groups, 1)
-            vc = _expand_kv(cache["v"][li_flat], groups, 1)
-            sc = jnp.einsum("bhd,bhkd->bhk", q, kc) / np.sqrt(hd)
-            sc = jnp.where(visible, sc, -1e30)
-            o = jnp.einsum("bhk,bhkd->bhd", jax.nn.softmax(sc, -1), vc)
+            qg = q.reshape(b, _kv_heads(cfg), groups, hd)
+            kc = cache["k"][li_flat]              # (b, hk, max_len, hd)
+            vc = cache["v"][li_flat]
+            sc = jnp.einsum("bkgd,bkld->bkgl", qg, kc) / np.sqrt(hd)
+            sc = jnp.where(visible[:, :, None, :], sc, -1e30)
+            o = jnp.einsum("bkgl,bkld->bkgd", jax.nn.softmax(sc, -1), vc)
             x = x + o.reshape(b, cfg.d_model) @ lp["wo"]
             h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
             if cfg.num_experts:
@@ -606,10 +628,8 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
                 # rotate BEFORE caching: decode stores rotated keys, so
                 # prefill must too (q rotates here as well)
                 pos = jnp.arange(s)
-                q = _rope(q.transpose(0, 2, 1, 3), pos,
-                          cfg.rope_base).transpose(0, 2, 1, 3)
-                kg = _rope(kg.transpose(0, 2, 1, 3), pos,
-                           cfg.rope_base).transpose(0, 2, 1, 3)
+                q = _rope_bshd(q, pos, cfg.rope_base)
+                kg = _rope_bshd(kg, pos, cfg.rope_base)
             # (b, s, hk, d) -> cache layout (b, hk, s, d), written [:s]
             cache = {
                 "k": cache["k"].at[li_flat, :, :, :s].set(
