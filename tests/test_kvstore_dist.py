@@ -342,3 +342,31 @@ def test_launch_ssh_two_workers(tmp_path):
         "fake-ssh to nodeB" in r.stderr, r.stderr
     # two workers completed (lines may interleave on a shared pipe)
     assert r.stdout.count("SUM 3.0") == 2, r.stdout
+
+
+def test_kill_mxnet_tool(tmp_path):
+    """tools/kill_mxnet.py (reference kill-mxnet.py analog) finds and
+    terminates a stray PS server without touching itself."""
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = ("import time\n"
+            "from mxnet_tpu.kvstore_server import KVStoreServer\n"
+            "s = KVStoreServer(port=0, num_workers=1)\n"
+            "s.start_background()\n"
+            "time.sleep(120)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            cwd=repo)
+    try:
+        time.sleep(2)
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "kill_mxnet.py"),
+             "--pattern", "kvstore_server"],
+            capture_output=True, text=True, timeout=60, cwd=repo)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "killing pid %d" % proc.pid in r.stdout, r.stdout
+        proc.wait(timeout=15)
+        assert proc.returncode is not None
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes, locally or over ssh.
+
+Capability analog of the reference's ``tools/kill-mxnet.py`` (which
+pdsh-kills python jobs on every host in a hostfile): finds processes
+whose command line mentions the target script or the MXNET_TPU PS
+contract, and terminates them. The invoking process (and its parents)
+are never touched — a naive ``pkill -f`` matches its own command line.
+
+    python tools/kill_mxnet.py                      # this host, default pattern
+    python tools/kill_mxnet.py --pattern train.py   # custom match
+    python tools/kill_mxnet.py --hostfile hosts.txt # over ssh too
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+DEFAULT_PATTERNS = ("mxnet_tpu.kvstore_server", "kv-store dist",
+                    "MXNET_TPU_ROLE")
+
+
+def _candidates(patterns):
+    """(pid, cmdline) of matching processes, excluding self+ancestors."""
+    skip = set()
+    pid = os.getpid()
+    while pid > 1:
+        skip.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().split()[3])
+        except (OSError, ValueError, IndexError):
+            break
+    out = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) in skip:
+            continue
+        try:
+            with open("/proc/%s/cmdline" % entry, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+        except OSError:
+            continue
+        if any(p in cmd for p in patterns):
+            out.append((int(entry), cmd))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pattern", action="append", default=[],
+                    help="extra substring(s) to match (repeatable)")
+    ap.add_argument("--hostfile",
+                    help="also kill on every host listed (ssh)")
+    ap.add_argument("--ssh-port", type=int, default=22)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="list matches without killing")
+    args = ap.parse_args()
+
+    patterns = tuple(args.pattern) or DEFAULT_PATTERNS
+    n = 0
+    for pid, cmd in _candidates(patterns):
+        print("%s pid %d: %s" % ("would kill" if args.dry_run
+                                 else "killing", pid, cmd[:120]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError as e:
+                print("  failed: %s" % e, file=sys.stderr)
+                continue
+        n += 1
+    print("%d local process(es) matched" % n)
+
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f
+                     if ln.strip() and not ln.startswith("#")]
+        remote = "python %s %s %s" % (
+            os.path.abspath(__file__),
+            " ".join("--pattern %s" % p for p in patterns),
+            "--dry-run" if args.dry_run else "")
+        for host in hosts:
+            r = subprocess.run(
+                ["ssh", "-p", str(args.ssh_port),
+                 "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes",
+                 host, remote], capture_output=True, text=True)
+            tag = "ok" if r.returncode == 0 else "rc=%d" % r.returncode
+            print("[%s] %s %s" % (host, tag,
+                                  (r.stdout or r.stderr).strip()[:200]))
+
+
+if __name__ == "__main__":
+    main()
