@@ -24,7 +24,7 @@ code runs any slice of the 5D configuration.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
