@@ -126,3 +126,12 @@ def test_bench_headline_prefers_harness2(tmp_path, monkeypatch):
         "unverified"] is True
     monkeypatch.delenv("MXNET_TPU_BENCH_DIR")
     importlib.reload(B)
+
+
+def test_job_registry_consistency():
+    """Every daemon-priority job exists and every registered job is
+    scheduled — a missing entry silently never banks on hardware."""
+    import mxnet_tpu.benchmark as B
+    assert set(B.JOB_PRIORITY) == set(B.JOBS), (
+        sorted(set(B.JOB_PRIORITY) ^ set(B.JOBS)))
+    assert len(B.JOB_PRIORITY) == len(set(B.JOB_PRIORITY))
