@@ -613,6 +613,13 @@ class SymbolBlock(HybridBlock):
 
     def forward(self, *args):
         from .. import autograd, random as _random
+        from ..symbol.symbol import Symbol as _Sym
+        if args and isinstance(args[0], _Sym):
+            # export/_trace_symbol walking a composed net: substitute the
+            # caller's input symbols into the pre-built graph (parameter
+            # variables stay free)
+            return self._outputs_sym(
+                **dict(zip(self._input_names, args)))
         if not _in_cached_trace() and not _in_shape_probe():
             # always route through the CachedOp (a pre-built symbol IS a
             # graph — run it as one compiled program, with tape support)

@@ -410,7 +410,8 @@ def _lm_local_loss(params, tokens, targets, cfg, mesh_shape,
 
 
 def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
-                                lr=0.1, num_microbatches=None):
+                                lr=0.1, num_microbatches=None,
+                                device_loop=False):
     """Build ``step(params, tokens, targets) -> (params, loss)`` — one
     compiled SPMD program doing forward, backward, psum, SGD.
 
@@ -422,6 +423,10 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     mesh must carry all of ``("dp","sp","tp","pp","ep")`` (size 1 ok).
     tokens/targets: (batch, seq) int32, sharded (dp, sp).
+
+    ``device_loop=True`` returns ``loop(params, tokens, targets)`` over
+    STACKED (k, batch, seq) batches instead: k steps scanned on device
+    in one compiled program (one dispatch per k steps).
     """
     for ax in AXES:
         if ax not in mesh.axis_names:
@@ -457,7 +462,22 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
         return new, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    if not device_loop:
+        return jax.jit(step, donate_argnums=(0,))
+
+    def loop(params, tokens, targets):
+        """``k`` steps as one program: scan over stacked (k, b, s)
+        batches — one dispatch per k steps (the reference's engine
+        bulking, done the TPU way). Returns (params, last_loss)."""
+        def body(p, xs):
+            tok, tgt = xs
+            p, loss = step(p, tok, tgt)
+            return p, loss
+
+        params, losses = jax.lax.scan(body, params, (tokens, targets))
+        return params, losses[-1]
+
+    return jax.jit(loop, donate_argnums=(0,))
 
 
 
