@@ -224,6 +224,323 @@ int MXExecutorReshape(ExecutorHandle exec, uint32_t num_inputs,
                       NDArrayHandle* input_examples,
                       ExecutorHandle* out);
 
+/* ================= batch 5 =========================================
+ * CachedOp, autograd state, NDArray extras + sparse accessors, symbol
+ * breadth (graph walking, shape/type inference, creator registry),
+ * RecordIO, kvstore roles/updaters, data-iter extras, quantization,
+ * explicit-array executor bind, runtime misc.
+ *
+ * Deliberately absent (documented n/a, like the reference built without
+ * the backing subsystem): shared-memory NDArray interop (PjRt buffers
+ * are not process-shareable), MXRtcCuda* + MXRtc* (runtime kernels are
+ * Python Pallas, see mxnet_tpu/rtc.py), the legacy MXFunc* v1 op
+ * surface, C-side custom-op registration (custom ops are Python-first,
+ * mxnet_tpu/operator.py), MXCustomFunctionRecord, MXAutogradGetSymbol,
+ * MXSymbolCutSubgraph.
+ */
+
+/* ---- cached op (reference: MXCreateCachedOp, cached_op.cc) ------- */
+typedef void* CachedOpHandle;
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
+/* flags accepted for signature parity; the whole graph is always one
+ * compiled program here, so there is nothing to toggle */
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char** keys,
+                       const char** vals, CachedOpHandle* out);
+/* inputs = list_arguments + list_auxiliary_states, in order */
+int MXInvokeCachedOp(CachedOpHandle h, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs);
+/* *out_stypes: storage ids per output (always dense = 0 here) */
+int MXInvokeCachedOpEx(CachedOpHandle h, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes);
+int MXFreeCachedOp(CachedOpHandle h);
+
+/* ---- autograd state ---------------------------------------------- */
+int MXAutogradIsRecording(int* curr);
+int MXAutogradIsTraining(int* curr);
+int MXAutogradSetIsTraining(int is_training, int* prev);
+/* ograd_handles may be NULL (ones cotangents); when num_variables > 0
+ * the gradients of those variables are returned (ABI-owned array,
+ * valid until the next call on this thread) */
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles,
+                         uint32_t num_variables,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes);
+int MXAutogradComputeGradient(uint32_t num_output,
+                              NDArrayHandle* output_handles);
+
+/* ---- NDArray extras ---------------------------------------------- */
+int MXNDArrayCreateNone(NDArrayHandle* out);
+/* dev_type codes: 1 cpu, 2 gpu, 3 tpu; delay_alloc accepted for parity */
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayDetach(NDArrayHandle h, NDArrayHandle* out);
+/* *out = NULL when no gradient is attached */
+int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle* out);
+int MXNDArrayWaitToWrite(NDArrayHandle h);
+/* dims specials: 0 copies the input dim, -1 infers; reverse matches
+ * specials from the right */
+int MXNDArrayReshape64(NDArrayHandle h, int ndim, const int64_t* dims,
+                       int reverse, NDArrayHandle* out);
+int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                            uint32_t* out_num, NDArrayHandle** out_arrs,
+                            uint32_t* out_name_num,
+                            const char*** out_names);
+/* host SNAPSHOT of the buffer (device arrays are copied D2H); pointer
+ * valid until the next call on this thread */
+int MXNDArrayGetData(NDArrayHandle h, void** out_pdata);
+int MXNDArrayGetDataNDArray(NDArrayHandle h, NDArrayHandle* out);
+/* aux 0 = indices (row_sparse) / indptr (csr); aux 1 = indices (csr) */
+int MXNDArrayGetAuxNDArray(NDArrayHandle h, uint32_t i,
+                           NDArrayHandle* out);
+int MXNDArrayGetAuxType(NDArrayHandle h, uint32_t i, int* out_type);
+/* storage_type: 1 row_sparse (aux = [indices]), 2 csr
+ * (aux = [indptr, indices]); arrays adopted as-is */
+int MXNDArrayCreateSparseEx(int storage_type, const uint32_t* shape,
+                            uint32_t ndim, NDArrayHandle data,
+                            uint32_t num_aux, NDArrayHandle* aux,
+                            NDArrayHandle* out);
+int MXNDArraySyncCheckFormat(NDArrayHandle h, const int full_check);
+
+/* ---- symbol breadth ---------------------------------------------- */
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname);
+int MXSymbolCreateGroup(uint32_t num, SymbolHandle* syms,
+                        SymbolHandle* out);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle* out);
+int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t* out);
+int MXSymbolGetName(SymbolHandle sym, const char** out, int* success);
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value);
+int MXSymbolPrint(SymbolHandle sym, const char** out_str);
+/* non-recursive: attrs of the head node only */
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t* out_num,
+                            const char*** out_kv);
+/* free-variable symbols; ABI-owned handle array (caller frees each
+ * handle), valid until the next call on this thread */
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle** inputs,
+                            int* input_size);
+/* shapes CSR-packed: keys[i]'s shape = arg_shape_data[arg_ind_ptr[i]
+ * .. arg_ind_ptr[i+1]); all output buffers ABI-owned, valid until the
+ * next call on this thread */
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                       const char** keys, const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete);
+int MXSymbolInferShapePartial(SymbolHandle sym, uint32_t num_args,
+                              const char** keys,
+                              const uint32_t* arg_ind_ptr,
+                              const uint32_t* arg_shape_data,
+                              uint32_t* in_shape_size,
+                              const uint32_t** in_shape_ndim,
+                              const uint32_t*** in_shape_data,
+                              uint32_t* out_shape_size,
+                              const uint32_t** out_shape_ndim,
+                              const uint32_t*** out_shape_data,
+                              uint32_t* aux_shape_size,
+                              const uint32_t** aux_shape_ndim,
+                              const uint32_t*** aux_shape_data,
+                              int* complete);
+int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                      const char** keys, const int* arg_type_data,
+                      uint32_t* in_type_size, const int** in_type_data,
+                      uint32_t* out_type_size, const int** out_type_data,
+                      uint32_t* aux_type_size, const int** aux_type_data,
+                      int* complete);
+/* creators are op identities (interned name handles); free with
+ * MXSymbolFree */
+typedef void* AtomicSymbolCreator;
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name,
+                                const char** description,
+                                uint32_t* num_args,
+                                const char*** arg_names,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args);
+
+/* ---- RecordIO (reference: MXRecordIO* over dmlc recordio) -------- */
+typedef void* RecordIOHandle;
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterFree(RecordIOHandle h);
+int MXRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle h, size_t* pos);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOReaderFree(RecordIOHandle h);
+/* *size = 0 at end of file; buffer valid until next call on thread */
+int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** buf,
+                               size_t* size);
+int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle h, size_t* pos);
+
+/* ---- kvstore roles / control ------------------------------------- */
+int MXKVStoreIsWorkerNode(int* ret);
+int MXKVStoreIsServerNode(int* ret);
+int MXKVStoreIsSchedulerNode(int* ret);
+int MXKVStoreGetNumDeadNode(KVStoreHandle h, const int node_id,
+                            int* number, const int timeout_sec);
+int MXKVStoreSetGradientCompression(KVStoreHandle h, uint32_t num_params,
+                                    const char** keys, const char** vals);
+int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
+                                   const char* cmd_body);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle h, const int do_barrier);
+/* blocks, running the server-role loop (reference: RunServer); the
+ * controller callback is accepted for parity and invoked for profiler
+ * commands sent via SendCommmandToServers on this process */
+typedef void(MXKVStoreServerController)(int head, const char* body,
+                                        void* controller_handle);
+int MXKVStoreRunServer(KVStoreHandle h, MXKVStoreServerController controller,
+                       void* controller_handle);
+int MXInitPSEnv(uint32_t num_vars, const char** keys, const char** vals);
+/* updater callbacks: handles passed in are BORROWED for the call */
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void* handle);
+typedef void(MXKVStoreStrUpdater)(const char* key, NDArrayHandle recv,
+                                  NDArrayHandle local, void* handle);
+int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdater updater,
+                        void* updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle h, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle);
+/* string-key aliases of Init/Push/Pull (this ABI is string-keyed
+ * throughout, like the reference's *Ex variants) */
+int MXKVStoreInitEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* outs, int priority);
+
+/* ---- data iter extras -------------------------------------------- */
+/* sample indices of the current batch; ABI-owned buffer */
+int MXDataIterGetIndex(DataIterHandle h, uint64_t** out_index,
+                       uint64_t* out_size);
+int MXDataIterGetIterInfo(const char* name, const char** out_name,
+                          const char** out_desc);
+
+/* ---- quantization (reference: MXQuantizeSymbol) ------------------ */
+int MXQuantizeSymbol(SymbolHandle sym, SymbolHandle* out,
+                     uint32_t num_excluded, const char** excluded,
+                     const char* quantized_dtype);
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym,
+                                     uint32_t num_layers,
+                                     const char** layer_names,
+                                     const float* min_ranges,
+                                     const float* max_ranges,
+                                     SymbolHandle* out);
+
+/* ---- explicit-array executor bind -------------------------------- */
+/* grad_req codes (reference OpReqType): 0 null, 1 write, 2 inplace
+ * (treated as write), 3 add */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   uint32_t len, NDArrayHandle* in_args,
+                   NDArrayHandle* arg_grad_store,
+                   const uint32_t* grad_req_type, uint32_t aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out);
+/* group2ctx maps are not supported through the C surface (use the
+ * Python model_parallel API); num_map_keys must be 0 */
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    uint32_t num_map_keys, const char** map_keys,
+                    const int* map_dev_types, const int* map_dev_ids,
+                    uint32_t len, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store,
+                    const uint32_t* grad_req_type,
+                    uint32_t aux_states_len, NDArrayHandle* aux_states,
+                    ExecutorHandle* out);
+/* shared_exec accepted for parity (memory sharing is XLA's job here) */
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     uint32_t num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     uint32_t len, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store,
+                     const uint32_t* grad_req_type,
+                     uint32_t aux_states_len, NDArrayHandle* aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle* out);
+int MXExecutorBackwardEx(ExecutorHandle exec, uint32_t num_ograds,
+                         NDArrayHandle* ograds);
+int MXExecutorPrint(ExecutorHandle exec, const char** out_str);
+int MXExecutorGetOptimizedSymbol(ExecutorHandle exec, SymbolHandle* out);
+
+/* ---- runtime misc ------------------------------------------------ */
+int MXNotifyShutdown(void);
+/* hint for host-side thread pools (native decode etc.) */
+int MXSetNumOMPThreads(int thread_num);
+int MXRandomSeedContext(int seed, int dev_type, int dev_id);
+/* faithful to a CUDA-less build: always fails with "no GPU devices" */
+int MXGetGPUMemoryInformation(int dev, int* free_mem, int* total_mem);
+
+/* ---- batch 5b ---------------------------------------------------- */
+/* *out_stypes: storage ids per output (always dense = 0 here) */
+int MXImperativeInvokeEx(const char* op_name, int num_inputs,
+                         NDArrayHandle* inputs, int* num_outputs,
+                         NDArrayHandle** outputs, int num_params,
+                         const char** param_keys, const char** param_vals,
+                         const int** out_stypes);
+int MXKVStorePullRowSparse(KVStoreHandle h, uint32_t num,
+                           const char** keys, NDArrayHandle* outs,
+                           NDArrayHandle* row_ids, int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle h, uint32_t num,
+                             const char** keys, NDArrayHandle* outs,
+                             NDArrayHandle* row_ids, int priority);
+int MXKVStorePullWithSparse(KVStoreHandle h, uint32_t num,
+                            const char** keys, NDArrayHandle* outs,
+                            int priority, int ignore_sparse);
+int MXKVStorePullWithSparseEx(KVStoreHandle h, uint32_t num,
+                              const char** keys, NDArrayHandle* outs,
+                              int priority, int ignore_sparse);
+/* legacy plain-name profiler aliases (same behavior as the
+ * process-scoped calls) */
+int MXSetProfilerConfig(int num_params, const char** keys,
+                        const char** vals);
+int MXSetProfilerState(int state);
+int MXDumpProfile(int finished);
+int MXProfilePause(int paused);
+int MXProfileCreateEvent(const char* name, ProfileHandle* out);
+/* faithful to the reference: always errors ("not implemented" there,
+ * c_api_symbolic.cc:640) — bind with grad_req and use backward */
+int MXSymbolGrad(SymbolHandle sym, uint32_t num_wrt, const char** wrt,
+                 SymbolHandle* out);
+/* fresh-grad bookkeeping flag (reference: NDArray::fresh_out_grad) */
+int MXNDArrayGetGradState(NDArrayHandle h, int* out);
+int MXNDArraySetGradState(NDArrayHandle h, int state);
+/* DLPack interop over a HOST snapshot of the buffer (the reference
+ * shares CPU memory in place; PjRt device buffers are copied D2H).
+ * ToDLPack consumes per the protocol; free the tensor with
+ * MXNDArrayCallDLPackDeleter. */
+typedef void* DLManagedTensorHandle;
+int MXNDArrayToDLPack(NDArrayHandle h, DLManagedTensorHandle* out);
+int MXNDArrayFromDLPack(DLManagedTensorHandle dlm, NDArrayHandle* out);
+int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlm);
+/* per-output monitor hook; handles passed to the callback are borrowed
+ * for the duration of the call */
+typedef void (*ExecutorMonitorCallback)(const char* name, NDArrayHandle arr,
+                                        void* callback_handle);
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle);
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle exec,
+                                   ExecutorMonitorCallback callback,
+                                   void* callback_handle, int monitor_all);
+
 #ifdef __cplusplus
 }
 #endif

@@ -443,8 +443,9 @@ def profiler_stats_print(reset):
 def profile_create(kind, domain, name):
     from . import profiler as _prof
     cls = {"domain": _prof.Domain, "task": _prof.Task,
-           "frame": _prof.Frame, "counter": _prof.Counter}[kind]
-    if kind == "domain":
+           "frame": _prof.Frame, "counter": _prof.Counter,
+           "event": _prof.Event}[kind]
+    if kind in ("domain", "event"):
         return cls(name)
     return cls(domain, name)
 
@@ -541,3 +542,569 @@ def symbol_compose(sym, name, keys, args):
 
 def symbol_copy(sym):
     return sym.copy()
+
+
+# -- batch 5: CachedOp / autograd state / symbol breadth / recordio /
+#    kvstore roles / sparse accessors / quantization
+#    (reference: c_api.cc MXCreateCachedOp:1233, c_api_symbolic.cc,
+#     c_api_profile.cc, kvstore.h:353)
+
+
+class _CachedOpC(object):
+    """C-ABI CachedOp: the symbol's whole graph as ONE jitted program.
+
+    Inputs follow the reference's CachedOp convention: every entry of
+    ``list_arguments() + list_auxiliary_states()``, in order
+    (reference: src/imperative/cached_op.cc:40)."""
+
+    def __init__(self, sym):
+        self._sym = sym
+        self._names = sym.list_arguments() + sym.list_auxiliary_states()
+        self._fn = None
+
+    def __call__(self, arrs):
+        import jax
+        from .symbol.symbol import _graph_eval_fn
+        if len(arrs) != len(self._names):
+            raise MXNetError(
+                "CachedOp expects %d inputs (args+aux), got %d"
+                % (len(self._names), len(arrs)))
+        if self._fn is None:
+            fn = _graph_eval_fn(self._sym, is_train=False)
+            names = self._names
+
+            def pure(vals, key):
+                outs, _ = fn(dict(zip(names, vals)), key)
+                return outs
+
+            self._fn = jax.jit(pure)
+        key = jax.random.PRNGKey(0)
+        return [NDArray(o)
+                for o in self._fn([a._data for a in arrs], key)]
+
+
+def cached_op_create(sym):
+    return _CachedOpC(sym)
+
+
+def cached_op_invoke(op, inputs):
+    return op(inputs)
+
+
+def autograd_is_recording():
+    from . import autograd
+    return int(autograd.is_recording())
+
+
+def autograd_is_training():
+    from . import autograd
+    return int(autograd.is_training())
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_backward_ex(heads, ograds, variables, retain_graph,
+                         train_mode):
+    """BackwardEx: explicit head gradients + optional variable list whose
+    grads are returned (reference: MXAutogradBackwardEx)."""
+    from . import autograd
+    ograds = ograds or None
+    autograd.backward(heads, head_grads=ograds,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+    return [v.grad for v in variables] if variables else []
+
+
+def nd_create_none():
+    return NDArray(_np.zeros((0,), _np.float32))
+
+
+def nd_detach(arr):
+    return arr.detach()
+
+
+def nd_get_grad(arr):
+    return arr.grad
+
+
+def nd_reshape64(arr, dims, reverse):
+    """Reshape with 0 (copy input dim) and -1 (infer) specials;
+    ``reverse`` matches the specials from the right like the
+    reference's MXNDArrayReshape64."""
+    shape = list(arr.shape)
+    dims = list(dims)
+    if reverse:
+        dims = dims[::-1]
+        shape = shape[::-1]
+    out = []
+    for i, d in enumerate(dims):
+        if d == 0:
+            if i >= len(shape):
+                raise MXNetError("0-dim at %d has no source dim" % i)
+            out.append(shape[i])
+        else:
+            out.append(int(d))
+    if reverse:
+        out = out[::-1]
+    return arr.reshape(tuple(out))
+
+
+def nd_load_from_buffer(buf):
+    """Load a .params/.ndarray byte buffer (reference:
+    MXNDArrayLoadFromBuffer) — same container format as nd_load."""
+    import os
+    import tempfile
+    fd, path = tempfile.mkstemp(suffix=".params")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(bytes(buf))
+        return nd_load(path)
+    finally:
+        os.unlink(path)
+
+
+def nd_get_data_nd(arr):
+    """Values array of a sparse NDArray; dense arrays return themselves
+    (reference: MXNDArrayGetDataNDArray)."""
+    from .ndarray.sparse import BaseSparseNDArray
+    if isinstance(arr, BaseSparseNDArray):
+        return NDArray(_np.asarray(arr.data))
+    return arr
+
+
+def nd_get_aux_nd(arr, i):
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        aux = (arr.indices,)
+    elif isinstance(arr, CSRNDArray):
+        aux = (arr.indptr, arr.indices)
+    else:
+        raise MXNetError("dense NDArray has no aux array")
+    if not 0 <= i < len(aux):
+        raise MXNetError("aux index %d out of range" % i)
+    return NDArray(_np.asarray(aux[i]))
+
+
+def nd_get_aux_type(arr, i):
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        aux = (arr.indices,)
+    elif isinstance(arr, CSRNDArray):
+        aux = (arr.indptr, arr.indices)
+    else:
+        raise MXNetError("dense NDArray has no aux array")
+    if not 0 <= i < len(aux):
+        raise MXNetError("aux index %d out of range" % i)
+    return _DTYPE_IDS[str(_np.dtype(aux[i].dtype))]
+
+
+def nd_create_sparse(stype, shape, data, aux):
+    from .ndarray.sparse import csr_matrix, row_sparse_array
+    if stype == "row_sparse":
+        return row_sparse_array((data, aux[0]), shape=tuple(shape))
+    if stype == "csr":
+        return csr_matrix((data, aux[1], aux[0]), shape=tuple(shape))
+    raise MXNetError("unknown sparse storage type %r" % stype)
+
+
+def nd_check_format(arr, full_check):
+    """Validate sparse index structure (reference:
+    MXNDArraySyncCheckFormat / NDArray::SyncCheckFormat)."""
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        idx = _np.asarray(arr.indices)
+        if idx.ndim != 1:
+            raise MXNetError("rsp indices must be 1-D")
+        if full_check and idx.size:
+            if (idx < 0).any() or (idx >= arr.shape[0]).any():
+                raise MXNetError("rsp indices out of bounds")
+            if (_np.diff(idx) <= 0).any():
+                raise MXNetError("rsp indices must be strictly increasing")
+    elif isinstance(arr, CSRNDArray):
+        indptr = _np.asarray(arr.indptr)
+        idx = _np.asarray(arr.indices)
+        if indptr.size != arr.shape[0] + 1:
+            raise MXNetError("csr indptr must have rows+1 entries")
+        if full_check:
+            if (_np.diff(indptr) < 0).any():
+                raise MXNetError("csr indptr must be non-decreasing")
+            if indptr[0] != 0 or indptr[-1] != idx.size:
+                raise MXNetError("csr indptr endpoints invalid")
+            if idx.size and ((idx < 0).any()
+                             or (idx >= arr.shape[1]).any()):
+                raise MXNetError("csr indices out of bounds")
+    return 0
+
+
+def symbol_from_file(fname):
+    with open(fname) as f:
+        return symbol_from_json(f.read())
+
+
+def symbol_save_file(sym, fname):
+    sym.save(fname)
+    return 0
+
+
+def symbol_group(syms):
+    from .symbol.symbol import Group
+    return Group(list(syms))
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_children(sym):
+    return sym.get_children()
+
+
+def symbol_get_output(sym, i):
+    return sym[int(i)]
+
+
+def symbol_num_outputs(sym):
+    return len(sym.list_outputs())
+
+
+def symbol_get_name(sym):
+    entries = sym._entries
+    if len(entries) == 1 and entries[0][0].name:
+        return entries[0][0].name
+    return None
+
+
+def symbol_set_attr(sym, key, val):
+    """Annotation attrs (lr_mult, ctx_group, ...) store dunder-prefixed
+    so they never collide with op params — the graph evaluator passes
+    bare attrs as op kwargs; Symbol.attr resolves them bare."""
+    wrapped = key
+    if not (key.startswith("__") and key.endswith("__")):
+        node = sym._entries[0][0]
+        declared = ()
+        if not node.is_var:
+            try:
+                declared = _reg.get_op(node.op).attr_defaults
+            except Exception:
+                declared = ()
+        if key not in declared:
+            wrapped = "__%s__" % key
+    sym._set_attr(**{wrapped: val})
+    return 0
+
+
+def symbol_print(sym):
+    return sym.debug_str()
+
+
+def symbol_list_attr_shallow(sym):
+    """Non-recursive attr dict of the head node (reference:
+    MXSymbolListAttrShallow)."""
+    out = []
+    node = sym._entries[0][0]
+    for k, v in sorted(getattr(node, "attrs", {}).items()):
+        if k.startswith("__") and k.endswith("__"):
+            k = k[2:-2]        # annotation attrs resolve bare
+        out.append(str(k))
+        out.append(str(v))
+    return out
+
+
+def symbol_get_inputs(sym):
+    """Free variables of the graph, each as its own Symbol handle
+    (reference: MXSymbolGetInputSymbols)."""
+    from .symbol.symbol import _topo, Symbol
+    return [Symbol([(n, 0)]) for n in _topo(sym._entries)
+            if n.is_var and not n.is_aux]
+
+
+def symbol_infer_shape(sym, keys, shapes, partial):
+    fn = sym.infer_shape_partial if partial else sym.infer_shape
+    arg_shapes, out_shapes, aux_shapes = fn(
+        **{k: tuple(s) for k, s in zip(keys, shapes)})
+    complete = arg_shapes is not None and \
+        all(s is not None for s in arg_shapes)
+    none_to_empty = lambda ls: [list(s) if s is not None else []  # noqa
+                                for s in (ls or [])]
+    return (none_to_empty(arg_shapes), none_to_empty(out_shapes),
+            none_to_empty(aux_shapes), int(complete))
+
+
+def symbol_infer_type(sym, keys, dtype_ids):
+    arg_t, out_t, aux_t = sym.infer_type(
+        **{k: _DTYPES[i] for k, i in zip(keys, dtype_ids)})
+    to_ids = lambda ls: [_DTYPE_IDS[_np.dtype(t).name]  # noqa: E731
+                         for t in (ls or [])]
+    return (to_ids(arg_t), to_ids(out_t), to_ids(aux_t),
+            int(arg_t is not None))
+
+
+def op_creators():
+    """Atomic-symbol creator handles = interned op-name strings
+    (reference returns nnvm op pointers; the name IS our identity)."""
+    return sorted(_reg.list_ops())
+
+
+def creator_name(h):
+    return str(h)
+
+
+def recio_reader_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "r")
+
+
+def recio_writer_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "w")
+
+
+def recio_read(r):
+    return r.read()            # None at EOF
+
+
+def recio_write(w, buf):
+    w.write(bytes(buf))
+    return 0
+
+
+def recio_seek(r, pos):
+    r.seek(pos)
+    return 0
+
+
+def recio_tell(r):
+    return r.tell()
+
+
+def recio_close(r):
+    r.close()
+    return 0
+
+
+def kv_role():
+    import os
+    return os.environ.get("MXNET_TPU_ROLE", "worker")
+
+
+def kv_num_dead(kv, node_id, timeout):
+    return int(kv.num_dead_node(node_id, timeout=timeout))
+
+
+def kv_set_gc(kv, keys, vals):
+    kv.set_gradient_compression(
+        {k: _parse_attr(v) for k, v in zip(keys, vals)})
+    return 0
+
+
+def kv_send_command(kv, head, body):
+    """Controller command to all servers (reference:
+    MXKVStoreSendCommmandToServers); profiler heads route through the
+    server-profiler path."""
+    kv._server_profiler_command(head, body)
+    return 0
+
+
+def kv_set_barrier_before_exit(kv, flag):
+    kv._barrier_before_exit = bool(flag)
+    return 0
+
+
+def kv_run_server(kv):
+    """Run the server-role loop on this process, blocking until shutdown
+    (reference: MXKVStoreRunServer)."""
+    from .kvstore_server import serve_forever as _serve
+    _serve()
+    return 0
+
+
+def kv_init_ps_env(keys, vals):
+    import os
+    os.environ.update({str(k): str(v) for k, v in zip(keys, vals)})
+    return 0
+
+
+def kv_set_updater(kv, fn_ptr, handle_ptr, str_keys):
+    """Install a C updater callback: merged gradient + stored weight per
+    key (reference: MXKVStoreSetUpdater/SetUpdaterEx). ``fn_ptr`` is the
+    raw C function pointer; handles passed to it are borrowed PyObject*
+    valid for the duration of the call."""
+    import ctypes
+    keyt = ctypes.c_char_p if str_keys else ctypes.c_int
+    proto = ctypes.CFUNCTYPE(None, keyt, ctypes.c_void_p,
+                             ctypes.c_void_p, ctypes.c_void_p)
+    cb = proto(fn_ptr)
+
+    def updater(key, recv, local):
+        if str_keys:
+            k = str(key).encode()
+        else:
+            ks = str(key)
+            if not ks.lstrip("-").isdigit():
+                raise MXNetError(
+                    "int-key updater installed but store key %r is not "
+                    "numeric; use MXKVStoreSetUpdaterEx (string keys)"
+                    % (key,))
+            k = int(ks)
+        cb(k, id(recv), id(local), handle_ptr)
+
+    kv._set_updater(updater)
+    return 0
+
+
+def iter_index(w):
+    b = _cur_batch(w)
+    if b.index is None:
+        raise MXNetError("iterator does not provide batch indices")
+    return [int(i) for i in b.index]
+
+
+def iter_info(name):
+    from . import io as _io
+    cls = getattr(_io, name, None)
+    if cls is None:
+        raise MXNetError("no such iterator %r" % name)
+    doc = (cls.__doc__ or "").strip()
+    return [name, doc.splitlines()[0] if doc else ""]
+
+
+def quantize_symbol(sym, excluded, quantized_dtype):
+    """Graph-only quantization pass (reference: MXQuantizeSymbol) —
+    runtime min/max, no calibration table."""
+    from .contrib.quantization import quantize_model
+    qsym, _, _ = quantize_model(sym, {}, {}, calib_mode="none",
+                                excluded_sym_names=tuple(excluded),
+                                quantized_dtype=quantized_dtype)
+    return qsym
+
+
+def calibrate_quantized_symbol(sym, names, mins, maxs):
+    """Attach a calibration table to a quantized graph (reference:
+    MXSetCalibTableToQuantizedSymbol): set min/max attrs on matching
+    quantize/requantize nodes so runtime range ops fold away."""
+    from .symbol.symbol import _topo
+    table = {n: (float(lo), float(hi))
+             for n, lo, hi in zip(names, mins, maxs)}
+    s = sym.copy()
+    hits = 0
+    for node in _topo(s._entries):
+        base = (node.name or "").replace("_quantize", "") \
+                                .replace("_requantize", "")
+        if base in table:
+            lo, hi = table[base]
+            node.attrs["min_calib_range"] = str(lo)
+            node.attrs["max_calib_range"] = str(hi)
+            hits += 1
+    return s
+
+
+def executor_bind_explicit(sym, args, grads, req_strs, aux):
+    """bind with explicit arrays in list_arguments order (reference:
+    MXExecutorBind/BindX/BindEX)."""
+    from .executor import Executor
+    from .context import current_context
+    grad_map = None
+    if grads:
+        names = sym.list_arguments()
+        grad_map = {n: g for n, g in zip(names, grads) if g is not None}
+    req = list(req_strs) if req_strs else "write"
+    if isinstance(req, list) and req and all(r == req[0] for r in req):
+        req = req[0]
+    return _ExecWrap(Executor(sym, current_context(), list(args), grad_map,
+                              req, list(aux) if aux else None))
+
+
+def executor_backward_ex(w, ograds):
+    w.exe.backward(ograds if ograds else None)
+    return 0
+
+
+def executor_print(w):
+    return w.exe.debug_str()
+
+
+def executor_optimized_symbol(w):
+    return w.exe._symbol.copy()
+
+
+def set_omp_threads(n):
+    """Host thread-pool hint (reference: MXSetNumOMPThreads -> OMP);
+    here it sizes the native decode pool default via env."""
+    import os
+    os.environ["OMP_NUM_THREADS"] = str(int(n))
+    return 0
+
+
+# -- batch 5b: sparse pulls, dlpack, fresh-grad flag, monitor callback
+
+
+def kv_pull_rsp(kv, keys, outs, row_ids, priority):
+    """Pull only the rows in row_ids per key (reference:
+    MXKVStorePullRowSparse)."""
+    kv.row_sparse_pull(list(keys), out=list(outs), priority=int(priority),
+                       row_ids=list(row_ids))
+    return 0
+
+
+def kv_pull_sparse(kv, keys, outs, priority, ignore_sparse):
+    kv.pull(list(keys), out=list(outs), priority=int(priority),
+            ignore_sparse=bool(ignore_sparse))
+    return 0
+
+
+def symbol_grad(sym, wrt):
+    """Faithful to the reference: MXSymbolGrad is 'not implemented'
+    there (c_api_symbolic.cc:640); bind with grad_req and use
+    backward."""
+    return sym.grad(list(wrt))
+
+
+def nd_get_fresh_grad(arr):
+    return int(getattr(arr, "_fresh_grad", False))
+
+
+def nd_set_fresh_grad(arr, flag):
+    arr._fresh_grad = bool(flag)
+    return 0
+
+
+def nd_to_dlpack(arr):
+    """DLPack capsule over a HOST snapshot of the buffer (the reference
+    shares CPU memory; PjRt device buffers are copied D2H first)."""
+    # .copy(): jax-backed views are read-only, which DLPack can't signal
+    return arr.asnumpy().copy().__dlpack__()
+
+
+class _DLPackWrapper(object):
+    """Adapter giving a raw capsule the array-interchange protocol."""
+
+    def __init__(self, capsule):
+        self._c = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._c
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def nd_from_dlpack(capsule):
+    return NDArray(_np.from_dlpack(_DLPackWrapper(capsule)).copy())
+
+
+def executor_set_monitor(w, fn_ptr, handle_ptr, monitor_all):
+    """Install a C monitor callback invoked per output (reference:
+    MXExecutorSetMonitorCallback); handles passed to it are borrowed."""
+    import ctypes
+    proto = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                             ctypes.c_void_p)
+    cb = proto(fn_ptr)
+
+    def monitor(name, arr):
+        cb(str(name).encode(), id(arr), handle_ptr)
+
+    w.exe.set_monitor_callback(monitor, monitor_all=bool(monitor_all))
+    return 0

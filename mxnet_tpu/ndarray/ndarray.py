@@ -37,7 +37,7 @@ class NDArray:
     """A multi-dimensional array on a device (reference: ndarray.h:82)."""
 
     __slots__ = ("_data", "_ctx", "grad", "_grad_req", "_ag_node",
-                 "__weakref__")
+                 "_fresh_grad", "__weakref__")
 
     def __init__(self, data, ctx=None):
         self._data = data

@@ -22,7 +22,7 @@ import time
 
 from .base import MXNetError
 
-__all__ = ["set_config", "profiler_set_config", "set_state",
+__all__ = ["set_config", "profiler_set_config", "set_state", "Event",
            "profiler_set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "Task", "Frame", "Counter", "Marker",
            "Domain", "scope"]
@@ -271,6 +271,13 @@ class Task(object):
 
 class Frame(Task):
     pass
+
+
+class Event(Task):
+    """Domain-less named duration (reference: profiler.py Event)."""
+
+    def __init__(self, name):
+        super(Event, self).__init__(None, name)
 
 
 class Counter(object):

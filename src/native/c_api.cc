@@ -1319,3 +1319,1368 @@ MXTPU_API int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
   *out = r;
   return 0;
 }
+
+// =================================================================
+// batch 5: CachedOp, autograd state, NDArray extras + sparse
+// accessors, symbol breadth, RecordIO, kvstore roles/updaters,
+// data-iter extras, quantization, explicit-array bind, runtime misc.
+// =================================================================
+
+namespace {
+
+// consume ``args`` (may be nullptr), discard the result
+int simple_call(const char* fn, PyObject* args) {
+  if (args == nullptr) { set_last_error("arg marshalling failed"); return -1; }
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// consume ``args``; *out = new strong handle from the result
+int handle_call(const char* fn, PyObject* args, void** out) {
+  if (args == nullptr) { set_last_error("arg marshalling failed"); return -1; }
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// consume ``args``; *out = long from the result
+int long_call(const char* fn, PyObject* args, long* out) {
+  if (args == nullptr) { set_last_error("arg marshalling failed"); return -1; }
+  PyObject* r = bridge_call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (*out == -1 && PyErr_Occurred()) { capture_py_error(); return -1; }
+  return 0;
+}
+
+PyObject* handle_list(uint32_t n, void** hs) {
+  PyObject* l = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = (hs != nullptr && hs[i] != nullptr)
+        ? reinterpret_cast<PyObject*>(hs[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(l, i, o);
+  }
+  return l;
+}
+
+PyObject* str_list(uint32_t n, const char** ss) {
+  PyObject* l = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SetItem(l, i, PyUnicode_FromString(ss[i]));
+  return l;
+}
+
+// thread-local buffers for shape/type/stype/index outputs
+struct ShapeBuf {
+  std::vector<std::vector<uint32_t>> store;
+  std::vector<const uint32_t*> ptrs;
+  std::vector<uint32_t> ndims;
+};
+thread_local ShapeBuf tl_shape_bufs[3];
+thread_local std::vector<int> tl_type_bufs[3];
+thread_local std::vector<int> tl_ints;
+thread_local std::vector<uint64_t> tl_u64;
+
+// unpack a python list of [d0, d1, ...] lists into one ShapeBuf section
+void fill_shapes(PyObject* list, ShapeBuf* b, uint32_t* size,
+                 const uint32_t** ndim, const uint32_t*** data) {
+  b->store.clear();
+  b->ptrs.clear();
+  b->ndims.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* shp = PyList_GetItem(list, i);
+    Py_ssize_t nd = PyList_Size(shp);
+    std::vector<uint32_t> dims;
+    for (Py_ssize_t j = 0; j < nd; ++j)
+      dims.push_back((uint32_t)PyLong_AsUnsignedLong(
+          PyList_GetItem(shp, j)));
+    b->store.push_back(std::move(dims));
+    b->ndims.push_back((uint32_t)nd);
+  }
+  for (auto& v : b->store) b->ptrs.push_back(v.data());
+  *size = (uint32_t)n;
+  *ndim = b->ndims.data();
+  *data = b->ptrs.data();
+}
+
+void fill_types(PyObject* list, std::vector<int>* buf, uint32_t* size,
+                const int** data) {
+  buf->clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    buf->push_back((int)PyLong_AsLong(PyList_GetItem(list, i)));
+  *size = (uint32_t)n;
+  *data = buf->data();
+}
+
+}  // namespace
+
+// --------------------------------------------------------- cached op
+
+MXTPU_API int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("cached_op_create",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym)),
+                     out);
+}
+
+MXTPU_API int MXCreateCachedOpEx(SymbolHandle sym, int num_flags,
+                                 const char** keys, const char** vals,
+                                 CachedOpHandle* out) {
+  (void)num_flags;  // flags have nothing to toggle: one compiled program
+  (void)keys;
+  (void)vals;
+  return MXCreateCachedOp(sym, out);
+}
+
+MXTPU_API int MXInvokeCachedOp(CachedOpHandle h, int num_inputs,
+                               NDArrayHandle* inputs, int* num_outputs,
+                               NDArrayHandle** outputs) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue(
+      "(ON)", reinterpret_cast<PyObject*>(h),
+      handle_list((uint32_t)num_inputs, inputs));
+  PyObject* r = bridge_call("cached_op_invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  uint32_t n = 0;
+  *outputs = reinterpret_cast<NDArrayHandle*>(stash_handles(r, &n));
+  *num_outputs = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOpEx(CachedOpHandle h, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs,
+                                 const int** out_stypes) {
+  if (MXInvokeCachedOp(h, num_inputs, inputs, num_outputs, outputs) != 0)
+    return -1;
+  tl_ints.assign((size_t)*num_outputs, 0);  // dense everywhere
+  *out_stypes = tl_ints.data();
+  return 0;
+}
+
+MXTPU_API int MXFreeCachedOp(CachedOpHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  Py_XDECREF(reinterpret_cast<PyObject*>(h));
+  return 0;
+}
+
+// --------------------------------------------------- autograd state
+
+MXTPU_API int MXAutogradIsRecording(int* curr) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("autograd_is_recording", PyTuple_New(0), &v) != 0)
+    return -1;
+  *curr = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXAutogradIsTraining(int* curr) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("autograd_is_training", PyTuple_New(0), &v) != 0)
+    return -1;
+  *curr = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("autograd_set_training",
+                Py_BuildValue("(i)", is_training), &v) != 0)
+    return -1;
+  if (prev != nullptr) *prev = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXAutogradBackwardEx(uint32_t num_output,
+                                   NDArrayHandle* output_handles,
+                                   NDArrayHandle* ograd_handles,
+                                   uint32_t num_variables,
+                                   NDArrayHandle* var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train,
+                                   NDArrayHandle** grad_handles,
+                                   int** grad_stypes) {
+  (void)create_graph;  // tape supports higher order; flag is implicit
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* heads = handle_list(num_output, output_handles);
+  PyObject* ograds = ograd_handles != nullptr
+      ? handle_list(num_output, ograd_handles) : PyList_New(0);
+  PyObject* vars = handle_list(num_variables, var_handles);
+  PyObject* args = Py_BuildValue("(NNNii)", heads, ograds, vars,
+                                 retain_graph, is_train);
+  PyObject* r = bridge_call("autograd_backward_ex", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (num_variables > 0 && grad_handles != nullptr) {
+    uint32_t n = 0;
+    *grad_handles = reinterpret_cast<NDArrayHandle*>(stash_handles(r, &n));
+    if (grad_stypes != nullptr) {
+      tl_ints.assign(n, 0);
+      *grad_stypes = tl_ints.data();
+    }
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXAutogradComputeGradient(uint32_t num_output,
+                                        NDArrayHandle* output_handles) {
+  return MXAutogradBackwardEx(num_output, output_handles, nullptr, 0,
+                              nullptr, 0, 0, 1, nullptr, nullptr);
+}
+
+// --------------------------------------------------- NDArray extras
+
+MXTPU_API int MXNDArrayCreateNone(NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("nd_create_none", PyTuple_New(0), out);
+}
+
+MXTPU_API int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA buffers materialize lazily anyway
+  static const char* kDev[] = {"cpu", "cpu", "gpu", "tpu"};
+  if (dev_type < 1 || dev_type > 3) {
+    set_last_error("dev_type must be 1 (cpu), 2 (gpu) or 3 (tpu)");
+    return -1;
+  }
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pshape = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(pshape, i, PyLong_FromUnsignedLong(shape[i]));
+  return handle_call("nd_create",
+                     Py_BuildValue("(Nisi)", pshape, dtype,
+                                   kDev[dev_type], dev_id),
+                     out);
+}
+
+MXTPU_API int MXNDArrayDetach(NDArrayHandle h, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("nd_detach",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h)),
+                     out);
+}
+
+MXTPU_API int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_get_grad", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    return 0;
+  }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitToWrite(NDArrayHandle h) {
+  // PjRt buffers are immutable: write-ready == read-ready
+  return MXNDArrayWaitToRead(h);
+}
+
+MXTPU_API int MXNDArrayReshape64(NDArrayHandle h, int ndim,
+                                 const int64_t* dims, int reverse,
+                                 NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pdims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(pdims, i, PyLong_FromLongLong(dims[i]));
+  return handle_call("nd_reshape64",
+                     Py_BuildValue("(ONi)",
+                                   reinterpret_cast<PyObject*>(h), pdims,
+                                   reverse),
+                     out);
+}
+
+MXTPU_API int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                                      uint32_t* out_num,
+                                      NDArrayHandle** out_arrs,
+                                      uint32_t* out_name_num,
+                                      const char*** out_names) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(buf), (Py_ssize_t)size);
+  PyObject* args = Py_BuildValue("(N)", bytes);
+  PyObject* r = bridge_call("nd_load_from_buffer", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  PyObject* arrs = PyTuple_GetItem(r, 0);
+  PyObject* names = PyTuple_GetItem(r, 1);
+  *out_arrs = reinterpret_cast<NDArrayHandle*>(stash_handles(arrs, out_num));
+  *out_names = stash_strings(names, out_name_num);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetData(NDArrayHandle h, void** out_pdata) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  char* data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(data, (size_t)n);
+  *out_pdata = const_cast<char*>(tl_strings.back().data());
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetDataNDArray(NDArrayHandle h, NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("nd_get_data_nd",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h)),
+                     out);
+}
+
+MXTPU_API int MXNDArrayGetAuxNDArray(NDArrayHandle h, uint32_t i,
+                                     NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("nd_get_aux_nd",
+                     Py_BuildValue("(OI)",
+                                   reinterpret_cast<PyObject*>(h), i),
+                     out);
+}
+
+MXTPU_API int MXNDArrayGetAuxType(NDArrayHandle h, uint32_t i,
+                                  int* out_type) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("nd_get_aux_type",
+                Py_BuildValue("(OI)", reinterpret_cast<PyObject*>(h), i),
+                &v) != 0)
+    return -1;
+  *out_type = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateSparseEx(int storage_type,
+                                      const uint32_t* shape, uint32_t ndim,
+                                      NDArrayHandle data, uint32_t num_aux,
+                                      NDArrayHandle* aux,
+                                      NDArrayHandle* out) {
+  static const char* kStype[] = {"default", "row_sparse", "csr"};
+  if (storage_type < 1 || storage_type > 2) {
+    set_last_error("storage_type must be 1 (row_sparse) or 2 (csr)");
+    return -1;
+  }
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pshape = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(pshape, i, PyLong_FromUnsignedLong(shape[i]));
+  return handle_call("nd_create_sparse",
+                     Py_BuildValue("(sNON)", kStype[storage_type], pshape,
+                                   reinterpret_cast<PyObject*>(data),
+                                   handle_list(num_aux, aux)),
+                     out);
+}
+
+MXTPU_API int MXNDArraySyncCheckFormat(NDArrayHandle h,
+                                       const int full_check) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("nd_check_format",
+                     Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(h),
+                                   full_check));
+}
+
+// --------------------------------------------------- symbol breadth
+
+MXTPU_API int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_from_file", Py_BuildValue("(s)", fname), out);
+}
+
+MXTPU_API int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("symbol_save_file",
+                     Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(sym),
+                                   fname));
+}
+
+MXTPU_API int MXSymbolCreateGroup(uint32_t num, SymbolHandle* syms,
+                                  SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_group",
+                     Py_BuildValue("(N)", handle_list(num, syms)), out);
+}
+
+MXTPU_API int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_get_internals",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym)),
+                     out);
+}
+
+MXTPU_API int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_get_children",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym)),
+                     out);
+}
+
+MXTPU_API int MXSymbolGetOutput(SymbolHandle sym, uint32_t index,
+                                SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_get_output",
+                     Py_BuildValue("(OI)",
+                                   reinterpret_cast<PyObject*>(sym), index),
+                     out);
+}
+
+MXTPU_API int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("symbol_num_outputs",
+                Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym)),
+                &v) != 0)
+    return -1;
+  *out = (uint32_t)v;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetName(SymbolHandle sym, const char** out,
+                              int* success) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_get_name", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    tl_strings.clear();
+    tl_cstrs.clear();
+    tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+    *out = tl_strings.back().c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolSetAttr(SymbolHandle sym, const char* key,
+                              const char* value) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("symbol_set_attr",
+                     Py_BuildValue("(Oss)",
+                                   reinterpret_cast<PyObject*>(sym), key,
+                                   value));
+}
+
+MXTPU_API int MXSymbolPrint(SymbolHandle sym, const char** out_str) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_print", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+  *out_str = tl_strings.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t* out_num,
+                                      const char*** out_kv) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_list_attr_shallow", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  uint32_t flat = 0;
+  *out_kv = stash_strings(r, &flat);
+  *out_num = flat / 2;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetInputSymbols(SymbolHandle sym,
+                                      SymbolHandle** inputs,
+                                      int* input_size) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  PyObject* r = bridge_call("symbol_get_inputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  uint32_t n = 0;
+  *inputs = reinterpret_cast<SymbolHandle*>(stash_handles(r, &n));
+  *input_size = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+int infer_shape_impl(SymbolHandle sym, uint32_t num_args, const char** keys,
+                     const uint32_t* arg_ind_ptr,
+                     const uint32_t* arg_shape_data, int partial,
+                     uint32_t* in_size, const uint32_t** in_ndim,
+                     const uint32_t*** in_data, uint32_t* out_size,
+                     const uint32_t** out_ndim, const uint32_t*** out_data,
+                     uint32_t* aux_size, const uint32_t** aux_ndim,
+                     const uint32_t*** aux_data, int* complete) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = str_list(num_args, keys);
+  PyObject* pshapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo,
+                     PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(pshapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ONNi)",
+                                 reinterpret_cast<PyObject*>(sym), pkeys,
+                                 pshapes, partial);
+  PyObject* r = bridge_call("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  fill_shapes(PyTuple_GetItem(r, 0), &tl_shape_bufs[0], in_size, in_ndim,
+              in_data);
+  fill_shapes(PyTuple_GetItem(r, 1), &tl_shape_bufs[1], out_size, out_ndim,
+              out_data);
+  fill_shapes(PyTuple_GetItem(r, 2), &tl_shape_bufs[2], aux_size, aux_ndim,
+              aux_data);
+  *complete = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                                 const char** keys,
+                                 const uint32_t* arg_ind_ptr,
+                                 const uint32_t* arg_shape_data,
+                                 uint32_t* in_shape_size,
+                                 const uint32_t** in_shape_ndim,
+                                 const uint32_t*** in_shape_data,
+                                 uint32_t* out_shape_size,
+                                 const uint32_t** out_shape_ndim,
+                                 const uint32_t*** out_shape_data,
+                                 uint32_t* aux_shape_size,
+                                 const uint32_t** aux_shape_ndim,
+                                 const uint32_t*** aux_shape_data,
+                                 int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          0, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+MXTPU_API int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          1, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+MXTPU_API int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                                const char** keys, const int* arg_type_data,
+                                uint32_t* in_type_size,
+                                const int** in_type_data,
+                                uint32_t* out_type_size,
+                                const int** out_type_data,
+                                uint32_t* aux_type_size,
+                                const int** aux_type_data, int* complete) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* pkeys = str_list(num_args, keys);
+  PyObject* ptypes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i)
+    PyList_SetItem(ptypes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject* args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject*>(sym), pkeys,
+                                 ptypes);
+  PyObject* r = bridge_call("symbol_infer_type", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  fill_types(PyTuple_GetItem(r, 0), &tl_type_bufs[0], in_type_size,
+             in_type_data);
+  fill_types(PyTuple_GetItem(r, 1), &tl_type_bufs[1], out_type_size,
+             out_type_data);
+  fill_types(PyTuple_GetItem(r, 2), &tl_type_bufs[2], aux_type_size,
+             aux_type_data);
+  *complete = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                               AtomicSymbolCreator** out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("op_creators", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<AtomicSymbolCreator*>(stash_handles(r, out_size));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char** name) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  const char* s = PyUnicode_AsUTF8(reinterpret_cast<PyObject*>(creator));
+  if (s == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(s);
+  *name = tl_strings.back().c_str();
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                          const char** name,
+                                          const char** description,
+                                          uint32_t* num_args,
+                                          const char*** arg_names,
+                                          const char*** arg_descriptions,
+                                          const char** key_var_num_args) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* cname = reinterpret_cast<PyObject*>(creator);
+  PyObject* args = Py_BuildValue("(O)", cname);
+  PyObject* r = bridge_call("op_info", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  // stash: [0]=name, [1]=doc, then attr names, then defaults
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(cname));
+  tl_strings.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(r, 0)));
+  PyObject* names_l = PyTuple_GetItem(r, 1);
+  PyObject* defaults_l = PyTuple_GetItem(r, 2);
+  Py_ssize_t n = PyList_Size(names_l);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names_l, i)));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_strings.emplace_back(
+        PyUnicode_AsUTF8(PyList_GetItem(defaults_l, i)));
+  for (auto& s : tl_strings) tl_cstrs.push_back(s.c_str());
+  *name = tl_cstrs[0];
+  *description = tl_cstrs[1];
+  *num_args = (uint32_t)n;
+  *arg_names = tl_cstrs.data() + 2;
+  *arg_descriptions = tl_cstrs.data() + 2 + n;
+  *key_var_num_args = "";
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ RecordIO
+
+MXTPU_API int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("recio_writer_create", Py_BuildValue("(s)", uri), out);
+}
+
+MXTPU_API int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("recio_reader_create", Py_BuildValue("(s)", uri), out);
+}
+
+namespace {
+int recio_free(RecordIOHandle h) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("recio_close", args);
+  Py_DECREF(args);
+  Py_XDECREF(r);
+  Py_XDECREF(reinterpret_cast<PyObject*>(h));
+  return r == nullptr ? -1 : 0;
+}
+}  // namespace
+
+MXTPU_API int MXRecordIOWriterFree(RecordIOHandle h) {
+  return recio_free(h);
+}
+
+MXTPU_API int MXRecordIOReaderFree(RecordIOHandle h) {
+  return recio_free(h);
+}
+
+MXTPU_API int MXRecordIOWriterWriteRecord(RecordIOHandle h,
+                                          const char* buf, size_t size) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* bytes = PyBytes_FromStringAndSize(buf, (Py_ssize_t)size);
+  return simple_call("recio_write",
+                     Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(h),
+                                   bytes));
+}
+
+MXTPU_API int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** buf,
+                                         size_t* size) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("recio_read", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {  // end of file
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char* data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(data, (size_t)n);
+  *buf = tl_strings.back().data();
+  *size = (size_t)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int recio_tell_impl(RecordIOHandle h, size_t* pos) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("recio_tell",
+                Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h)),
+                &v) != 0)
+    return -1;
+  *pos = (size_t)v;
+  return 0;
+}
+}  // namespace
+
+MXTPU_API int MXRecordIOWriterTell(RecordIOHandle h, size_t* pos) {
+  return recio_tell_impl(h, pos);
+}
+
+MXTPU_API int MXRecordIOReaderTell(RecordIOHandle h, size_t* pos) {
+  return recio_tell_impl(h, pos);
+}
+
+MXTPU_API int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("recio_seek",
+                     Py_BuildValue("(OK)", reinterpret_cast<PyObject*>(h),
+                                   (unsigned long long)pos));
+}
+
+// -------------------------------------------- kvstore roles / control
+
+namespace {
+int kv_role_is(const char* role, int* ret) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* r = bridge_call("kv_role", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  *ret = (s != nullptr && std::string(s) == role) ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+MXTPU_API int MXKVStoreIsWorkerNode(int* ret) {
+  return kv_role_is("worker", ret);
+}
+
+MXTPU_API int MXKVStoreIsServerNode(int* ret) {
+  return kv_role_is("server", ret);
+}
+
+MXTPU_API int MXKVStoreIsSchedulerNode(int* ret) {
+  return kv_role_is("scheduler", ret);
+}
+
+MXTPU_API int MXKVStoreGetNumDeadNode(KVStoreHandle h, const int node_id,
+                                      int* number, const int timeout_sec) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("kv_num_dead",
+                Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(h),
+                              node_id, timeout_sec),
+                &v) != 0)
+    return -1;
+  *number = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetGradientCompression(KVStoreHandle h,
+                                              uint32_t num_params,
+                                              const char** keys,
+                                              const char** vals) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_set_gc",
+                     Py_BuildValue("(ONN)", reinterpret_cast<PyObject*>(h),
+                                   str_list(num_params, keys),
+                                   str_list(num_params, vals)));
+}
+
+MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
+                                             const char* cmd_body) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_send_command",
+                     Py_BuildValue("(Ois)", reinterpret_cast<PyObject*>(h),
+                                   cmd_id, cmd_body));
+}
+
+MXTPU_API int MXKVStoreSetBarrierBeforeExit(KVStoreHandle h,
+                                            const int do_barrier) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_set_barrier_before_exit",
+                     Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(h),
+                                   do_barrier));
+}
+
+MXTPU_API int MXKVStoreRunServer(KVStoreHandle h,
+                                 MXKVStoreServerController controller,
+                                 void* controller_handle) {
+  (void)controller;         // command handling is built into the server
+  (void)controller_handle;  // (profiler control, heartbeats)
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_run_server",
+                     Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h)));
+}
+
+MXTPU_API int MXInitPSEnv(uint32_t num_vars, const char** keys,
+                          const char** vals) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_init_ps_env",
+                     Py_BuildValue("(NN)", str_list(num_vars, keys),
+                                   str_list(num_vars, vals)));
+}
+
+MXTPU_API int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdater updater,
+                                  void* updater_handle) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call(
+      "kv_set_updater",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(h),
+                    PyLong_FromVoidPtr(reinterpret_cast<void*>(updater)),
+                    PyLong_FromVoidPtr(updater_handle), 0));
+}
+
+MXTPU_API int MXKVStoreSetUpdaterEx(KVStoreHandle h,
+                                    MXKVStoreUpdater updater,
+                                    MXKVStoreStrUpdater str_updater,
+                                    void* updater_handle) {
+  if (str_updater == nullptr)
+    return MXKVStoreSetUpdater(h, updater, updater_handle);
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call(
+      "kv_set_updater",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(h),
+                    PyLong_FromVoidPtr(
+                        reinterpret_cast<void*>(str_updater)),
+                    PyLong_FromVoidPtr(updater_handle), 1));
+}
+
+MXTPU_API int MXKVStoreInitEx(KVStoreHandle h, uint32_t num,
+                              const char** keys, NDArrayHandle* vals) {
+  return MXKVStoreInit(h, num, keys, vals);
+}
+
+MXTPU_API int MXKVStorePushEx(KVStoreHandle h, uint32_t num,
+                              const char** keys, NDArrayHandle* vals,
+                              int priority) {
+  return MXKVStorePush(h, num, keys, vals, priority);
+}
+
+MXTPU_API int MXKVStorePullEx(KVStoreHandle h, uint32_t num,
+                              const char** keys, NDArrayHandle* outs,
+                              int priority) {
+  return MXKVStorePull(h, num, keys, outs, priority);
+}
+
+// ----------------------------------------------------- data iter extras
+
+MXTPU_API int MXDataIterGetIndex(DataIterHandle h, uint64_t** out_index,
+                                 uint64_t* out_size) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* r = bridge_call("iter_index", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_u64.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_u64.push_back(
+        (uint64_t)PyLong_AsUnsignedLongLong(PyList_GetItem(r, i)));
+  *out_index = tl_u64.data();
+  *out_size = (uint64_t)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDataIterGetIterInfo(const char* name, const char** out_name,
+                                    const char** out_desc) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* r = bridge_call("iter_info", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  uint32_t n = 0;
+  const char** pair = stash_strings(r, &n);
+  *out_name = n > 0 ? pair[0] : "";
+  *out_desc = n > 1 ? pair[1] : "";
+  Py_DECREF(r);
+  return 0;
+}
+
+// -------------------------------------------------------- quantization
+
+MXTPU_API int MXQuantizeSymbol(SymbolHandle sym, SymbolHandle* out,
+                               uint32_t num_excluded, const char** excluded,
+                               const char* quantized_dtype) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("quantize_symbol",
+                     Py_BuildValue("(ONs)", reinterpret_cast<PyObject*>(sym),
+                                   str_list(num_excluded, excluded),
+                                   quantized_dtype),
+                     out);
+}
+
+MXTPU_API int MXSetCalibTableToQuantizedSymbol(
+    SymbolHandle qsym, uint32_t num_layers, const char** layer_names,
+    const float* min_ranges, const float* max_ranges, SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* mins = PyList_New(num_layers);
+  PyObject* maxs = PyList_New(num_layers);
+  for (uint32_t i = 0; i < num_layers; ++i) {
+    PyList_SetItem(mins, i, PyFloat_FromDouble(min_ranges[i]));
+    PyList_SetItem(maxs, i, PyFloat_FromDouble(max_ranges[i]));
+  }
+  return handle_call("calibrate_quantized_symbol",
+                     Py_BuildValue("(ONNN)",
+                                   reinterpret_cast<PyObject*>(qsym),
+                                   str_list(num_layers, layer_names), mins,
+                                   maxs),
+                     out);
+}
+
+// --------------------------------------- explicit-array executor bind
+
+MXTPU_API int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             uint32_t len, NDArrayHandle* in_args,
+                             NDArrayHandle* arg_grad_store,
+                             const uint32_t* grad_req_type,
+                             uint32_t aux_states_len,
+                             NDArrayHandle* aux_states,
+                             ExecutorHandle* out) {
+  (void)dev_type;  // arrays carry their context
+  (void)dev_id;
+  static const char* kReq[] = {"null", "write", "write", "add"};
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* reqs = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    uint32_t rq = grad_req_type != nullptr ? grad_req_type[i] : 1u;
+    if (rq > 3) rq = 1;
+    PyList_SetItem(reqs, i, PyUnicode_FromString(kReq[rq]));
+  }
+  return handle_call(
+      "executor_bind_explicit",
+      Py_BuildValue("(ONNNN)", reinterpret_cast<PyObject*>(sym),
+                    handle_list(len, in_args),
+                    handle_list(len, arg_grad_store), reqs,
+                    handle_list(aux_states_len, aux_states)),
+      out);
+}
+
+MXTPU_API int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                              uint32_t num_map_keys, const char** map_keys,
+                              const int* map_dev_types,
+                              const int* map_dev_ids, uint32_t len,
+                              NDArrayHandle* in_args,
+                              NDArrayHandle* arg_grad_store,
+                              const uint32_t* grad_req_type,
+                              uint32_t aux_states_len,
+                              NDArrayHandle* aux_states,
+                              ExecutorHandle* out) {
+  (void)map_keys;
+  (void)map_dev_types;
+  (void)map_dev_ids;
+  if (num_map_keys != 0) {
+    set_last_error("group2ctx maps are not supported through the C ABI; "
+                   "use the Python model_parallel API");
+    return -1;
+  }
+  return MXExecutorBind(sym, dev_type, dev_id, len, in_args,
+                        arg_grad_store, grad_req_type, aux_states_len,
+                        aux_states, out);
+}
+
+MXTPU_API int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                               uint32_t num_map_keys, const char** map_keys,
+                               const int* map_dev_types,
+                               const int* map_dev_ids, uint32_t len,
+                               NDArrayHandle* in_args,
+                               NDArrayHandle* arg_grad_store,
+                               const uint32_t* grad_req_type,
+                               uint32_t aux_states_len,
+                               NDArrayHandle* aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle* out) {
+  (void)shared_exec;  // memory sharing is XLA's job here
+  return MXExecutorBindX(sym, dev_type, dev_id, num_map_keys, map_keys,
+                         map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
+}
+
+MXTPU_API int MXExecutorBackwardEx(ExecutorHandle exec, uint32_t num_ograds,
+                                   NDArrayHandle* ograds) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("executor_backward_ex",
+                     Py_BuildValue("(ON)",
+                                   reinterpret_cast<PyObject*>(exec),
+                                   handle_list(num_ograds, ograds)));
+}
+
+MXTPU_API int MXExecutorPrint(ExecutorHandle exec, const char** out_str) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(exec));
+  PyObject* r = bridge_call("executor_print", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  tl_strings.clear();
+  tl_cstrs.clear();
+  tl_strings.emplace_back(PyUnicode_AsUTF8(r));
+  *out_str = tl_strings.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorGetOptimizedSymbol(ExecutorHandle exec,
+                                           SymbolHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("executor_optimized_symbol",
+                     Py_BuildValue("(O)",
+                                   reinterpret_cast<PyObject*>(exec)),
+                     out);
+}
+
+// -------------------------------------------------------- runtime misc
+
+MXTPU_API int MXNotifyShutdown(void) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("nd_wait_all", PyTuple_New(0));
+}
+
+MXTPU_API int MXSetNumOMPThreads(int thread_num) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("set_omp_threads", Py_BuildValue("(i)", thread_num));
+}
+
+MXTPU_API int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  (void)dev_type;  // one global RNG stream (jax key threading)
+  (void)dev_id;
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("random_seed", Py_BuildValue("(i)", seed));
+}
+
+MXTPU_API int MXGetGPUMemoryInformation(int dev, int* free_mem,
+                                        int* total_mem) {
+  (void)dev;
+  (void)free_mem;
+  (void)total_mem;
+  set_last_error("no GPU devices in a TPU build");
+  return -1;
+}
+
+// ---------------------------------------------------------- batch 5b
+
+MXTPU_API int MXImperativeInvokeEx(const char* op_name, int num_inputs,
+                                   NDArrayHandle* inputs, int* num_outputs,
+                                   NDArrayHandle** outputs, int num_params,
+                                   const char** param_keys,
+                                   const char** param_vals,
+                                   const int** out_stypes) {
+  if (MXImperativeInvoke(op_name, num_inputs, inputs, num_outputs, outputs,
+                         num_params, param_keys, param_vals) != 0)
+    return -1;
+  tl_ints.assign((size_t)*num_outputs, 0);  // dense everywhere
+  *out_stypes = tl_ints.data();
+  return 0;
+}
+
+MXTPU_API int MXKVStorePullRowSparse(KVStoreHandle h, uint32_t num,
+                                     const char** keys,
+                                     NDArrayHandle* outs,
+                                     NDArrayHandle* row_ids, int priority) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_pull_rsp",
+                     Py_BuildValue("(ONNNi)",
+                                   reinterpret_cast<PyObject*>(h),
+                                   str_list(num, keys),
+                                   handle_list(num, outs),
+                                   handle_list(num, row_ids), priority));
+}
+
+MXTPU_API int MXKVStorePullRowSparseEx(KVStoreHandle h, uint32_t num,
+                                       const char** keys,
+                                       NDArrayHandle* outs,
+                                       NDArrayHandle* row_ids,
+                                       int priority) {
+  return MXKVStorePullRowSparse(h, num, keys, outs, row_ids, priority);
+}
+
+MXTPU_API int MXKVStorePullWithSparse(KVStoreHandle h, uint32_t num,
+                                      const char** keys,
+                                      NDArrayHandle* outs, int priority,
+                                      int ignore_sparse) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("kv_pull_sparse",
+                     Py_BuildValue("(ONNii)",
+                                   reinterpret_cast<PyObject*>(h),
+                                   str_list(num, keys),
+                                   handle_list(num, outs), priority,
+                                   ignore_sparse));
+}
+
+MXTPU_API int MXKVStorePullWithSparseEx(KVStoreHandle h, uint32_t num,
+                                        const char** keys,
+                                        NDArrayHandle* outs, int priority,
+                                        int ignore_sparse) {
+  return MXKVStorePullWithSparse(h, num, keys, outs, priority,
+                                 ignore_sparse);
+}
+
+// plain-name profiler aliases (reference has both the process-scoped
+// and the legacy names; same behavior here)
+MXTPU_API int MXSetProfilerConfig(int num_params, const char** keys,
+                                  const char** vals) {
+  return MXSetProcessProfilerConfig(num_params, keys, vals);
+}
+
+MXTPU_API int MXSetProfilerState(int state) {
+  return MXSetProcessProfilerState(state);
+}
+
+MXTPU_API int MXDumpProfile(int finished) {
+  return MXDumpProcessProfile(finished);
+}
+
+MXTPU_API int MXProfilePause(int paused) {
+  return MXProcessProfilePause(paused);
+}
+
+MXTPU_API int MXProfileCreateEvent(const char* name, ProfileHandle* out) {
+  return profile_create("event", nullptr, name, out);
+}
+
+MXTPU_API int MXSymbolGrad(SymbolHandle sym, uint32_t num_wrt,
+                           const char** wrt, SymbolHandle* out) {
+  // faithful to the reference: c_api_symbolic.cc:640 MXSymbolGrad is
+  // LOG(FATAL) "not implemented" — bind with grad_req + backward
+  Gil gil;
+  if (!gil.ok) return -1;
+  return handle_call("symbol_grad",
+                     Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(sym),
+                                   str_list(num_wrt, wrt)),
+                     out);
+}
+
+MXTPU_API int MXNDArrayGetGradState(NDArrayHandle h, int* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  long v;
+  if (long_call("nd_get_fresh_grad",
+                Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h)),
+                &v) != 0)
+    return -1;
+  *out = (int)v;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySetGradState(NDArrayHandle h, int state) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call("nd_set_fresh_grad",
+                     Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(h),
+                                   state));
+}
+
+// DLPack over a host snapshot (capsule consumed per the protocol:
+// renamed used_dltensor, tensor freed via MXNDArrayCallDLPackDeleter)
+MXTPU_API int MXNDArrayToDLPack(NDArrayHandle h, DLManagedTensorHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(h));
+  PyObject* capsule = bridge_call("nd_to_dlpack", args);
+  Py_DECREF(args);
+  if (capsule == nullptr) return -1;
+  void* ptr = PyCapsule_GetPointer(capsule, "dltensor");
+  if (ptr == nullptr) {
+    capture_py_error();
+    Py_DECREF(capsule);
+    return -1;
+  }
+  PyCapsule_SetName(capsule, "used_dltensor");
+  PyCapsule_SetDestructor(capsule, nullptr);
+  Py_DECREF(capsule);
+  *out = ptr;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFromDLPack(DLManagedTensorHandle dlm,
+                                  NDArrayHandle* out) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  PyObject* capsule = PyCapsule_New(dlm, "dltensor", nullptr);
+  if (capsule == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  return handle_call("nd_from_dlpack", Py_BuildValue("(N)", capsule), out);
+}
+
+MXTPU_API int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlm) {
+  if (dlm == nullptr) return 0;
+  // minimal DLManagedTensor layout: the deleter lives after DLTensor
+  // (data, device{2xint32}, ndim, dtype{4 bytes}, shape*, strides*,
+  // byte_offset) and manager_ctx — offsets per dlpack.h v0.x ABI
+  struct MiniDLTensor {
+    void* data;
+    int32_t device_type, device_id;
+    int32_t ndim;
+    uint8_t code, bits;
+    uint16_t lanes;
+    int64_t* shape;
+    int64_t* strides;
+    uint64_t byte_offset;
+  };
+  struct MiniDLManaged {
+    MiniDLTensor dl_tensor;
+    void* manager_ctx;
+    void (*deleter)(MiniDLManaged*);
+  };
+  auto* m = reinterpret_cast<MiniDLManaged*>(dlm);
+  if (m->deleter != nullptr) m->deleter(m);
+  return 0;
+}
+
+MXTPU_API int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                           ExecutorMonitorCallback callback,
+                                           void* callback_handle) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call(
+      "executor_set_monitor",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(exec),
+                    PyLong_FromVoidPtr(reinterpret_cast<void*>(callback)),
+                    PyLong_FromVoidPtr(callback_handle), 0));
+}
+
+MXTPU_API int MXExecutorSetMonitorCallbackEX(
+    ExecutorHandle exec, ExecutorMonitorCallback callback,
+    void* callback_handle, int monitor_all) {
+  Gil gil;
+  if (!gil.ok) return -1;
+  return simple_call(
+      "executor_set_monitor",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(exec),
+                    PyLong_FromVoidPtr(reinterpret_cast<void*>(callback)),
+                    PyLong_FromVoidPtr(callback_handle), monitor_all));
+}

@@ -551,3 +551,657 @@ def test_cpp_symbol_building(tmp_path, c_api_lib):
                        text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "out 2 4" in r.stdout and "SYMBUILD OK" in r.stdout, r.stdout
+
+
+def test_c_api_batch5_ndarray_autograd_cachedop(tmp_path, c_api_lib):
+    """Batch-5 ABI part 1: NDArray extras (CreateEx/None/Detach/grad/
+    Reshape64/GetData/LoadFromBuffer), sparse create + accessors +
+    format check, autograd state + BackwardEx, CachedOp."""
+    import ctypes
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # CreateEx (dev_type 1 = cpu) + GetData snapshot
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(h)) == 0
+    vals = (ctypes.c_float * 6)(*[float(i) for i in range(6)])
+    assert lib.MXNDArraySyncCopyFromCPU(h, vals, 6 * 4) == 0
+    assert lib.MXNDArrayWaitToWrite(h) == 0
+    p = ctypes.c_void_p()
+    assert lib.MXNDArrayGetData(h, ctypes.byref(p)) == 0
+    snap = ctypes.cast(p, ctypes.POINTER(ctypes.c_float * 6)).contents
+    assert list(snap) == [float(i) for i in range(6)]
+
+    # CreateNone
+    none_h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateNone(ctypes.byref(none_h)) == 0
+    ndim = ctypes.c_uint32()
+    oshape = (ctypes.c_uint32 * 32)()
+    assert lib.MXNDArrayGetShape(none_h, ctypes.byref(ndim), oshape) == 0
+    assert ndim.value == 1 and oshape[0] == 0
+    lib.MXNDArrayFree(none_h)
+
+    # Reshape64: specials 0 (copy) and -1 (infer), reverse from right
+    dims = (ctypes.c_int64 * 2)(3, -1)
+    r1 = ctypes.c_void_p()
+    assert lib.MXNDArrayReshape64(h, 2, dims, 0, ctypes.byref(r1)) == 0
+    assert lib.MXNDArrayGetShape(r1, ctypes.byref(ndim), oshape) == 0
+    assert (ndim.value, oshape[0], oshape[1]) == (2, 3, 2)
+    lib.MXNDArrayFree(r1)
+
+    # grad: none attached -> NULL; Detach returns a new handle
+    g = ctypes.c_void_p(1234)
+    assert lib.MXNDArrayGetGrad(h, ctypes.byref(g)) == 0
+    assert not g.value
+    d = ctypes.c_void_p()
+    assert lib.MXNDArrayDetach(h, ctypes.byref(d)) == 0
+    lib.MXNDArrayFree(d)
+
+    # LoadFromBuffer round-trip via MXNDArraySave bytes
+    fname = str(tmp_path / "arrs.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"w")
+    arrs = (ctypes.c_void_p * 1)(h.value)
+    assert lib.MXNDArraySave(fname, 1, arrs, keys) == 0
+    raw = open(fname, "rb").read()
+    out_num = ctypes.c_uint32()
+    out_arrs = ctypes.POINTER(ctypes.c_void_p)()
+    name_num = ctypes.c_uint32()
+    out_names = ctypes.POINTER(ctypes.c_char_p)()
+    lib.MXNDArrayLoadFromBuffer.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    assert lib.MXNDArrayLoadFromBuffer(
+        raw, len(raw), ctypes.byref(out_num), ctypes.byref(out_arrs),
+        ctypes.byref(name_num), ctypes.byref(out_names)) == 0
+    assert out_num.value == 1 and out_names[0] == b"w"
+    got = (ctypes.c_float * 6)()
+    assert lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(out_arrs[0]), got, 6 * 4) == 0
+    assert list(got) == [float(i) for i in range(6)]
+    lib.MXNDArrayFree(ctypes.c_void_p(out_arrs[0]))
+
+    # sparse: rsp from data+indices, accessors, format check
+    dshape = (ctypes.c_uint32 * 2)(2, 3)
+    dh = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(dshape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(dh)) == 0
+    dv = (ctypes.c_float * 6)(*[1.0] * 6)
+    assert lib.MXNDArraySyncCopyFromCPU(dh, dv, 6 * 4) == 0
+    # indices are int32 by policy (ndarray/sparse.py int64->int32 with
+    # bounds check; jax x64 is off)
+    ishape = (ctypes.c_uint32 * 1)(2)
+    ih = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(ishape, 1, 1, 0, 0, 4,
+                                 ctypes.byref(ih)) == 0
+    iv = (ctypes.c_int32 * 2)(0, 3)
+    assert lib.MXNDArraySyncCopyFromCPU(ih, iv, 2 * 4) == 0
+    fshape = (ctypes.c_uint32 * 2)(5, 3)
+    aux = (ctypes.c_void_p * 1)(ih.value)
+    sp = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateSparseEx(1, fshape, 2, dh, 1, aux,
+                                       ctypes.byref(sp)) == 0
+    st = ctypes.c_int()
+    assert lib.MXNDArrayGetStorageType(sp, ctypes.byref(st)) == 0
+    assert st.value == 1
+    assert lib.MXNDArraySyncCheckFormat(sp, 1) == 0
+    av = ctypes.c_void_p()
+    assert lib.MXNDArrayGetAuxNDArray(sp, 0, ctypes.byref(av)) == 0
+    at = ctypes.c_int()
+    assert lib.MXNDArrayGetAuxType(sp, 0, ctypes.byref(at)) == 0
+    assert at.value == 4  # int32 indices (framework-wide sparse policy)
+    dn = ctypes.c_void_p()
+    assert lib.MXNDArrayGetDataNDArray(sp, ctypes.byref(dn)) == 0
+    assert lib.MXNDArrayGetShape(dn, ctypes.byref(ndim), oshape) == 0
+    assert (ndim.value, oshape[0], oshape[1]) == (2, 2, 3)
+    for hh in (av, dn, sp, dh, ih):
+        lib.MXNDArrayFree(hh)
+
+    # bad rsp (indices out of bounds) must fail the full check
+    ih2 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(ishape, 1, 1, 0, 0, 4,
+                                 ctypes.byref(ih2)) == 0
+    bad = (ctypes.c_int32 * 2)(0, 99)
+    assert lib.MXNDArraySyncCopyFromCPU(ih2, bad, 2 * 4) == 0
+    dh2 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(dshape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(dh2)) == 0
+    sp2 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateSparseEx(1, fshape, 2, dh2, 1,
+                                       (ctypes.c_void_p * 1)(ih2.value),
+                                       ctypes.byref(sp2)) == 0
+    assert lib.MXNDArraySyncCheckFormat(sp2, 1) == -1
+    assert b"out of bounds" in lib.MXGetLastError()
+    for hh in (sp2, dh2, ih2):
+        lib.MXNDArrayFree(hh)
+
+    # autograd state + BackwardEx with explicit variables
+    cur = ctypes.c_int(-1)
+    assert lib.MXAutogradIsRecording(ctypes.byref(cur)) == 0
+    assert cur.value == 0
+    assert lib.MXAutogradIsTraining(ctypes.byref(cur)) == 0
+    prev = ctypes.c_int(-1)
+    assert lib.MXAutogradSetIsTraining(1, ctypes.byref(prev)) == 0
+    assert lib.MXAutogradIsTraining(ctypes.byref(cur)) == 0
+    assert cur.value == 1
+    assert lib.MXAutogradSetIsTraining(prev.value, None) == 0
+
+    x = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(x)) == 0
+    assert lib.MXNDArraySyncCopyFromCPU(x, vals, 6 * 4) == 0
+    assert lib.MXAutogradMarkVariables(1, (ctypes.c_void_p * 1)(x.value)) \
+        == 0
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXImperativeInvoke(b"square", 1,
+                                  (ctypes.c_void_p * 1)(x.value),
+                                  ctypes.byref(n_out), ctypes.byref(outs),
+                                  0, None, None) == 0
+    y = ctypes.c_void_p(outs[0])
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    grads = ctypes.POINTER(ctypes.c_void_p)()
+    stypes = ctypes.POINTER(ctypes.c_int)()
+    assert lib.MXAutogradBackwardEx(
+        1, (ctypes.c_void_p * 1)(y.value), None, 1,
+        (ctypes.c_void_p * 1)(x.value), 0, 0, 1, ctypes.byref(grads),
+        ctypes.byref(stypes)) == 0
+    gv = (ctypes.c_float * 6)()
+    assert lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(grads[0]), gv, 6 * 4) == 0
+    assert list(gv) == [2.0 * v for v in vals]
+    assert stypes[0] == 0
+    lib.MXNDArrayFree(ctypes.c_void_p(grads[0]))
+    lib.MXNDArrayFree(y)
+
+    # CachedOp over relu(x) built from C symbols
+    var = ctypes.c_void_p()
+    assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(var)) == 0
+    act = ctypes.c_void_p()
+    akeys = (ctypes.c_char_p * 1)(b"act_type")
+    avals = (ctypes.c_char_p * 1)(b"relu")
+    assert lib.MXSymbolCreateAtomicSymbol(b"Activation", 1, akeys, avals,
+                                          b"act", ctypes.byref(act)) == 0
+    assert lib.MXSymbolCompose(act, b"act", 1,
+                               (ctypes.c_char_p * 1)(b"data"),
+                               (ctypes.c_void_p * 1)(var.value)) == 0
+    cop = ctypes.c_void_p()
+    assert lib.MXCreateCachedOpEx(act, 0, None, None,
+                                  ctypes.byref(cop)) == 0
+    neg = (ctypes.c_float * 6)(-1, 2, -3, 4, -5, 6)
+    xin = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0,
+                                 ctypes.byref(xin)) == 0
+    assert lib.MXNDArraySyncCopyFromCPU(xin, neg, 6 * 4) == 0
+    on = ctypes.c_int()
+    couts = ctypes.POINTER(ctypes.c_void_p)()
+    cst = ctypes.POINTER(ctypes.c_int)()
+    assert lib.MXInvokeCachedOpEx(cop, 1,
+                                  (ctypes.c_void_p * 1)(xin.value),
+                                  ctypes.byref(on), ctypes.byref(couts),
+                                  ctypes.byref(cst)) == 0
+    assert on.value == 1 and cst[0] == 0
+    ov = (ctypes.c_float * 6)()
+    assert lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(couts[0]), ov, 6 * 4) == 0
+    assert list(ov) == [0, 2, 0, 4, 0, 6]
+    lib.MXNDArrayFree(ctypes.c_void_p(couts[0]))
+    assert lib.MXFreeCachedOp(cop) == 0
+    for hh in (xin, act, var, x, h):
+        (lib.MXNDArrayFree if hh in (xin, x, h) else lib.MXSymbolFree)(hh)
+
+
+def test_c_api_batch5_symbol_breadth(tmp_path, c_api_lib):
+    """Batch-5 ABI part 2: symbol file IO, graph walking, infer
+    shape/type, creator registry, quantization passes."""
+    import ctypes
+    import mxnet_tpu as mx
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    json_path = str(tmp_path / "net.json")
+    with open(json_path, "w") as f:
+        f.write(act.tojson())
+
+    sym = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromFile(json_path.encode(),
+                                      ctypes.byref(sym)) == 0
+    out_path = str(tmp_path / "net2.json")
+    assert lib.MXSymbolSaveToFile(sym, out_path.encode()) == 0
+    assert mx.sym.load(out_path).list_arguments() == \
+        act.list_arguments()
+
+    # names / outputs / internals / children / inputs
+    name = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    assert lib.MXSymbolGetName(sym, ctypes.byref(name),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and name.value == b"act"
+    n_out = ctypes.c_uint32()
+    assert lib.MXSymbolGetNumOutputs(sym, ctypes.byref(n_out)) == 0
+    assert n_out.value == 1
+    o0 = ctypes.c_void_p()
+    assert lib.MXSymbolGetOutput(sym, 0, ctypes.byref(o0)) == 0
+    internals = ctypes.c_void_p()
+    assert lib.MXSymbolGetInternals(sym, ctypes.byref(internals)) == 0
+    n_int = ctypes.c_uint32()
+    names_p = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListOutputs(internals, ctypes.byref(n_int),
+                                   ctypes.byref(names_p)) == 0
+    assert n_int.value >= 2  # fc_output + act_output at least
+    children = ctypes.c_void_p()
+    assert lib.MXSymbolGetChildren(sym, ctypes.byref(children)) == 0
+    inputs = ctypes.POINTER(ctypes.c_void_p)()
+    n_in = ctypes.c_int()
+    assert lib.MXSymbolGetInputSymbols(sym, ctypes.byref(inputs),
+                                       ctypes.byref(n_in)) == 0
+    assert n_in.value == 3  # data, fc_weight, fc_bias
+    for i in range(n_in.value):
+        lib.MXSymbolFree(ctypes.c_void_p(inputs[i]))
+
+    # attrs
+    assert lib.MXSymbolSetAttr(sym, b"color", b"blue") == 0
+    val = ctypes.c_char_p()
+    assert lib.MXSymbolGetAttr(sym, b"color", ctypes.byref(val),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and val.value == b"blue"
+    n_kv = ctypes.c_uint32()
+    kv_p = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListAttrShallow(sym, ctypes.byref(n_kv),
+                                       ctypes.byref(kv_p)) == 0
+    shallow = {kv_p[2 * i]: kv_p[2 * i + 1] for i in range(n_kv.value)}
+    assert shallow.get(b"color") == b"blue"
+    s = ctypes.c_char_p()
+    assert lib.MXSymbolPrint(sym, ctypes.byref(s)) == 0
+    assert b"act" in s.value
+
+    # infer shape: data (2, 8) -> out (2, 4); weights inferred
+    keys = (ctypes.c_char_p * 1)(b"data")
+    ind_ptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape_data = (ctypes.c_uint32 * 2)(2, 8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u32pp = ctypes.POINTER(u32p)
+    in_sz = ctypes.c_uint32()
+    in_nd = u32p()
+    in_dat = u32pp()
+    out_sz = ctypes.c_uint32()
+    out_nd = u32p()
+    out_dat = u32pp()
+    aux_sz = ctypes.c_uint32()
+    aux_nd = u32p()
+    aux_dat = u32pp()
+    comp = ctypes.c_int()
+    assert lib.MXSymbolInferShape(
+        sym, 1, keys, ind_ptr, shape_data, ctypes.byref(in_sz),
+        ctypes.byref(in_nd), ctypes.byref(in_dat), ctypes.byref(out_sz),
+        ctypes.byref(out_nd), ctypes.byref(out_dat), ctypes.byref(aux_sz),
+        ctypes.byref(aux_nd), ctypes.byref(aux_dat),
+        ctypes.byref(comp)) == 0
+    assert comp.value == 1
+    assert in_sz.value == 3 and out_sz.value == 1
+    assert [out_dat[0][j] for j in range(out_nd[0])] == [2, 4]
+    wt = [in_dat[1][j] for j in range(in_nd[1])]
+    assert wt == [4, 8]  # fc_weight (num_hidden, input_dim)
+
+    # infer type: float32 propagates
+    tdata = (ctypes.c_int * 1)(0)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    it_sz = ctypes.c_uint32()
+    it_d = i32p()
+    ot_sz = ctypes.c_uint32()
+    ot_d = i32p()
+    at_sz = ctypes.c_uint32()
+    at_d = i32p()
+    assert lib.MXSymbolInferType(
+        sym, 1, keys, tdata, ctypes.byref(it_sz), ctypes.byref(it_d),
+        ctypes.byref(ot_sz), ctypes.byref(ot_d), ctypes.byref(at_sz),
+        ctypes.byref(at_d), ctypes.byref(comp)) == 0
+    assert comp.value == 1 and ot_d[0] == 0
+
+    # creator registry
+    n_cr = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n_cr), ctypes.byref(creators)) == 0
+    assert n_cr.value > 300
+    cname = ctypes.c_char_p()
+    first = ctypes.c_void_p(creators[0])
+    assert lib.MXSymbolGetAtomicSymbolName(first,
+                                           ctypes.byref(cname)) == 0
+    assert cname.value
+    desc = ctypes.c_char_p()
+    n_args = ctypes.c_uint32()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    kv_var = ctypes.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        first, ctypes.byref(cname), ctypes.byref(desc),
+        ctypes.byref(n_args), ctypes.byref(an), ctypes.byref(ad),
+        ctypes.byref(kv_var)) == 0
+
+    # quantization passes
+    qsym = ctypes.c_void_p()
+    assert lib.MXQuantizeSymbol(sym, ctypes.byref(qsym), 0, None,
+                                b"int8") == 0
+    qn = ctypes.c_char_p()
+    assert lib.MXSymbolPrint(qsym, ctypes.byref(qn)) == 0
+    assert b"quantize" in qn.value
+    lnames = (ctypes.c_char_p * 1)(b"fc")
+    mins = (ctypes.c_float * 1)(-1.0)
+    maxs = (ctypes.c_float * 1)(1.0)
+    cal = ctypes.c_void_p()
+    assert lib.MXSetCalibTableToQuantizedSymbol(
+        qsym, 1, lnames, mins, maxs, ctypes.byref(cal)) == 0
+    for hh in (cal, qsym, children, internals, o0, sym):
+        lib.MXSymbolFree(hh)
+
+
+def test_c_api_batch5_recordio_kv_exec_misc(tmp_path, c_api_lib):
+    """Batch-5 ABI part 3: RecordIO reader/writer, kvstore roles +
+    updater callback + compression, iter info, explicit-array bind,
+    runtime misc."""
+    import ctypes
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXRecordIOWriterWriteRecord.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.MXRecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+
+    # RecordIO round trip + seek/tell
+    rec_path = str(tmp_path / "t.rec").encode()
+    w = ctypes.c_void_p()
+    assert lib.MXRecordIOWriterCreate(rec_path, ctypes.byref(w)) == 0
+    assert lib.MXRecordIOWriterWriteRecord(w, b"hello", 5) == 0
+    pos = ctypes.c_size_t()
+    assert lib.MXRecordIOWriterTell(w, ctypes.byref(pos)) == 0
+    assert pos.value > 0
+    assert lib.MXRecordIOWriterWriteRecord(w, b"worlds!", 7) == 0
+    assert lib.MXRecordIOWriterFree(w) == 0
+    r = ctypes.c_void_p()
+    assert lib.MXRecordIOReaderCreate(rec_path, ctypes.byref(r)) == 0
+    buf = ctypes.c_char_p()
+    size = ctypes.c_size_t()
+    assert lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                          ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"hello"
+    assert lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                          ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"worlds!"
+    assert lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                          ctypes.byref(size)) == 0
+    assert size.value == 0  # EOF
+    assert lib.MXRecordIOReaderSeek(r, 0) == 0
+    assert lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                          ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"hello"
+    assert lib.MXRecordIOReaderFree(r) == 0
+
+    # kvstore roles (no env role set -> worker)
+    ret = ctypes.c_int(-1)
+    assert lib.MXKVStoreIsWorkerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 1
+    assert lib.MXKVStoreIsServerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 0
+    assert lib.MXKVStoreIsSchedulerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 0
+
+    # local kv: InitEx/PushEx/PullEx aliases + updater callback +
+    # compression + dead-node + barrier flag
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    shape = (ctypes.c_uint32 * 1)(4)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(h)) == 0
+    ones = (ctypes.c_float * 4)(1, 1, 1, 1)
+    assert lib.MXNDArraySyncCopyFromCPU(h, ones, 16) == 0
+    keys = (ctypes.c_char_p * 1)(b"w")
+    arrs = (ctypes.c_void_p * 1)(h.value)
+    assert lib.MXKVStoreInitEx(kv, 1, keys, arrs) == 0
+
+    seen = {}
+    UPD = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+
+    @UPD
+    def str_updater(key, recv, local, handle):
+        # emulate sgd: local -= 0.5 * recv, through the ABI itself
+        seen["key"] = key
+        got = (ctypes.c_float * 4)()
+        lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(recv), got, 16)
+        cur = (ctypes.c_float * 4)()
+        lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(local), cur, 16)
+        upd = (ctypes.c_float * 4)(*[c - 0.5 * g
+                                     for c, g in zip(cur, got)])
+        lib.MXNDArraySyncCopyFromCPU(ctypes.c_void_p(local), upd, 16)
+
+    assert lib.MXKVStoreSetUpdaterEx(kv, None, str_updater, None) == 0
+    g = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(g)) == 0
+    twos = (ctypes.c_float * 4)(2, 2, 2, 2)
+    assert lib.MXNDArraySyncCopyFromCPU(g, twos, 16) == 0
+    assert lib.MXKVStorePushEx(kv, 1, keys,
+                               (ctypes.c_void_p * 1)(g.value), 0) == 0
+    out = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(out)) == 0
+    assert lib.MXKVStorePullEx(kv, 1, keys,
+                               (ctypes.c_void_p * 1)(out.value), 0) == 0
+    got = (ctypes.c_float * 4)()
+    assert lib.MXNDArraySyncCopyToCPU(out, got, 16) == 0
+    assert list(got) == [0.0] * 4  # 1 - 0.5*2
+    assert seen["key"] == b"w"
+
+    n_dead = ctypes.c_int(-1)
+    assert lib.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(n_dead),
+                                       5) == 0
+    assert n_dead.value == 0
+    gck = (ctypes.c_char_p * 2)(b"type", b"threshold")
+    gcv = (ctypes.c_char_p * 2)(b"2bit", b"0.5")
+    assert lib.MXKVStoreSetGradientCompression(kv, 2, gck, gcv) == 0
+    assert lib.MXKVStoreSetBarrierBeforeExit(kv, 1) == 0
+    lib.MXKVStoreFree(kv)
+
+    # MXInitPSEnv sets env for later kv creation
+    ek = (ctypes.c_char_p * 1)(b"MXNET_TPU_TEST_PSENV")
+    ev = (ctypes.c_char_p * 1)(b"42")
+    assert lib.MXInitPSEnv(1, ek, ev) == 0
+    import os
+    assert os.environ.get("MXNET_TPU_TEST_PSENV") == "42"
+
+    # iter info
+    iname = ctypes.c_char_p()
+    idesc = ctypes.c_char_p()
+    assert lib.MXDataIterGetIterInfo(b"MNISTIter", ctypes.byref(iname),
+                                     ctypes.byref(idesc)) == 0
+    assert iname.value == b"MNISTIter"
+
+    # explicit-array bind: y = 2*x via elemwise; grad_req write
+    import mxnet_tpu as mx
+    x = mx.sym.Variable("x")
+    y = mx.sym.square(x, name="sq")
+    xa = ctypes.c_void_p()
+    s2 = (ctypes.c_uint32 * 1)(3)
+    assert lib.MXNDArrayCreateEx(s2, 1, 1, 0, 0, 0,
+                                 ctypes.byref(xa)) == 0
+    xv = (ctypes.c_float * 3)(1, 2, 3)
+    assert lib.MXNDArraySyncCopyFromCPU(xa, xv, 12) == 0
+    ga = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(s2, 1, 1, 0, 0, 0,
+                                 ctypes.byref(ga)) == 0
+    # hand the python symbol to the C side (in-process handle = PyObject*)
+    sym_h = ctypes.c_void_p(id(y))
+    exe = ctypes.c_void_p()
+    reqs = (ctypes.c_uint32 * 1)(1)
+    assert lib.MXExecutorBind(sym_h, 1, 0, 1,
+                              (ctypes.c_void_p * 1)(xa.value),
+                              (ctypes.c_void_p * 1)(ga.value), reqs, 0,
+                              None, ctypes.byref(exe)) == 0
+    assert lib.MXExecutorForward(exe, 1) == 0
+    n_outs = ctypes.c_uint32()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXExecutorOutputs(exe, ctypes.byref(n_outs),
+                                 ctypes.byref(outs)) == 0
+    yv = (ctypes.c_float * 3)()
+    assert lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(outs[0]), yv,
+                                      12) == 0
+    assert list(yv) == [1.0, 4.0, 9.0]
+    assert lib.MXExecutorBackwardEx(exe, 0, None) == 0
+    gv = (ctypes.c_float * 3)()
+    assert lib.MXNDArraySyncCopyToCPU(ga, gv, 12) == 0
+    assert list(gv) == [2.0, 4.0, 6.0]
+    es = ctypes.c_char_p()
+    assert lib.MXExecutorPrint(exe, ctypes.byref(es)) == 0
+    assert es.value
+    osym = ctypes.c_void_p()
+    assert lib.MXExecutorGetOptimizedSymbol(exe, ctypes.byref(osym)) == 0
+    lib.MXSymbolFree(osym)
+    lib.MXExecutorFree(exe)
+
+    # runtime misc
+    assert lib.MXNotifyShutdown() == 0
+    assert lib.MXSetNumOMPThreads(2) == 0
+    assert lib.MXRandomSeedContext(7, 1, 0) == 0
+    fm = ctypes.c_int()
+    tm = ctypes.c_int()
+    assert lib.MXGetGPUMemoryInformation(0, ctypes.byref(fm),
+                                         ctypes.byref(tm)) == -1
+    assert b"no GPU" in lib.MXGetLastError()
+    for hh in (h, g, out, xa, ga):
+        lib.MXNDArrayFree(hh)
+
+
+def test_c_api_batch5b_sparse_dlpack_monitor(tmp_path, c_api_lib):
+    """Batch-5b ABI: InvokeEx stypes, sparse pulls, profiler aliases +
+    Event, fresh-grad flag, DLPack round-trip, executor monitor
+    callback, faithful MXSymbolGrad error."""
+    import ctypes
+    import mxnet_tpu as mx
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # InvokeEx returns stypes
+    shape = (ctypes.c_uint32 * 1)(4)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(h)) == 0
+    v = (ctypes.c_float * 4)(1, -2, 3, -4)
+    assert lib.MXNDArraySyncCopyFromCPU(h, v, 16) == 0
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    stypes = ctypes.POINTER(ctypes.c_int)()
+    assert lib.MXImperativeInvokeEx(b"relu", 1,
+                                    (ctypes.c_void_p * 1)(h.value),
+                                    ctypes.byref(n_out),
+                                    ctypes.byref(outs), 0, None, None,
+                                    ctypes.byref(stypes)) == 0
+    assert n_out.value == 1 and stypes[0] == 0
+    lib.MXNDArrayFree(ctypes.c_void_p(outs[0]))
+
+    # kv pull with sparse flags (dense store; flag exercises the path)
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    keys = (ctypes.c_char_p * 1)(b"w")
+    assert lib.MXKVStoreInit(kv, 1, keys,
+                             (ctypes.c_void_p * 1)(h.value)) == 0
+    out = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(out)) == 0
+    assert lib.MXKVStorePullWithSparse(
+        kv, 1, keys, (ctypes.c_void_p * 1)(out.value), 0, 1) == 0
+    got = (ctypes.c_float * 4)()
+    assert lib.MXNDArraySyncCopyToCPU(out, got, 16) == 0
+    assert list(got) == [1, -2, 3, -4]
+    # row_sparse_pull of rows [0, 2]
+    rs = (ctypes.c_uint32 * 1)(2)
+    rid = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(rs, 1, 1, 0, 0, 4,
+                                 ctypes.byref(rid)) == 0
+    ridv = (ctypes.c_int32 * 2)(0, 2)
+    assert lib.MXNDArraySyncCopyFromCPU(rid, ridv, 8) == 0
+    r2 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(rs, 1, 1, 0, 0, 0,
+                                 ctypes.byref(r2)) == 0
+    assert lib.MXKVStorePullRowSparse(
+        kv, 1, keys, (ctypes.c_void_p * 1)(r2.value),
+        (ctypes.c_void_p * 1)(rid.value), 0) == 0
+    g2 = (ctypes.c_float * 2)()
+    assert lib.MXNDArraySyncCopyToCPU(r2, g2, 8) == 0
+    assert list(g2) == [1.0, 3.0]
+    lib.MXKVStoreFree(kv)
+
+    # profiler aliases + Event object
+    assert lib.MXSetProfilerState(1) == 0
+    ev = ctypes.c_void_p()
+    assert lib.MXProfileCreateEvent(b"phase", ctypes.byref(ev)) == 0
+    assert lib.MXProfileDurationStart(ev) == 0
+    assert lib.MXProfileDurationStop(ev) == 0
+    assert lib.MXProfilePause(1) == 0
+    assert lib.MXProfilePause(0) == 0
+    assert lib.MXSetProfilerState(0) == 0
+    lib.MXProfileDestroyHandle(ev)
+
+    # fresh-grad flag
+    st = ctypes.c_int(-1)
+    assert lib.MXNDArrayGetGradState(h, ctypes.byref(st)) == 0
+    assert st.value == 0
+    assert lib.MXNDArraySetGradState(h, 1) == 0
+    assert lib.MXNDArrayGetGradState(h, ctypes.byref(st)) == 0
+    assert st.value == 1
+
+    # DLPack round trip (FromDLPack CONSUMES the tensor — ownership
+    # passes to the importer, so no CallDLPackDeleter afterwards)
+    dlm = ctypes.c_void_p()
+    assert lib.MXNDArrayToDLPack(h, ctypes.byref(dlm)) == 0
+    assert dlm.value
+    back = ctypes.c_void_p()
+    assert lib.MXNDArrayFromDLPack(dlm, ctypes.byref(back)) == 0
+    bv = (ctypes.c_float * 4)()
+    assert lib.MXNDArraySyncCopyToCPU(back, bv, 16) == 0
+    assert list(bv) == [1, -2, 3, -4]
+    lib.MXNDArrayFree(back)
+    # an UNCONSUMED export is released with CallDLPackDeleter
+    dlm2 = ctypes.c_void_p()
+    assert lib.MXNDArrayToDLPack(h, ctypes.byref(dlm2)) == 0
+    assert lib.MXNDArrayCallDLPackDeleter(dlm2) == 0
+
+    # MXSymbolGrad errors faithfully
+    y = mx.sym.square(mx.sym.Variable("x"))
+    gsym = ctypes.c_void_p()
+    wrt = (ctypes.c_char_p * 1)(b"x")
+    assert lib.MXSymbolGrad(ctypes.c_void_p(id(y)), 1, wrt,
+                            ctypes.byref(gsym)) == -1
+    assert b"deprecated" in lib.MXGetLastError()
+
+    # executor monitor callback sees output names
+    xa = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(xa)) == 0
+    assert lib.MXNDArraySyncCopyFromCPU(xa, v, 16) == 0
+    exe = ctypes.c_void_p()
+    reqs = (ctypes.c_uint32 * 1)(0)
+    assert lib.MXExecutorBind(ctypes.c_void_p(id(y)), 1, 0, 1,
+                              (ctypes.c_void_p * 1)(xa.value), None,
+                              reqs, 0, None, ctypes.byref(exe)) == 0
+    seen = []
+    MON = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p)
+
+    @MON
+    def monitor(name, arr, handle):
+        got = (ctypes.c_float * 4)()
+        lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(arr), got, 16)
+        seen.append((name, list(got)))
+
+    assert lib.MXExecutorSetMonitorCallbackEX(exe, monitor, None, 1) == 0
+    assert lib.MXExecutorForward(exe, 0) == 0
+    assert any(vals == [1.0, 4.0, 9.0, 16.0] for _, vals in seen), seen
+    lib.MXExecutorFree(exe)
+    for hh in (h, out, rid, r2, xa):
+        lib.MXNDArrayFree(hh)
