@@ -5,10 +5,15 @@
 #ifndef MXNET_TPU_CPP_MXNETCPP_H_
 #define MXNET_TPU_CPP_MXNETCPP_H_
 
+#include "mxnet_tpu_cpp/shape.hpp"
 #include "mxnet_tpu_cpp/ndarray.hpp"
 #include "mxnet_tpu_cpp/op.h"
 #include "mxnet_tpu_cpp/executor.hpp"
 #include "mxnet_tpu_cpp/optimizer.hpp"
+#include "mxnet_tpu_cpp/lr_scheduler.hpp"
+#include "mxnet_tpu_cpp/initializer.hpp"
+#include "mxnet_tpu_cpp/metric.hpp"
+#include "mxnet_tpu_cpp/monitor.hpp"
 #include "mxnet_tpu_cpp/kvstore.hpp"
 #include "mxnet_tpu_cpp/io.hpp"
 

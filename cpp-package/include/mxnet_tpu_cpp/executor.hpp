@@ -134,6 +134,8 @@ class Executor {
     return out;
   }
 
+  ExecutorHandle handle() const { return handle_; }
+
  private:
   ExecutorHandle handle_ = nullptr;
 };

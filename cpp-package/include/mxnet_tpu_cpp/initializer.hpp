@@ -1,0 +1,126 @@
+// Weight initializers for the C++ frontend.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// initializer.h: name-dispatched Initializer (bias/gamma/beta/moving
+// stats get their canonical constants) with Uniform/Normal/Xavier
+// strategies; random draws run through the framework's registered
+// samplers via MXImperativeInvoke.
+#ifndef MXNET_TPU_CPP_INITIALIZER_HPP_
+#define MXNET_TPU_CPP_INITIALIZER_HPP_
+
+#include <cmath>
+#include <sstream>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class Initializer {
+ public:
+  virtual ~Initializer() = default;
+
+  // reference initializer.h operator(): dispatch on the parameter name
+  void operator()(const std::string& name, NDArray* arr) {
+    auto ends_with = [&name](const char* s) {
+      std::string suf(s);
+      return name.size() >= suf.size() &&
+             name.compare(name.size() - suf.size(), suf.size(), suf) == 0;
+    };
+    if (ends_with("bias") || ends_with("beta") ||
+        ends_with("moving_mean") || ends_with("running_mean")) {
+      Fill(arr, 0.0f);
+    } else if (ends_with("gamma") || ends_with("moving_var") ||
+               ends_with("running_var")) {
+      Fill(arr, 1.0f);
+    } else {
+      InitWeight(arr);
+    }
+  }
+
+ protected:
+  virtual void InitWeight(NDArray* arr) = 0;
+
+  static void Fill(NDArray* arr, float v) {
+    std::vector<float> host(arr->Size(), v);
+    arr->CopyFrom(host);
+  }
+
+  static void Draw(NDArray* arr, const char* op, float a, float b) {
+    bool is_uniform = std::string(op).find("uniform") != std::string::npos;
+    std::map<std::string, std::string> attrs = {
+        {is_uniform ? "low" : "loc", std::to_string(a)},
+        {is_uniform ? "high" : "scale", std::to_string(b)}};
+    // shape attr so the sampler produces the right buffer; Shape
+    // streams python-tuple syntax
+    std::ostringstream shp;
+    shp << Shape(arr->Shape());
+    attrs["shape"] = shp.str();
+    NDArray out = Invoke(op, {}, attrs);
+    Check(MXNDArraySyncCopyFromNDArray(arr->handle(), out.handle()));
+  }
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale = 0.07f) : scale_(scale) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    Draw(arr, "_random_uniform", -scale_, scale_);
+  }
+
+ private:
+  float scale_;
+};
+
+class Normal : public Initializer {
+ public:
+  Normal(float mu = 0.0f, float sigma = 0.01f) : mu_(mu), sigma_(sigma) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    Draw(arr, "_random_normal", mu_, sigma_);
+  }
+
+ private:
+  float mu_, sigma_;
+};
+
+class Xavier : public Initializer {
+ public:
+  enum RandType { gaussian, uniform };
+  enum FactorType { avg, in, out };
+
+  explicit Xavier(RandType rand_type = gaussian,
+                  FactorType factor_type = avg, float magnitude = 3.0f)
+      : rand_type_(rand_type), factor_type_(factor_type),
+        magnitude_(magnitude) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    auto dims = arr->Shape();
+    float hw = 1.0f;
+    for (size_t i = 2; i < dims.size(); ++i) hw *= dims[i];
+    float fan_out = dims.empty() ? 1.0f : dims[0] * hw;
+    float fan_in = dims.size() < 2 ? 1.0f : dims[1] * hw;
+    float factor = fan_in;
+    if (factor_type_ == avg) factor = (fan_in + fan_out) / 2.0f;
+    if (factor_type_ == out) factor = fan_out;
+    float scale = std::sqrt(magnitude_ / factor);
+    if (rand_type_ == uniform)
+      Draw(arr, "_random_uniform", -scale, scale);
+    else
+      Draw(arr, "_random_normal", 0.0f, scale);
+  }
+
+ private:
+  RandType rand_type_;
+  FactorType factor_type_;
+  float magnitude_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_INITIALIZER_HPP_

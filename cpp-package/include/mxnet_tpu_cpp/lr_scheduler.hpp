@@ -1,0 +1,73 @@
+// Learning-rate schedules for the C++ training loop.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// lr_scheduler.h (FactorScheduler with stop_factor floor); the update
+// count is the optimizer step, matching python/mxnet/lr_scheduler.py.
+#ifndef MXNET_TPU_CPP_LR_SCHEDULER_HPP_
+#define MXNET_TPU_CPP_LR_SCHEDULER_HPP_
+
+#include <stdexcept>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+class LRScheduler {
+ public:
+  explicit LRScheduler(float base_lr = 0.01f) : base_lr_(base_lr) {}
+  virtual ~LRScheduler() = default;
+  void SetLR(float lr) { base_lr_ = lr; }
+  virtual float GetLR(unsigned num_update) = 0;
+
+ protected:
+  float base_lr_;
+};
+
+class FactorScheduler : public LRScheduler {
+ public:
+  FactorScheduler(int step, float factor = 1.0f,
+                  float stop_factor_lr = 1e-8f, float base_lr = 0.01f)
+      : LRScheduler(base_lr), step_(step > 0 ? step : 0),
+        factor_(factor), stop_factor_lr_(stop_factor_lr) {
+    // the python reference raises for step < 1; step=0 would loop
+    // forever below
+    if (step < 1) throw std::invalid_argument("FactorScheduler: step >= 1");
+  }
+
+  float GetLR(unsigned num_update) override {
+    while (num_update > count_ + step_) {
+      count_ += step_;
+      base_lr_ *= factor_;
+      if (base_lr_ < stop_factor_lr_) base_lr_ = stop_factor_lr_;
+    }
+    return base_lr_;
+  }
+
+ private:
+  unsigned step_, count_ = 0;
+  float factor_, stop_factor_lr_;
+};
+
+class MultiFactorScheduler : public LRScheduler {
+ public:
+  MultiFactorScheduler(std::vector<unsigned> steps, float factor,
+                       float base_lr = 0.01f)
+      : LRScheduler(base_lr), steps_(std::move(steps)), factor_(factor) {}
+
+  float GetLR(unsigned num_update) override {
+    // strict >, matching python/mxnet lr_scheduler.py: the boundary
+    // update itself still sees the pre-decay rate
+    while (cur_ < steps_.size() && num_update > steps_[cur_]) {
+      base_lr_ *= factor_;
+      ++cur_;
+    }
+    return base_lr_;
+  }
+
+ private:
+  std::vector<unsigned> steps_;
+  size_t cur_ = 0;
+  float factor_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_LR_SCHEDULER_HPP_

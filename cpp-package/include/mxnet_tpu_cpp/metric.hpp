@@ -1,0 +1,139 @@
+// Evaluation metrics for the C++ training loop.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// metric.h (EvalMetric/Accuracy/LogLoss/MAE/MSE/RMSE/PSNR): host-side
+// accumulation over (label, pred) batches, Reset/Update/Get.
+#ifndef MXNET_TPU_CPP_METRIC_HPP_
+#define MXNET_TPU_CPP_METRIC_HPP_
+
+#include <algorithm>
+#include <cmath>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class EvalMetric {
+ public:
+  explicit EvalMetric(std::string name) : name_(std::move(name)) {}
+  virtual ~EvalMetric() = default;
+
+  virtual void Update(const NDArray& labels, const NDArray& preds) = 0;
+  void Reset() { sum_ = 0.0; num_ = 0.0; }
+  float Get() const { return num_ > 0 ? float(sum_ / num_) : 0.0f; }
+  const std::string& GetName() const { return name_; }
+
+ protected:
+  std::string name_;
+  double sum_ = 0.0, num_ = 0.0;
+};
+
+class Accuracy : public EvalMetric {
+ public:
+  Accuracy() : EvalMetric("accuracy") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    auto shp = preds.Shape();
+    size_t batch = shp.empty() ? 0 : shp[0];
+    if (batch == 0) return;
+    size_t k = preds.Size() / batch;
+    if (k == 0) return;
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    batch = std::min(batch, l.size());  // guard padded/partial batches
+    for (size_t i = 0; i < batch; ++i) {
+      size_t arg = 0;
+      for (size_t j = 1; j < k; ++j)
+        if (p[i * k + j] > p[i * k + arg]) arg = j;
+      sum_ += (arg == static_cast<size_t>(l[i] + 0.5f)) ? 1.0 : 0.0;
+      num_ += 1.0;
+    }
+  }
+};
+
+class MAE : public EvalMetric {
+ public:
+  MAE() : EvalMetric("mae") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    size_t n = std::min(p.size(), l.size());
+    for (size_t i = 0; i < n; ++i) sum_ += std::fabs(p[i] - l[i]);
+    num_ += n;
+  }
+};
+
+class MSE : public EvalMetric {
+ public:
+  MSE() : EvalMetric("mse") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    size_t n = std::min(p.size(), l.size());
+    for (size_t i = 0; i < n; ++i)
+      sum_ += (p[i] - l[i]) * (p[i] - l[i]);
+    num_ += n;
+  }
+};
+
+class RMSE : public EvalMetric {
+ public:
+  RMSE() : EvalMetric("rmse") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    size_t n = std::min(p.size(), l.size());
+    double s = 0.0;
+    for (size_t i = 0; i < n; ++i) s += (p[i] - l[i]) * (p[i] - l[i]);
+    sum_ += std::sqrt(s / (n ? n : 1));
+    num_ += 1.0;
+  }
+};
+
+class LogLoss : public EvalMetric {
+ public:
+  LogLoss() : EvalMetric("logloss") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    auto shp = preds.Shape();
+    size_t batch = shp.empty() ? 0 : shp[0];
+    if (batch == 0) return;
+    size_t k = preds.Size() / batch;
+    if (k == 0) return;
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    batch = std::min(batch, l.size());  // guard padded/partial batches
+    const float eps = 1e-15f;
+    for (size_t i = 0; i < batch; ++i) {
+      size_t cls = static_cast<size_t>(l[i] + 0.5f);
+      float v = std::max(p[i * k + (cls < k ? cls : 0)], eps);
+      sum_ += -std::log(v);
+      num_ += 1.0;
+    }
+  }
+};
+
+class PSNR : public EvalMetric {
+ public:
+  PSNR() : EvalMetric("psnr") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> p = preds.CopyTo();
+    std::vector<float> l = labels.CopyTo();
+    size_t n = std::min(p.size(), l.size());
+    double mse = 0.0;
+    for (size_t i = 0; i < n; ++i)
+      mse += (p[i] - l[i]) * (p[i] - l[i]);
+    mse /= (n ? n : 1);
+    sum_ += 10.0 * std::log10(255.0 * 255.0 / (mse > 0 ? mse : 1e-12));
+    num_ += 1.0;
+  }
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_METRIC_HPP_

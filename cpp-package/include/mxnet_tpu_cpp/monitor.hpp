@@ -1,0 +1,85 @@
+// Output monitor for debugging C++ training loops.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// monitor.h: install on an executor, collect per-output statistics each
+// forward, drain them with toc(). Uses the ABI's monitor callback
+// (MXExecutorSetMonitorCallbackEX), so the hook fires inside the
+// framework exactly where the reference's does.
+#ifndef MXNET_TPU_CPP_MONITOR_HPP_
+#define MXNET_TPU_CPP_MONITOR_HPP_
+
+#include <cmath>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxnet_tpu_cpp/ndarray.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class Monitor {
+ public:
+  using Stat = std::pair<std::string, float>;
+
+  // stat_func maps an output buffer to one scalar; default mean |x|
+  explicit Monitor(float (*stat_func)(const std::vector<float>&) = nullptr)
+      : stat_func_(stat_func ? stat_func : &MeanAbs) {}
+
+  // the installed callback carries a raw `this`: non-copyable,
+  // non-movable, and uninstalled on destruction so the executor can
+  // never call into a dead Monitor
+  Monitor(const Monitor&) = delete;
+  Monitor& operator=(const Monitor&) = delete;
+  Monitor(Monitor&&) = delete;
+  Monitor& operator=(Monitor&&) = delete;
+
+  ~Monitor() { Uninstall(); }
+
+  void Install(ExecutorHandle exec, bool monitor_all = true) {
+    Check(MXExecutorSetMonitorCallbackEX(exec, &Monitor::Trampoline, this,
+                                         monitor_all ? 1 : 0));
+    exec_ = exec;
+  }
+
+  void Uninstall() {
+    if (exec_ != nullptr) {
+      MXExecutorSetMonitorCallbackEX(exec_, nullptr, nullptr, 0);
+      exec_ = nullptr;
+    }
+  }
+
+  // collected (name, stat) pairs since the last toc
+  std::vector<Stat> toc() {
+    std::vector<Stat> out;
+    out.swap(stats_);
+    return out;
+  }
+
+  static float MeanAbs(const std::vector<float>& v) {
+    double s = 0.0;
+    for (float x : v) s += std::fabs(x);
+    return v.empty() ? 0.0f : static_cast<float>(s / v.size());
+  }
+
+ private:
+  static void Trampoline(const char* name, NDArrayHandle arr,
+                         void* handle) noexcept {
+    // never let an exception unwind through the C callback frame
+    try {
+      auto* self = static_cast<Monitor*>(handle);
+      NDArray view = NDArray::Borrow(arr);  // borrowed, not freed
+      int dtype = -1;
+      if (MXNDArrayGetDType(arr, &dtype) != 0 || dtype != MXTPU_FLOAT32)
+        return;  // stat only defined for float32 buffers
+      self->stats_.emplace_back(name, self->stat_func_(view.CopyTo()));
+    } catch (...) {
+    }
+  }
+
+  float (*stat_func_)(const std::vector<float>&);
+  std::vector<Stat> stats_;
+  ExecutorHandle exec_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_MONITOR_HPP_

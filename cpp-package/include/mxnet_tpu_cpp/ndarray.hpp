@@ -39,16 +39,26 @@ class NDArray {
     return a;
   }
 
+  // non-owning view of a BORROWED handle (e.g. inside a monitor or
+  // updater callback): reads are fine, the handle is not freed
+  static NDArray Borrow(NDArrayHandle h) {
+    NDArray a;
+    a.handle_ = h;
+    a.owns_ = false;
+    return a;
+  }
+
   NDArray(const NDArray&) = delete;
   NDArray& operator=(const NDArray&) = delete;
 
-  NDArray(NDArray&& o) noexcept : handle_(o.handle_) {
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_), owns_(o.owns_) {
     o.handle_ = nullptr;
   }
   NDArray& operator=(NDArray&& o) noexcept {
     if (this != &o) {
       Free();
       handle_ = o.handle_;
+      owns_ = o.owns_;
       o.handle_ = nullptr;
     }
     return *this;
@@ -103,10 +113,11 @@ class NDArray {
 
  private:
   void Free() {
-    if (handle_ != nullptr) MXNDArrayFree(handle_);
+    if (handle_ != nullptr && owns_) MXNDArrayFree(handle_);
     handle_ = nullptr;
   }
   NDArrayHandle handle_;
+  bool owns_ = true;
 };
 
 // Invoke one registered operator; returns its first output.

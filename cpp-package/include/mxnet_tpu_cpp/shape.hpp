@@ -1,0 +1,58 @@
+// Dimension tuple for the C++ frontend.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// shape.h (mshadow TShape wrapper): a small value type the io/executor
+// helpers pass around instead of raw vectors.
+#ifndef MXNET_TPU_CPP_SHAPE_HPP_
+#define MXNET_TPU_CPP_SHAPE_HPP_
+
+#include <cstdint>
+#include <initializer_list>
+#include <ostream>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+class Shape {
+ public:
+  Shape() = default;
+  Shape(std::initializer_list<uint32_t> dims) : dims_(dims) {}
+  explicit Shape(std::vector<uint32_t> dims) : dims_(std::move(dims)) {}
+
+  uint32_t ndim() const { return static_cast<uint32_t>(dims_.size()); }
+  uint32_t operator[](size_t i) const { return dims_[i]; }
+  uint32_t& operator[](size_t i) { return dims_[i]; }
+  const std::vector<uint32_t>& data() const { return dims_; }
+  const uint32_t* raw() const { return dims_.data(); }
+
+  // implicit view as the dimension vector, so every NDArray/io/executor
+  // API taking std::vector<uint32_t> accepts a Shape directly
+  operator const std::vector<uint32_t>&() const { return dims_; }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (uint32_t d : dims_) n *= d;
+    return n;
+  }
+
+  bool operator==(const Shape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const Shape& o) const { return dims_ != o.dims_; }
+
+  // python-tuple-literal syntax (1-dim keeps the trailing comma), so a
+  // streamed Shape is directly usable as a shape attr string
+  friend std::ostream& operator<<(std::ostream& os, const Shape& s) {
+    os << "(";
+    for (size_t i = 0; i < s.dims_.size(); ++i) {
+      if (i) os << ",";
+      os << s.dims_[i];
+    }
+    if (s.dims_.size() == 1) os << ",";
+    return os << ")";
+  }
+
+ private:
+  std::vector<uint32_t> dims_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_SHAPE_HPP_

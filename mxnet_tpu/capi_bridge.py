@@ -1097,7 +1097,12 @@ def nd_from_dlpack(capsule):
 
 def executor_set_monitor(w, fn_ptr, handle_ptr, monitor_all):
     """Install a C monitor callback invoked per output (reference:
-    MXExecutorSetMonitorCallback); handles passed to it are borrowed."""
+    MXExecutorSetMonitorCallback); handles passed to it are borrowed.
+    A NULL fn_ptr uninstalls (lets C++ wrappers detach before their
+    state dies)."""
+    if not fn_ptr:
+        w.exe.set_monitor_callback(None)
+        return 0
     import ctypes
     proto = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
                              ctypes.c_void_p)

@@ -1205,3 +1205,93 @@ def test_c_api_batch5b_sparse_dlpack_monitor(tmp_path, c_api_lib):
     lib.MXExecutorFree(exe)
     for hh in (h, out, rid, r2, xa):
         lib.MXNDArrayFree(hh)
+
+
+_FRONTEND_EXTRAS_MAIN = r"""
+#include <cstdio>
+#include <cmath>
+#include "mxnet_tpu_cpp/MxNetCpp.h"
+
+using namespace mxnet_tpu_cpp;
+
+int main() {
+  // Shape value type
+  Shape s{2, 3, 4};
+  if (s.Size() != 24 || s.ndim() != 3) { std::printf("FAIL shape\n"); return 1; }
+  NDArray from_shape(s);          // Shape converts into the NDArray API
+  if (from_shape.Size() != 24) { std::printf("FAIL shape ctor\n"); return 1; }
+
+  // initializers: name dispatch + xavier scaling
+  NDArray w({64, 32}), b({64}), g({64});
+  Xavier xav(Xavier::gaussian, Xavier::avg, 3.0f);
+  xav("fc_weight", &w);
+  xav("fc_bias", &b);
+  xav("bn_gamma", &g);
+  auto wv = w.CopyTo(); auto bv = b.CopyTo(); auto gv = g.CopyTo();
+  double wsum = 0, wabs = 0;
+  for (float v : wv) { wsum += v; wabs += std::fabs(v); }
+  bool bias_zero = true, gamma_one = true;
+  for (float v : bv) if (v != 0.0f) bias_zero = false;
+  for (float v : gv) if (v != 1.0f) gamma_one = false;
+  std::printf("init bias_zero=%d gamma_one=%d wabs_mean=%.4f\n",
+              bias_zero ? 1 : 0, gamma_one ? 1 : 0, wabs / wv.size());
+  // xavier std = sqrt(3/48) ~ 0.25 -> mean|x| ~ 0.2; loose sanity band
+  if (!(wabs / wv.size() > 0.05 && wabs / wv.size() < 0.5)) {
+    std::printf("FAIL xavier scale\n"); return 1;
+  }
+
+  // lr schedules
+  FactorScheduler fs(10, 0.5f, 1e-6f, 1.0f);
+  MultiFactorScheduler ms({5, 8}, 0.1f, 1.0f);
+  std::printf("lr fs@25=%.3f ms@9=%.3f\n", fs.GetLR(25), ms.GetLR(9));
+  if (std::fabs(fs.GetLR(25) - 0.25f) > 1e-6) { std::printf("FAIL fs\n"); return 1; }
+  if (std::fabs(ms.GetLR(9) - 0.01f) > 1e-7) { std::printf("FAIL ms\n"); return 1; }
+
+  // metrics
+  NDArray preds({2, 3}), labels({2});
+  preds.CopyFrom({0.1f, 0.7f, 0.2f, 0.6f, 0.3f, 0.1f});
+  labels.CopyFrom({1.0f, 2.0f});
+  Accuracy acc;
+  acc.Update(labels, preds);
+  RMSE rmse;
+  NDArray a({3}), p({3});
+  a.CopyFrom({1, 2, 3}); p.CopyFrom({1, 2, 5});
+  rmse.Update(a, p);
+  std::printf("acc=%.2f rmse=%.4f\n", acc.Get(), rmse.Get());
+  if (std::fabs(acc.Get() - 0.5f) > 1e-6) { std::printf("FAIL acc\n"); return 1; }
+
+  // monitor on an executor forward
+  Symbol x = Symbol::Variable("x");
+  Symbol y = Symbol::Atomic("square", {}, "sq");
+  y.Compose({{"x", &x}});  // square's input slot is named x
+  NDArray xv({4});
+  Executor exe(y, {"x"}, {&xv});      // example fixes the shape only
+  NDArray arg = exe.Arg("x");
+  arg.CopyFrom({1, -2, 3, -4});       // bound value set in place
+  Monitor mon;
+  mon.Install(exe.handle(), true);
+  exe.Forward(false);
+  auto stats = mon.toc();
+  bool saw = false;
+  for (auto& kv : stats)
+    if (kv.second > 7.49f && kv.second < 7.51f) saw = true;  // mean|sq| = 7.5
+  std::printf("monitor stats=%zu saw_sq=%d\n", stats.size(), saw ? 1 : 0);
+  if (!saw) { std::printf("FAIL monitor\n"); return 1; }
+
+  std::printf("EXTRAS OK\n");
+  return 0;
+}
+"""
+
+
+def test_cpp_frontend_extras(tmp_path, c_api_lib):
+    """New cpp-package mirrors: Shape, initializers (name dispatch +
+    Xavier scaling), LR schedulers, metrics, executor Monitor through
+    the ABI monitor callback."""
+    src = tmp_path / "extras.cc"
+    src.write_text(_FRONTEND_EXTRAS_MAIN)
+    exe = _compile(tmp_path, str(src), c_api_lib, "extras")
+    r = subprocess.run([exe], env=_child_env(), capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "EXTRAS OK" in r.stdout, r.stdout
