@@ -1214,6 +1214,12 @@ _FRONTEND_EXTRAS_MAIN = r"""
 
 using namespace mxnet_tpu_cpp;
 
+static int g_stat_calls = 0;
+static float CountingStat(const std::vector<float>& v) {
+  ++g_stat_calls;
+  return Monitor::MeanAbs(v);
+}
+
 int main() {
   // Shape value type
   Shape s{2, 3, 4};
@@ -1277,6 +1283,19 @@ int main() {
     if (kv.second > 7.49f && kv.second < 7.51f) saw = true;  // mean|sq| = 7.5
   std::printf("monitor stats=%zu saw_sq=%d\n", stats.size(), saw ? 1 : 0);
   if (!saw) { std::printf("FAIL monitor\n"); return 1; }
+  {
+    Monitor scoped(&CountingStat);       // uninstalls on destruction
+    scoped.Install(exe.handle(), true);
+    exe.Forward(false);                  // proves the callback is wired
+    if (g_stat_calls == 0) { std::printf("FAIL scoped wiring\n"); return 1; }
+  }
+  int calls_at_destroy = g_stat_calls;
+  exe.Forward(false);                    // must not call into dead state
+  if (g_stat_calls != calls_at_destroy) {
+    std::printf("FAIL uninstall no-op: callback fired after destroy\n");
+    return 1;
+  }
+  std::printf("post-destroy forward ok\n");
 
   std::printf("EXTRAS OK\n");
   return 0;
