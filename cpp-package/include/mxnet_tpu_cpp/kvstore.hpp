@@ -5,6 +5,8 @@
 #ifndef MXNET_TPU_CPP_KVSTORE_HPP_
 #define MXNET_TPU_CPP_KVSTORE_HPP_
 
+#include <map>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
@@ -40,6 +42,75 @@ class KVStore {
     CallP(&MXKVStorePull, keys, outs, priority);
   }
 
+  void PushPull(const std::vector<std::string>& keys,
+                const std::vector<const NDArray*>& vals,
+                const std::vector<const NDArray*>& outs,
+                int priority = 0) {
+    if (keys.size() != vals.size() || keys.size() != outs.size())
+      throw std::invalid_argument("PushPull: keys/vals/outs sizes differ");
+    std::vector<const char*> ks;
+    std::vector<NDArrayHandle> vh, oh;
+    Marshal(keys, vals, &ks, &vh);
+    for (const auto* o : outs) oh.push_back(o->handle());
+    Check(MXKVStorePushPull(handle_, static_cast<uint32_t>(ks.size()),
+                            ks.data(), vh.data(), oh.data(), priority));
+  }
+
+  // updater receives BORROWED handles (read recv, write local via the
+  // sync-copy ABI); the caller keeps updater/state alive while pushes
+  // can happen — same contract as the reference's C++ kvstore
+  void SetUpdater(MXKVStoreStrUpdater* updater, void* state = nullptr) {
+    if (updater == nullptr) {
+      // clear: a NULL function pointer uninstalls bridge-side
+      Check(MXKVStoreSetUpdater(handle_, nullptr, nullptr));
+      return;
+    }
+    Check(MXKVStoreSetUpdaterEx(handle_, nullptr, updater, state));
+  }
+
+  void SetOptimizer(const std::string& name,
+                    const std::map<std::string, std::string>& params = {}) {
+    std::vector<const char*> ks, vs;
+    MapToKV(params, &ks, &vs);
+    Check(MXKVStoreSetOptimizer(handle_, name.c_str(),
+                                static_cast<int>(ks.size()), ks.data(),
+                                vs.data()));
+  }
+
+  void SetGradientCompression(
+      const std::map<std::string, std::string>& params) {
+    std::vector<const char*> ks, vs;
+    MapToKV(params, &ks, &vs);
+    Check(MXKVStoreSetGradientCompression(
+        handle_, static_cast<uint32_t>(ks.size()), ks.data(), vs.data()));
+  }
+
+  void Barrier() { Check(MXKVStoreBarrier(handle_)); }
+
+  int NumDeadNode(int node_id = 0, int timeout_sec = 60) const {
+    int n = 0;
+    Check(MXKVStoreGetNumDeadNode(handle_, node_id, &n, timeout_sec));
+    return n;
+  }
+
+  static bool IsWorkerNode() {
+    int r = 0;
+    Check(MXKVStoreIsWorkerNode(&r));
+    return r != 0;
+  }
+
+  static bool IsServerNode() {
+    int r = 0;
+    Check(MXKVStoreIsServerNode(&r));
+    return r != 0;
+  }
+
+  static bool IsSchedulerNode() {
+    int r = 0;
+    Check(MXKVStoreIsSchedulerNode(&r));
+    return r != 0;
+  }
+
   std::string Type() const {
     const char* t = nullptr;
     Check(MXKVStoreGetType(handle_, &t));
@@ -58,14 +129,34 @@ class KVStore {
     return n;
   }
 
+  KVStoreHandle handle() const { return handle_; }
+
  private:
+  static void Marshal(const std::vector<std::string>& keys,
+                      const std::vector<const NDArray*>& vals,
+                      std::vector<const char*>* ks,
+                      std::vector<NDArrayHandle>* hs) {
+    if (keys.size() != vals.size())
+      throw std::invalid_argument("KVStore: keys/arrays sizes differ");
+    for (const auto& k : keys) ks->push_back(k.c_str());
+    for (const auto* v : vals) hs->push_back(v->handle());
+  }
+
+  static void MapToKV(const std::map<std::string, std::string>& params,
+                      std::vector<const char*>* ks,
+                      std::vector<const char*>* vs) {
+    for (const auto& kv : params) {
+      ks->push_back(kv.first.c_str());
+      vs->push_back(kv.second.c_str());
+    }
+  }
+
   template <typename Fn>
   void Call(Fn fn, const std::vector<std::string>& keys,
             const std::vector<const NDArray*>& vals) {
     std::vector<const char*> ks;
     std::vector<NDArrayHandle> hs;
-    for (const auto& k : keys) ks.push_back(k.c_str());
-    for (const auto* v : vals) hs.push_back(v->handle());
+    Marshal(keys, vals, &ks, &hs);
     Check(fn(handle_, static_cast<uint32_t>(ks.size()), ks.data(),
              hs.data()));
   }
@@ -75,8 +166,7 @@ class KVStore {
              const std::vector<const NDArray*>& vals, int priority) {
     std::vector<const char*> ks;
     std::vector<NDArrayHandle> hs;
-    for (const auto& k : keys) ks.push_back(k.c_str());
-    for (const auto* v : vals) hs.push_back(v->handle());
+    Marshal(keys, vals, &ks, &hs);
     Check(fn(handle_, static_cast<uint32_t>(ks.size()), ks.data(),
              hs.data(), priority));
   }

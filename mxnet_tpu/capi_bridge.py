@@ -931,7 +931,11 @@ def kv_set_updater(kv, fn_ptr, handle_ptr, str_keys):
     """Install a C updater callback: merged gradient + stored weight per
     key (reference: MXKVStoreSetUpdater/SetUpdaterEx). ``fn_ptr`` is the
     raw C function pointer; handles passed to it are borrowed PyObject*
-    valid for the duration of the call."""
+    valid for the duration of the call. A NULL fn_ptr clears the
+    updater."""
+    if not fn_ptr:
+        kv._set_updater(None)
+        return 0
     import ctypes
     keyt = ctypes.c_char_p if str_keys else ctypes.c_int
     proto = ctypes.CFUNCTYPE(None, keyt, ctypes.c_void_p,

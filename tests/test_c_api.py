@@ -1314,3 +1314,78 @@ def test_cpp_frontend_extras(tmp_path, c_api_lib):
                        text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "EXTRAS OK" in r.stdout, r.stdout
+
+
+_KVSTORE_CPP_MAIN = r"""
+#include <cstdio>
+#include <cmath>
+#include "mxnet_tpu_cpp/MxNetCpp.h"
+
+using namespace mxnet_tpu_cpp;
+
+static int g_upd_calls = 0;
+
+static void SgdHalf(const char* key, NDArrayHandle recv,
+                    NDArrayHandle local, void* state) {
+  ++g_upd_calls;
+  NDArray r = NDArray::Borrow(recv), l = NDArray::Borrow(local);
+  auto rv = r.CopyTo(); auto lv = l.CopyTo();
+  for (size_t i = 0; i < lv.size(); ++i) lv[i] -= 0.5f * rv[i];
+  l.CopyFrom(lv);
+  (void)key; (void)state;
+}
+
+int main() {
+  if (!KVStore::IsWorkerNode() || KVStore::IsServerNode()) {
+    std::printf("FAIL roles\n"); return 1;
+  }
+  KVStore kv("local");
+  NDArray w({4}), g({4}), out({4});
+  w.CopyFrom({1, 1, 1, 1});
+  g.CopyFrom({2, 2, 2, 2});
+  kv.Init({"w"}, {&w});
+  kv.SetUpdater(&SgdHalf);
+  kv.Push({"w"}, {&g});
+  kv.Pull({"w"}, {&out});
+  auto ov = out.CopyTo();
+  int dead = kv.NumDeadNode(0, 5);
+  std::printf("pull=%.1f upd_calls=%d dead=%d\n", ov[0], g_upd_calls,
+              dead);
+  if (std::fabs(ov[0] - 0.0f) > 1e-6 || g_upd_calls != 1 || dead != 0) {
+    std::printf("FAIL updater\n"); return 1;
+  }
+  kv.SetUpdater(nullptr);               // clears; store-write semantics
+  kv.Push({"w"}, {&g});
+  kv.Pull({"w"}, {&out});
+  if (std::fabs(out.CopyTo()[0] - 2.0f) > 1e-6) {
+    std::printf("FAIL updater clear\n"); return 1;
+  }
+  kv.SetGradientCompression({{"type", "2bit"}, {"threshold", "0.5"}});
+  kv.Barrier();
+  // pushpull on a second, optimizer-driven store
+  KVStore kv2("local");
+  NDArray w2({4}), g2({4}), o2({4});
+  w2.CopyFrom({1, 1, 1, 1});
+  g2.CopyFrom({4, 4, 4, 4});
+  kv2.Init({"p"}, {&w2});
+  kv2.SetOptimizer("sgd", {{"learning_rate", "0.25"}});
+  kv2.PushPull({"p"}, {&g2}, {&o2});
+  auto o2v = o2.CopyTo();
+  std::printf("pushpull=%.2f\n", o2v[0]);  // 1 - 0.25*4 = 0
+  if (std::fabs(o2v[0]) > 1e-5) { std::printf("FAIL pushpull\n"); return 1; }
+  std::printf("KV OK\n");
+  return 0;
+}
+"""
+
+
+def test_cpp_kvstore_full_surface(tmp_path, c_api_lib):
+    """C++ KVStore mirror: roles, typed updater callback, gradient
+    compression, barrier, optimizer-driven pushpull, dead-node query."""
+    src = tmp_path / "kvcpp.cc"
+    src.write_text(_KVSTORE_CPP_MAIN)
+    exe = _compile(tmp_path, str(src), c_api_lib, "kvcpp")
+    r = subprocess.run([exe], env=_child_env(), capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "KV OK" in r.stdout, r.stdout
