@@ -9,6 +9,7 @@
 #include "mxnet_tpu_cpp/ndarray.hpp"
 #include "mxnet_tpu_cpp/op.h"
 #include "mxnet_tpu_cpp/executor.hpp"
+#include "mxnet_tpu_cpp/operator.hpp"
 #include "mxnet_tpu_cpp/optimizer.hpp"
 #include "mxnet_tpu_cpp/lr_scheduler.hpp"
 #include "mxnet_tpu_cpp/initializer.hpp"

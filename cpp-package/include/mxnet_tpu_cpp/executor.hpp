@@ -57,6 +57,16 @@ class Symbol {
                           hs.data()));
   }
 
+  // keys=NULL: wire args in order into the graph's free variables
+  void ComposePositional(const std::vector<const Symbol*>& args,
+                         const std::string& name = "") {
+    std::vector<SymbolHandle> hs;
+    for (const auto* a : args) hs.push_back(a->handle());
+    Check(MXSymbolCompose(handle_, name.empty() ? nullptr : name.c_str(),
+                          static_cast<uint32_t>(hs.size()), nullptr,
+                          hs.data()));
+  }
+
   Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
   Symbol(const Symbol&) = delete;
   Symbol& operator=(const Symbol&) = delete;

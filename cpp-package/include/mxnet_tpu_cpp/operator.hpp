@@ -1,0 +1,90 @@
+// Fluent symbol-building sugar for the C++ frontend.
+// Capability analog of the reference's cpp-package/include/mxnet-cpp/
+// operator.h: Operator("Convolution").SetParam(...).SetInput(...)
+// .CreateSymbol(name) — the idiom every mxnet-cpp example composes
+// networks with. Builds on the two-phase atomic+compose C ABI.
+#ifndef MXNET_TPU_CPP_OPERATOR_HPP_
+#define MXNET_TPU_CPP_OPERATOR_HPP_
+
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxnet_tpu_cpp/executor.hpp"
+
+namespace mxnet_tpu_cpp {
+
+class Operator {
+ public:
+  explicit Operator(std::string op_name) : op_name_(std::move(op_name)) {}
+
+  // streamed like the reference's mxnet-cpp template SetParam, so any
+  // arithmetic type works without overload ambiguity
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+  Operator& SetParam(const std::string& key, bool value) {
+    params_[key] = value ? "True" : "False";
+    return *this;
+  }
+
+  // named input wired into the op's matching slot at CreateSymbol;
+  // rvalues are rejected at compile time — the Symbol must outlive
+  // CreateSymbol (its handle is borrowed, not copied)
+  Operator& SetInput(const std::string& name, const Symbol& sym) {
+    inputs_.emplace_back(name, &sym);
+    return *this;
+  }
+  Operator& SetInput(const std::string&, Symbol&&) = delete;
+
+  // positional sugar: unnamed inputs wire in order into the op's free
+  // slots (lhs/rhs, data, ... — the compose fallback)
+  Operator& operator()(const Symbol& sym) {
+    inputs_.emplace_back(std::string(), &sym);
+    return *this;
+  }
+  Operator& operator()(Symbol&&) = delete;
+
+  Symbol CreateSymbol(const std::string& name = "") {
+    Symbol s = Symbol::Atomic(op_name_, params_, name);
+    if (inputs_.empty()) return s;
+    bool any_named = false, any_positional = false;
+    for (const auto& kv : inputs_)
+      (kv.first.empty() ? any_positional : any_named) = true;
+    if (any_named && any_positional)
+      throw std::invalid_argument(
+          "Operator: mixing named SetInput and positional operator() "
+          "inputs is ambiguous");
+    if (any_positional) {
+      std::vector<const Symbol*> args;
+      for (const auto& kv : inputs_) args.push_back(kv.second);
+      s.ComposePositional(args, name);
+    } else {
+      std::map<std::string, const Symbol*> wired;
+      for (const auto& kv : inputs_) {
+        if (!wired.emplace(kv.first, kv.second).second)
+          throw std::invalid_argument(
+              "Operator: duplicate input name '" + kv.first + "'");
+      }
+      s.Compose(wired, name);
+    }
+    return s;
+  }
+
+ private:
+  std::string op_name_;
+  std::map<std::string, std::string> params_;
+  // pointers borrowed until CreateSymbol; caller keeps inputs alive
+  std::vector<std::pair<std::string, const Symbol*>> inputs_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_OPERATOR_HPP_
