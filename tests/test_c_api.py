@@ -1472,3 +1472,15 @@ def test_cpp_operator_chaining(tmp_path, c_api_lib):
                        text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "OPERATOR OK" in r.stdout, r.stdout
+
+
+def test_cpp_lenet_operator_example(tmp_path, c_api_lib):
+    """examples/cpp/train_lenet_operator.cc: a conv net composed with
+    the Operator idiom trains to >0.9 accuracy using the full frontend
+    mirror set (Xavier, FactorScheduler, Accuracy, executor grads)."""
+    src = os.path.join(REPO, "examples", "cpp", "train_lenet_operator.cc")
+    exe = _compile(tmp_path, src, c_api_lib, "lenet_op")
+    r = subprocess.run([exe], env=_child_env(), capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LENET OK" in r.stdout, r.stdout
