@@ -825,8 +825,9 @@ def symbol_infer_shape(sym, keys, shapes, partial):
     fn = sym.infer_shape_partial if partial else sym.infer_shape
     arg_shapes, out_shapes, aux_shapes = fn(
         **{k: tuple(s) for k, s in zip(keys, shapes)})
-    complete = arg_shapes is not None and \
-        all(s is not None for s in arg_shapes)
+    complete = all(
+        ls is not None and all(s is not None for s in ls)
+        for ls in (arg_shapes, out_shapes, aux_shapes))
     none_to_empty = lambda ls: [list(s) if s is not None else []  # noqa
                                 for s in (ls or [])]
     return (none_to_empty(arg_shapes), none_to_empty(out_shapes),

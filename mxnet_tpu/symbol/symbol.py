@@ -350,6 +350,13 @@ class Symbol(object):
         arg_shapes = [shapes.get(n) for n in arg_names]
         aux_shapes = [shapes.get(n) for n in aux_names]
 
+        if partial and (None in arg_shapes or None in aux_shapes):
+            # some inputs stay unknowable: report what IS known and leave
+            # every output unresolved (the reference's partial contract —
+            # symbol.py infer_shape_partial returns without erroring)
+            return (arg_shapes, [None] * len(self.list_outputs()),
+                    aux_shapes)
+
         def build(name):
             return jax.ShapeDtypeStruct(shapes[name], _np.float32)
 

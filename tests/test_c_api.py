@@ -1484,3 +1484,72 @@ def test_cpp_lenet_operator_example(tmp_path, c_api_lib):
                        text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "LENET OK" in r.stdout, r.stdout
+
+
+def test_c_api_infer_shape_partial_and_iter_index(tmp_path, c_api_lib):
+    """Remaining batch-5 corners: InferShapePartial leaves unknowable
+    shapes empty with complete=0; DataIterGetIndex errors cleanly on an
+    iterator without sample indices."""
+    import ctypes
+    import mxnet_tpu as mx
+    lib = ctypes.CDLL(c_api_lib)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # two-input graph, only one shape given -> partial succeeds,
+    # full infer reports incomplete rather than erroring
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s = mx.sym.elemwise_add(a, mx.sym.square(b), name="s")
+    sym = ctypes.c_void_p(id(s))
+    keys = (ctypes.c_char_p * 1)(b"a")
+    ind_ptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape_data = (ctypes.c_uint32 * 2)(2, 3)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u32pp = ctypes.POINTER(u32p)
+    in_sz = ctypes.c_uint32()
+    in_nd = u32p()
+    in_dat = u32pp()
+    out_sz = ctypes.c_uint32()
+    out_nd = u32p()
+    out_dat = u32pp()
+    aux_sz = ctypes.c_uint32()
+    aux_nd = u32p()
+    aux_dat = u32pp()
+    comp = ctypes.c_int(-1)
+    assert lib.MXSymbolInferShapePartial(
+        sym, 1, keys, ind_ptr, shape_data, ctypes.byref(in_sz),
+        ctypes.byref(in_nd), ctypes.byref(in_dat), ctypes.byref(out_sz),
+        ctypes.byref(out_nd), ctypes.byref(out_dat),
+        ctypes.byref(aux_sz), ctypes.byref(aux_nd),
+        ctypes.byref(aux_dat), ctypes.byref(comp)) == 0
+    assert comp.value == 0               # b unknowable
+    # the known input keeps its shape; b's entry is empty (ndim 0)
+    ndims = [in_nd[i] for i in range(in_sz.value)]
+    assert sorted(ndims) == [0, 2]
+
+    # MNISTIter has no per-sample index buffer -> clean error
+    import struct
+    img_path = str(tmp_path / "im.idx")
+    lbl_path = str(tmp_path / "lb.idx")
+    import numpy as np
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 4, 4, 4))
+        f.write(np.zeros((4, 4, 4), np.uint8).tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 4))
+        f.write(np.zeros((4,), np.uint8).tobytes())
+    it = ctypes.c_void_p()
+    ik = (ctypes.c_char_p * 3)(b"image", b"label", b"batch_size")
+    iv = (ctypes.c_char_p * 3)(img_path.encode(), lbl_path.encode(), b"2")
+    assert lib.MXDataIterCreateIter(b"MNISTIter", 3, ik, iv,
+                                    ctypes.byref(it)) == 0
+    has = ctypes.c_int()
+    assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value
+    idx = ctypes.POINTER(ctypes.c_uint64)()
+    n = ctypes.c_uint64()
+    rc = lib.MXDataIterGetIndex(it, ctypes.byref(idx), ctypes.byref(n))
+    if rc == 0:
+        assert n.value > 0               # indices provided
+    else:
+        assert b"indices" in lib.MXGetLastError()
+    lib.MXDataIterFree(it)
