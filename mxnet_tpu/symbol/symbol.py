@@ -344,18 +344,21 @@ class Symbol(object):
         # propagate through the graph with eval_shape; unknown leaf shapes
         # are resolved by per-op deduction where possible (dense layers),
         # otherwise inference fails like the reference's InferShape.
-        shapes = _deduce_shapes(self, known, partial=partial)
+        shapes, node_outs = _deduce_shapes(self, known, partial=partial,
+                                           return_outs=True)
         if shapes is None:
             return None, None, None
         arg_shapes = [shapes.get(n) for n in arg_names]
         aux_shapes = [shapes.get(n) for n in aux_names]
 
         if partial and (None in arg_shapes or None in aux_shapes):
-            # some inputs stay unknowable: report what IS known and leave
-            # every output unresolved (the reference's partial contract —
-            # symbol.py infer_shape_partial returns without erroring)
-            return (arg_shapes, [None] * len(self.list_outputs()),
-                    aux_shapes)
+            # some inputs stay unknowable: report what IS known — incl.
+            # any output whose own inputs were all deducible — and leave
+            # the rest None (the reference's partial contract)
+            outs = [shapes.get(node.name) if node.is_var
+                    else node_outs.get((id(node), oi))
+                    for node, oi in self._entries]
+            return arg_shapes, outs, aux_shapes
 
         def build(name):
             return jax.ShapeDtypeStruct(shapes[name], _np.float32)
@@ -827,7 +830,7 @@ def _aux_input_positions(op, node):
     return [wired.index(a) for a in aux_names]
 
 
-def _deduce_shapes(symbol, known, partial=False):
+def _deduce_shapes(symbol, known, partial=False, return_outs=False):
     """Best-effort leaf shape deduction. Strategy: variables with
     ``__shape__`` attrs or entries in ``known`` are fixed; remaining
     parameter shapes are deduced per consuming op (dense/conv/norm
@@ -888,6 +891,8 @@ def _deduce_shapes(symbol, known, partial=False):
     missing = [n.name for n in nodes if n.is_var and n.name not in shapes]
     if missing and not partial:
         raise MXNetError("cannot infer shapes for %s" % missing)
+    if return_outs:
+        return shapes, out_shapes
     return shapes
 
     # (reference behavior note: InferShape solves a full constraint system;

@@ -1553,3 +1553,59 @@ def test_c_api_infer_shape_partial_and_iter_index(tmp_path, c_api_lib):
     else:
         assert b"indices" in lib.MXGetLastError()
     lib.MXDataIterFree(it)
+
+
+def test_c_api_kvstore_run_server(tmp_path, c_api_lib):
+    """MXKVStoreRunServer: a server-role process driven purely through
+    the C ABI serves a dist_tpu_sync worker (init/push/pull round
+    trip), proving the blocking server loop entry point."""
+    import socket
+    import time as _time
+    import numpy as np
+
+    # port 0: the server binds an ephemeral port and announces it on
+    # stdout (no bind-then-close TOCTOU race)
+    code = (
+        "import ctypes, os\n"
+        "os.environ.update(MXNET_TPU_ROLE='server',\n"
+        "                  MXNET_TPU_PS_PORT='0',\n"
+        "                  MXNET_TPU_NUM_WORKERS='1',\n"
+        "                  MXNET_TPU_PS_MODE='sync')\n"
+        "lib = ctypes.CDLL(%r)\n"
+        "kv = ctypes.c_void_p()\n"
+        "assert lib.MXKVStoreCreate(b'local', ctypes.byref(kv)) == 0\n"
+        "lib.MXKVStoreRunServer(kv, None, None)\n" % (c_api_lib,))
+    proc = subprocess.Popen([sys.executable, "-u", "-c", code],
+                            env=_child_env(), stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    try:
+        line = proc.stdout.readline().decode()  # 'listening on <port>'
+        assert "listening on" in line, (
+            line + proc.stderr.read().decode()
+            if proc.poll() is not None else line)
+        port = int(line.split("listening on")[1].split()[0])
+        with socket.create_connection(("127.0.0.1", port), timeout=30):
+            pass
+
+        import mxnet_tpu as mx
+        env = {"MXNET_TPU_PS_URI": "127.0.0.1",
+               "MXNET_TPU_PS_PORT": str(port),
+               "MXNET_TPU_RANK": "0", "MXNET_TPU_NUM_WORKERS": "1"}
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            kv = mx.kv.create("dist_tpu_sync")
+            kv.init("w", mx.nd.zeros((4,)))
+            kv.push("w", mx.nd.array(np.full((4,), 5.0, np.float32)))
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)
+            np.testing.assert_allclose(out.asnumpy(), np.full((4,), 5.0))
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
