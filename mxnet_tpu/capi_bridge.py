@@ -712,30 +712,10 @@ def nd_create_sparse(stype, shape, data, aux):
 
 def nd_check_format(arr, full_check):
     """Validate sparse index structure (reference:
-    MXNDArraySyncCheckFormat / NDArray::SyncCheckFormat)."""
-    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
-    if isinstance(arr, RowSparseNDArray):
-        idx = _np.asarray(arr.indices)
-        if idx.ndim != 1:
-            raise MXNetError("rsp indices must be 1-D")
-        if full_check and idx.size:
-            if (idx < 0).any() or (idx >= arr.shape[0]).any():
-                raise MXNetError("rsp indices out of bounds")
-            if (_np.diff(idx) <= 0).any():
-                raise MXNetError("rsp indices must be strictly increasing")
-    elif isinstance(arr, CSRNDArray):
-        indptr = _np.asarray(arr.indptr)
-        idx = _np.asarray(arr.indices)
-        if indptr.size != arr.shape[0] + 1:
-            raise MXNetError("csr indptr must have rows+1 entries")
-        if full_check:
-            if (_np.diff(indptr) < 0).any():
-                raise MXNetError("csr indptr must be non-decreasing")
-            if indptr[0] != 0 or indptr[-1] != idx.size:
-                raise MXNetError("csr indptr endpoints invalid")
-            if idx.size and ((idx < 0).any()
-                             or (idx >= arr.shape[1]).any()):
-                raise MXNetError("csr indices out of bounds")
+    MXNDArraySyncCheckFormat); dense arrays are trivially valid."""
+    from .ndarray.sparse import BaseSparseNDArray
+    if isinstance(arr, BaseSparseNDArray):
+        arr.check_format(full_check=bool(full_check))
     return 0
 
 

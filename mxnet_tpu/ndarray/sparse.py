@@ -104,6 +104,34 @@ class BaseSparseNDArray(object):
         self.todense().wait_to_read()
         return self
 
+    def check_format(self, full_check=True):
+        """Validate the index structure (reference: sparse.py
+        check_format / NDArray::SyncCheckFormat): raises MXNetError on
+        out-of-bounds, unsorted, or inconsistent aux arrays."""
+        if self.stype == "row_sparse":
+            idx = _np.asarray(self.indices)
+            if idx.ndim != 1:
+                raise MXNetError("rsp indices must be 1-D")
+            if full_check and idx.size:
+                if (idx < 0).any() or (idx >= self.shape[0]).any():
+                    raise MXNetError("rsp indices out of bounds")
+                if (_np.diff(idx) <= 0).any():
+                    raise MXNetError(
+                        "rsp indices must be strictly increasing")
+        elif self.stype == "csr":
+            indptr = _np.asarray(self.indptr)
+            idx = _np.asarray(self.indices)
+            if indptr.size != self.shape[0] + 1:
+                raise MXNetError("csr indptr must have rows+1 entries")
+            if full_check:
+                if (_np.diff(indptr) < 0).any():
+                    raise MXNetError("csr indptr must be non-decreasing")
+                if indptr[0] != 0 or indptr[-1] != idx.size:
+                    raise MXNetError("csr indptr endpoints invalid")
+                if idx.size and ((idx < 0).any()
+                                 or (idx >= self.shape[1]).any()):
+                    raise MXNetError("csr indices out of bounds")
+
     def __eq__(self, other):
         return self is other
 

@@ -369,3 +369,42 @@ def test_sparse_fm_converges(tmp_path):
         ad.update(1, V, V.grad, states[1])
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < 0.15 * losses[0], (losses[0], losses[-1])
+
+
+def test_sparse_check_format():
+    """check_format (reference: sparse.py check_format): structural
+    validation on both storage types, python-level API."""
+    import numpy as np
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray.sparse import csr_matrix, row_sparse_array
+
+    good = row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 3], np.int32)),
+        shape=(5, 3))
+    good.check_format()
+
+    unsorted = row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([3, 0], np.int32)),
+        shape=(5, 3))
+    with pytest.raises(MXNetError, match="strictly increasing"):
+        unsorted.check_format()
+    unsorted.check_format(full_check=False)   # structural-only passes
+
+    oob = row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 9], np.int32)),
+        shape=(5, 3))
+    with pytest.raises(MXNetError, match="out of bounds"):
+        oob.check_format()
+
+    csr = csr_matrix(
+        (np.array([1., 2., 3.], np.float32),
+         np.array([0, 2, 1], np.int32), np.array([0, 1, 2, 3], np.int32)),
+        shape=(3, 3))
+    csr.check_format()
+    bad_csr = csr_matrix(
+        (np.array([1., 2., 3.], np.float32),
+         np.array([0, 5, 1], np.int32), np.array([0, 1, 2, 3], np.int32)),
+        shape=(3, 3))
+    with pytest.raises(MXNetError, match="out of bounds"):
+        bad_csr.check_format()
