@@ -140,6 +140,12 @@ def main():
            "unit": best["unit"],
            "vs_baseline": best.get("vs_baseline", 0.0),
            "harness": best.get("harness", 1)}
+    # telemetry snapshot (op count, compile count/time, peak HBM) banked
+    # by the measuring process (benchmark.persist), so BENCH_*.json
+    # rounds also catch compile and memory regressions; {} on records
+    # banked before the field existed. Deliberately no live fallback —
+    # a driver-side jax.devices() could hang on a wedged tunnel.
+    out["telemetry"] = best.get("telemetry") or {}
     if unverified:
         out["warning"] = ("no fetch-synced (harness-2) measurement banked; "
                           "this value used the weaker block_until_ready "

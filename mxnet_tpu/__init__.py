@@ -45,11 +45,17 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
          "util", "registry", "misc", "executor_manager", "ndarray_doc",
-         "symbol_doc")
+         "symbol_doc", "telemetry", "serving")
 
 
 def __getattr__(name):
     import importlib
+    if name == "diagnostics":
+        # one-shot environment/device/memory/cache report for bug
+        # reports (the libinfo + storage-profiler-dump analog)
+        from .telemetry import diagnostics
+        globals()["diagnostics"] = diagnostics
+        return diagnostics
     if name == "AttrScope":
         from .symbol import AttrScope
         globals()["AttrScope"] = AttrScope

@@ -175,6 +175,13 @@ def persist(metric, value, unit, extra=None, host_metric=False):
     rec = {"metric": metric, "value": round(float(value), 2), "unit": unit,
            "platform": _platform(), "harness": HARNESS_GEN,
            "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        # bank compile/memory behavior next to the throughput number so
+        # BENCH rounds track retrace and HBM regressions, not just img/s
+        from . import telemetry as _tm
+        rec["telemetry"] = _tm.snapshot()
+    except Exception:
+        pass
     base = BASELINES.get(metric)
     if base:
         rec["vs_baseline"] = round(float(value) / base, 3)

@@ -71,6 +71,14 @@ class Speedometer(object):
                         / (time.time() - self.tic)
                 except ZeroDivisionError:
                     speed = float("inf")
+                if math.isfinite(speed):
+                    # live training speed on /metrics with no extra user
+                    # code (bridged into the profiler trace as well)
+                    from . import telemetry as _tm
+                    if _tm._enabled:
+                        _tm.gauge("training/throughput",
+                                  "Training samples/sec (Speedometer)"
+                                  ).set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

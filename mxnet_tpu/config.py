@@ -52,6 +52,11 @@ VARS = {
                                      "input->output aliasing = true "
                                      "in-place updates, no double-"
                                      "buffering)."),
+    "MXNET_TELEMETRY": (bool, True,
+                        "Always-on runtime metrics (telemetry.py): op "
+                        "dispatch, jit-cache, HBM, kvstore, io "
+                        "instruments. 0 removes the hot-path hooks "
+                        "entirely; telemetry.enable() flips at runtime."),
     "MXNET_DATALOADER_START_METHOD": (str, "fork",
                                       "Process start method for "
                                       "DataLoader workers (fork/spawn/"

@@ -17,10 +17,20 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, zeros
 from .context import current_context
 from . import random as _random
+from . import telemetry as _tm
 from .ops import registry as _reg
 from .symbol.symbol import _graph_eval_fn, _topo
 
 __all__ = ["Executor"]
+
+
+def _note_graph_compile():
+    """Count a whole-graph jit build (forward or vjp specialization)."""
+    if _tm._enabled:
+        _tm._ensure_compile_listener()
+        _tm.counter("executor/graph_compile_total",
+                    "Executor whole-graph jit builds "
+                    "(forward + vjp specializations)").inc()
 
 
 class Executor(object):
@@ -87,6 +97,12 @@ class Executor(object):
         self._monitor_callback = None
         self._dp_mesh = None
         self._dp_batch_names = ()
+        if _tm._enabled:
+            _tm.counter("executor/bind_total",
+                        "Executor binds (graph → buffers)").inc()
+        from . import profiler as _prof
+        _prof.record_instant("executor_bind", "executor",
+                             {"args": len(arg_names), "aux": len(aux_names)})
 
     # -- data parallelism --------------------------------------------------
     def set_dp_mesh(self, mesh, batch_arg_names):
@@ -140,6 +156,7 @@ class Executor(object):
             import jax
             fn = _graph_eval_fn(self._symbol, is_train)
             self._jitted[is_train] = jax.jit(fn)
+            _note_graph_compile()
         return self._jitted[is_train]
 
     def _vjp(self, grad_names_key):
@@ -162,6 +179,7 @@ class Executor(object):
                 return gs
 
             self._vjp_jitted[grad_names_key] = jax.jit(run)
+            _note_graph_compile()
         return self._vjp_jitted[grad_names_key]
 
     # -- execution ---------------------------------------------------------

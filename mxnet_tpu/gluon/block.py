@@ -372,6 +372,15 @@ class CachedOp(object):
         # register so autograd._vjp_fn caches a jitted vjp for this op
         opdef = _reg.OpDef(name, pure_fn, num_outputs=n_total, needs_rng=True)
         _reg._REGISTRY[name] = opdef
+        from .. import telemetry as _tm
+        if _tm._enabled:
+            _tm._ensure_compile_listener()
+            _tm.counter("cachedop/build_total", "CachedOp mode builds "
+                        "(hybridized block → registered jit op)").inc()
+        from .. import profiler as _prof
+        _prof.record_instant("cachedop_build", "executor",
+                             {"op": name, "mode": "train" if mode_key
+                              else "predict"})
         info = {"name": name, "opdef": opdef, "aux_ids": aux_ids,
                 "n_real": box["n_real"], "is_list": box["is_list"]}
         self._modes[mode_key] = info

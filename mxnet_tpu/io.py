@@ -20,6 +20,7 @@ import numpy as np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
+from . import telemetry as _tm
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter"]
@@ -375,6 +376,8 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._tm_epoch_t0 = None
+        self._tm_epoch_samples = 0
 
         def prefetch_func(self, i):
             while True:
@@ -451,10 +454,33 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+        if _tm._enabled:
+            # epoch throughput: samples served since the previous reset
+            now = _tm.monotonic()
+            if self._tm_epoch_t0 is not None and self._tm_epoch_samples:
+                dt = now - self._tm_epoch_t0
+                if dt > 0:
+                    _tm.gauge("io/epoch_samples_per_sec",
+                              "Input-pipeline throughput over the last "
+                              "epoch").set(self._tm_epoch_samples / dt)
+            self._tm_epoch_t0 = now
+            self._tm_epoch_samples = 0
 
     def iter_next(self):
+        t0 = None
+        if _tm._enabled:
+            # ready events double as the prefetch queue: depth = batches
+            # staged ahead of the consumer right now
+            _tm.gauge("io/queue_depth", "Prefetched batches ready ahead "
+                      "of the consumer").set(
+                sum(1 for e in self.data_ready if e.is_set()))
+            t0 = _tm.monotonic()
         for e in self.data_ready:
             e.wait()
+        if t0 is not None:
+            _tm.histogram("io/batch_wait_seconds",
+                          "Time the consumer blocked waiting for the "
+                          "prefetcher").observe(_tm.monotonic() - t0)
         if self.next_batch[0] is None:
             # all sub-iterators end together
             assert all(b is None for b in self.next_batch), \
@@ -473,6 +499,16 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+        if _tm._enabled:
+            _tm.counter("io/batches_total",
+                        "Batches served by prefetching iterators").inc()
+            n = self.batch_size or 0
+            if n:
+                _tm.counter("io/samples_total", "Samples served by "
+                            "prefetching iterators").inc(n)
+                if self._tm_epoch_t0 is None:
+                    self._tm_epoch_t0 = _tm.monotonic()
+                self._tm_epoch_samples += n
         return True
 
     def next(self):

@@ -20,8 +20,25 @@ import pickle
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, zeros
+from . import telemetry as _tm
 
 __all__ = ["KVStore", "create"]
+
+
+def _approx_nbytes(value):
+    """Total payload bytes of a push/pull value tree (NDArray, sparse
+    NDArray, or nested lists of them) — feeds kvstore/bytes_total."""
+    if isinstance(value, (list, tuple)):
+        return sum(_approx_nbytes(v) for v in value)
+    total = 0
+    for attr in ("_data", "data", "indices", "indptr"):
+        arr = getattr(value, attr, None)
+        nb = getattr(arr, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+            if attr == "_data":
+                break
+    return total
 
 
 @functools.lru_cache(maxsize=None)
@@ -156,11 +173,21 @@ class KVStore(object):
             self._store[k] = vlist[0].copy()
             if self._sock is not None:
                 self._ps_call("INIT", k, vlist[0].asnumpy())
+        if _tm._enabled:
+            _tm.record_kvstore("init", None, _approx_nbytes(value))
 
     def push(self, key, value, priority=0):
         """Aggregate values; if an optimizer is installed, run the update
         on the store (reference: kvstore_local.h:184-212 PushImpl:
         comm_->Reduce then updater_)."""
+        if not _tm._enabled:
+            return self._push_impl(key, value, priority)
+        t0 = _tm.monotonic()
+        self._push_impl(key, value, priority)
+        _tm.record_kvstore("push", _tm.monotonic() - t0,
+                           _approx_nbytes(value))
+
+    def _push_impl(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -216,6 +243,14 @@ class KVStore(object):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast the stored value into ``out`` (reference:
         kvstore_local.h PullImpl → comm_->Broadcast)."""
+        if not _tm._enabled:
+            return self._pull_impl(key, out, priority, ignore_sparse)
+        t0 = _tm.monotonic()
+        self._pull_impl(key, out, priority, ignore_sparse)
+        _tm.record_kvstore("pull", _tm.monotonic() - t0,
+                           _approx_nbytes(out))
+
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):

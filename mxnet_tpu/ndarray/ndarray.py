@@ -22,6 +22,7 @@ from ..base import MXNetError, np_dtype, numeric_types
 _NULL_SCOPE = _contextlib.nullcontext()
 from ..context import Context, current_context
 from .. import random as _random
+from .. import telemetry as _tm
 from ..ops import registry as _reg
 
 __all__ = ["NDArray", "invoke_op", "array", "zeros", "ones", "full", "empty",
@@ -495,6 +496,7 @@ def invoke_op(name, inputs, attrs, out=None):
         prof_scope = _prof.scope(name, "operator")
     else:
         prof_scope = _NULL_SCOPE   # singleton: keep the hot path light
+    tm_token = _tm.dispatch_begin() if _tm._enabled else None
     with prof_scope:
         raw_out = _reg.invoke_raw(op, arrays, attrs)
         if _engine.is_naive():
@@ -502,6 +504,8 @@ def invoke_op(name, inputs, attrs, out=None):
             # src/engine/naive_engine.cc, MXNET_ENGINE_TYPE)
             for o in raw_out:
                 o.block_until_ready()
+    if tm_token is not None:
+        _tm.dispatch_end(name, tm_token)
     if not any(isinstance(x, NDArray) for x in inputs):
         # creation ops: honor the claimed context's device (the reference
         # allocates on ctx; JAX would otherwise use the default device)

@@ -149,7 +149,13 @@ def is_running():
 
 def record_event(name, category, t_start, t_end, args=None):
     """Append one complete event (us timestamps relative to profiler
-    start) — the analog of ProfileOperator entries."""
+    start) — the analog of ProfileOperator entries. Gated on
+    :func:`is_running` so user objects (Task/Counter/Marker/scope) stop
+    accumulating — and stop leaking memory — once the profiler is
+    stopped or paused (reference: every Profile* object checks
+    profiler state before emitting)."""
+    if not is_running():
+        return
     with _events_lock:
         _events.append({"name": name, "cat": category, "ph": "X",
                         "ts": t_start * 1e6, "dur": (t_end - t_start) * 1e6,
@@ -158,21 +164,31 @@ def record_event(name, category, t_start, t_end, args=None):
                         "args": args or {}})
 
 
-def record_instant(name, category, args=None):
+def record_instant(name, category, args=None, s="p"):
+    """``s``: instant-event scope per the Trace Event format —
+    "p" process (default), "t" thread, "g" global."""
+    if not is_running():
+        return
     with _events_lock:
         _events.append({"name": name, "cat": category, "ph": "i",
                         "ts": (time.perf_counter() - _t0) * 1e6,
                         "pid": os.getpid(),
                         "tid": threading.get_ident() % 100000,
-                        "s": "p", "args": args or {}})
+                        "s": s, "args": args or {}})
 
 
-def record_counter(name, value):
+def record_counter(name, value, cat=None):
+    """Counter args must stay numeric (every args key of a ph:"C" event
+    is a chart series in trace viewers); a domain rides in ``cat``."""
+    if not is_running():
+        return
+    ev = {"name": name, "ph": "C",
+          "ts": (time.perf_counter() - _t0) * 1e6,
+          "pid": os.getpid(), "args": {"value": value}}
+    if cat:
+        ev["cat"] = cat
     with _events_lock:
-        _events.append({"name": name, "ph": "C",
-                        "ts": (time.perf_counter() - _t0) * 1e6,
-                        "pid": os.getpid(),
-                        "args": {"value": value}})
+        _events.append(ev)
 
 
 class _OpScope(object):
@@ -227,11 +243,16 @@ def dumps(reset=False):
 def dump(finished=True, filename=None, profile_process="worker"):
     """Write chrome://tracing JSON (reference: Profiler::DumpProfile,
     profiler.h:304). Open in chrome://tracing or Perfetto.
-    ``profile_process='server'`` dumps the PS server's timeline in the
-    server process."""
+    ``finished=True`` also STOPS the profiler (reference semantics:
+    ``MXDumpProfile(finished)`` sets the state to stop), so nothing
+    accumulates after the final dump. Pass ``finished=False`` for a
+    mid-run snapshot. ``profile_process='server'`` dumps the PS
+    server's timeline in the server process."""
     if profile_process == "server":
         _server_command("dump", bool(finished))
         return None
+    if finished:
+        stop()
     path = filename or _config["filename"]
     with _events_lock:
         events = list(_events)
@@ -264,8 +285,11 @@ class Task(object):
     def stop(self):
         if self._t0 is None:
             raise MXNetError("Task.stop() before start()")
+        args = None
+        if self.domain is not None:
+            args = {"domain": self.domain.name}
         record_event(self.name, "task", self._t0,
-                     time.perf_counter() - _t0)
+                     time.perf_counter() - _t0, args)
         self._t0 = None
 
 
@@ -285,11 +309,14 @@ class Counter(object):
 
     def __init__(self, domain, name, value=0):
         self.name = name
+        self.domain = domain
         self._value = value
 
     def set_value(self, value):
         self._value = value
-        record_counter(self.name, value)
+        record_counter(self.name, value,
+                       self.domain.name if self.domain is not None
+                       else None)
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -303,6 +330,12 @@ class Marker(object):
 
     def __init__(self, domain, name):
         self.name = name
+        self.domain = domain
 
     def mark(self, scope="process"):
-        record_instant(self.name, "marker")
+        args = {}
+        if self.domain is not None:
+            args["domain"] = self.domain.name
+        record_instant(self.name, "marker", args,
+                       s={"process": "p", "thread": "t",
+                          "global": "g"}.get(scope, "p"))

@@ -18,6 +18,7 @@ import tempfile
 import numpy as _np
 
 from .base import MXNetError
+from . import telemetry as _tm
 
 __all__ = ["Predictor"]
 
@@ -78,7 +79,22 @@ class Predictor(object):
         arr[:] = array(flat.reshape(arr.shape))
 
     def forward(self):
+        t0 = _tm.monotonic() if _tm._enabled else None
         self._outputs = self._exe.forward(is_train=False)
+        if t0 is not None:
+            _tm.counter("serving/requests_total",
+                        "Predictor forward calls").inc()
+            _tm.histogram("serving/request_seconds",
+                          "Predictor forward latency (host-side)").observe(
+                _tm.monotonic() - t0)
+
+    def serve_metrics(self, port=0, addr="127.0.0.1"):
+        """Start the telemetry ``/metrics`` + ``/healthz`` endpoint next
+        to this predictor (inference deployments scrape it; see
+        docs/observability.md). Returns the :class:`TelemetryServer`
+        handle — keep a reference and ``close()`` it on shutdown."""
+        from . import telemetry
+        return telemetry.serve(port=port, addr=addr)
 
     def num_outputs(self):
         self._ensure_forward()

@@ -40,17 +40,30 @@ def _device(ctx=None):
     return ctx
 
 
-def memory_stats(ctx=None):
-    """Allocator statistics for one device, as reported by PjRt
-    (bytes_in_use, peak_bytes_in_use, bytes_limit, ... — exact keys are
-    backend-dependent; {} when the backend exposes none, e.g. some CPU
-    builds). Reference analog: storage profiler aggregate stats."""
-    dev = _device(ctx)
+def _raw_stats(dev):
     try:
         stats = dev.memory_stats()
     except Exception:
         stats = None
     return dict(stats) if stats else {}
+
+
+def memory_stats(ctx=None):
+    """Allocator statistics for one device, as reported by PjRt
+    (bytes_in_use, peak_bytes_in_use, bytes_limit, ... — exact keys are
+    backend-dependent; {} when the backend exposes none, e.g. some CPU
+    builds). Reference analog: storage profiler aggregate stats.
+    Side effect: refreshes the hbm/* telemetry gauges."""
+    dev = _device(ctx)
+    stats = _raw_stats(dev)
+    if stats and "bytes_in_use" in stats:
+        from . import telemetry as _tm
+        if _tm._enabled:
+            # peak only when the allocator tracks one — a synthesized
+            # peak here would clobber StepMemoryProfiler's running max
+            _tm.record_hbm(dev, stats["bytes_in_use"],
+                           stats.get("peak_bytes_in_use"))
+    return stats
 
 
 def live_bytes(ctx=None):
@@ -119,13 +132,21 @@ class StepMemoryProfiler(object):
 
     def step(self):
         from . import profiler
-        stats = memory_stats(self._ctx)
+        from . import telemetry as _tm
+        # raw read: the gauges are set exactly once below, with the
+        # synthesized running-max peak when the allocator tracks none
+        stats = _raw_stats(_device(self._ctx))
         in_use = stats.get("bytes_in_use")
         if in_use is None:
             in_use = live_bytes(self._ctx)
-        peak = stats.get("peak_bytes_in_use", in_use)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is None:
+            peak = max(in_use, max((s["peak_bytes_in_use"]
+                                    for s in self.steps), default=0))
         rec = {"bytes_in_use": int(in_use), "peak_bytes_in_use": int(peak)}
         self.steps.append(rec)
+        if _tm._enabled:
+            _tm.record_hbm(_device(self._ctx), int(in_use), int(peak))
         if profiler.is_running():
             profiler.record_counter("hbm_bytes_in_use", int(in_use))
             profiler.record_counter("hbm_peak_bytes", int(peak))

@@ -1,0 +1,737 @@
+"""Always-on runtime telemetry: metrics registry + sinks.
+
+The profiler (profiler.py) answers "what happened during this traced
+window"; this module answers "what is the process doing right now" — the
+always-on, low-overhead counters/gauges/histograms a serving deployment
+scrapes. Reference analogs: the engine profiler's aggregate tables
+(src/profiler/aggregate_stats.cc) and the storage profiler
+(src/profiler/storage_profiler.h), generalized into one registry that
+every layer reports through.
+
+Three sinks:
+
+1. :func:`render_prometheus` — Prometheus text exposition format;
+2. :func:`serve` — a stdlib-only HTTP server mounting ``/metrics`` and
+   ``/healthz`` (what an inference ``Predictor`` starts for scraping);
+3. a bridge mirroring selected gauges into the profiler's chrome trace
+   as ``ph:"C"`` counter events (:func:`bridge_to_profiler`), so traces
+   and scraped metrics tell one consistent story.
+
+Naming scheme: instruments use short path-style names
+(``op/dispatch_seconds``, ``hbm/bytes_in_use``); rendering prefixes
+``mxnet_`` and maps every non-metric character to ``_``
+(``mxnet_op_dispatch_seconds``). Labels are free-form key/value pairs
+(``{op="dot"}``, ``{device="TPU_0"}``).
+
+Cost model: one module-bool check when disabled (MXNET_TELEMETRY=0);
+when enabled, an op dispatch pays two ``perf_counter`` reads, one dict
+lookup, and three locked integer bumps — structured to stay within a few
+percent of the uninstrumented dispatch (asserted by
+tests/test_telemetry.py::test_dispatch_overhead). Unobserved metrics
+cost nothing: labeled children materialize on first observation.
+
+JIT-compile tracking hooks ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` events — the same feed
+XLA's own dashboards use — so compile count/time covers *every* compile
+(eager op cache misses, executor graph builds, CachedOp modes) without
+touching the compile path itself.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+
+__all__ = ["Registry", "Counter", "Gauge", "Histogram", "REGISTRY",
+           "counter", "gauge", "histogram", "enable", "enabled",
+           "render_prometheus", "serve", "TelemetryServer",
+           "bridge_to_profiler", "snapshot", "diagnostics", "reset",
+           "DEFAULT_LATENCY_BUCKETS"]
+
+# Fixed log-scale latency buckets (seconds): 1-2.5-5 per decade from
+# 10us to 10s — op dispatch sits in the left decades, XLA compiles and
+# batch waits in the right ones.
+DEFAULT_LATENCY_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+monotonic = time.perf_counter
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+class Counter(object):
+    """Monotonically increasing count."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge(object):
+    """Point-in-time value. ``set`` mirrors into the profiler trace as a
+    ``ph:"C"`` counter event when this gauge's family is bridged and the
+    profiler is running."""
+
+    __slots__ = ("_value", "_lock", "_bridge_name")
+
+    def __init__(self, bridge_name=None):
+        self._value = 0.0
+        self._lock = threading.Lock()
+        self._bridge_name = bridge_name
+
+    def set(self, value):
+        value = float(value)
+        with self._lock:
+            self._value = value
+        if self._bridge_name is not None:
+            from . import profiler
+            if profiler.is_running():
+                profiler.record_counter(self._bridge_name, value)
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram(object):
+    """Cumulative histogram over fixed upper bounds (+Inf implicit)."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def bucket_counts(self):
+        """Cumulative counts per upper bound, ending with +Inf."""
+        out, acc = [], 0
+        with self._lock:
+            raw = list(self._counts)
+        for c in raw:
+            acc += c
+            out.append(acc)
+        return out
+
+
+class Family(object):
+    """One named metric: an instrument per label-value combination.
+    Unlabeled metrics hold a single default child."""
+
+    __slots__ = ("name", "kind", "help", "labelnames", "buckets",
+                 "_children", "_lock", "_bridged")
+
+    def __init__(self, name, kind, help="", labelnames=(), buckets=None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = buckets
+        self._children = {}
+        self._lock = threading.Lock()
+        self._bridged = False
+
+    def _bridge_name_for(self, labelvalues):
+        """Chrome-trace counter name for a bridged gauge child (None
+        when this family is not bridged)."""
+        if not self._bridged:
+            return None
+        name = prom_name(self.name)
+        if labelvalues:
+            name += "{%s}" % ",".join(
+                "%s=%s" % kv for kv in zip(self.labelnames, labelvalues))
+        return name
+
+    def _make(self, labelvalues):
+        if self.kind == "counter":
+            return Counter()
+        if self.kind == "gauge":
+            return Gauge(self._bridge_name_for(labelvalues))
+        return Histogram(self.buckets or DEFAULT_LATENCY_BUCKETS)
+
+    def labels(self, *labelvalues, **labelkw):
+        if labelkw:
+            labelvalues = tuple(str(labelkw[n]) for n in self.labelnames)
+        else:
+            labelvalues = tuple(str(v) for v in labelvalues)
+        if len(labelvalues) != len(self.labelnames):
+            raise ValueError("metric %r expects labels %s"
+                             % (self.name, list(self.labelnames)))
+        child = self._children.get(labelvalues)
+        if child is None:
+            with self._lock:
+                child = self._children.get(labelvalues)
+                if child is None:
+                    child = self._make(labelvalues)
+                    self._children[labelvalues] = child
+        return child
+
+    # unlabeled convenience: family proxies its single default child
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount=1):
+        self._default().inc(amount)
+
+    def set(self, value):
+        self._default().set(value)
+
+    def dec(self, amount=1):
+        self._default().dec(amount)
+
+    def observe(self, value):
+        self._default().observe(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    def series(self):
+        """Snapshot [(labelvalues, child)] observed so far."""
+        with self._lock:
+            return list(self._children.items())
+
+
+class Registry(object):
+    """Thread-safe get-or-create store of metric families."""
+
+    def __init__(self):
+        self._families = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name, kind, help, labelnames, buckets=None):
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError("metric %r already registered as %s"
+                                 % (name, fam.kind))
+            return fam
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = Family(name, kind, help, labelnames, buckets)
+                self._families[name] = fam
+        return fam
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get_or_create(name, "counter", help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get_or_create(name, "gauge", help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        return self._get_or_create(name, "histogram", help, labelnames,
+                                   buckets)
+
+    def families(self):
+        with self._lock:
+            return [self._families[k] for k in sorted(self._families)]
+
+    def reset(self):
+        with self._lock:
+            self._families.clear()
+
+    def render_prometheus(self):
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        for fam in self.families():
+            series = fam.series()
+            if not series:
+                continue
+            pname = prom_name(fam.name)
+            if fam.help:
+                lines.append("# HELP %s %s"
+                             % (pname, fam.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (pname, fam.kind))
+            for labelvalues, child in sorted(series):
+                base_labels = list(zip(fam.labelnames, labelvalues))
+                if fam.kind in ("counter", "gauge"):
+                    lines.append("%s%s %s" % (pname, _label_str(base_labels),
+                                              _fmt(child.value)))
+                else:
+                    bounds = list(child.buckets) + [float("inf")]
+                    for ub, c in zip(bounds, child.bucket_counts()):
+                        lines.append("%s_bucket%s %d" % (
+                            pname,
+                            _label_str(base_labels + [("le", _le(ub))]), c))
+                    lines.append("%s_sum%s %s"
+                                 % (pname, _label_str(base_labels),
+                                    _fmt(child.sum)))
+                    lines.append("%s_count%s %d"
+                                 % (pname, _label_str(base_labels),
+                                    child.count))
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self):
+        """Flat dict of every observed series (for JSON embedding)."""
+        out = {}
+        for fam in self.families():
+            for labelvalues, child in fam.series():
+                key = fam.name
+                if labelvalues:
+                    key += "{%s}" % ",".join(
+                        "%s=%s" % kv for kv in zip(fam.labelnames,
+                                                   labelvalues))
+                if fam.kind == "histogram":
+                    out[key] = {"count": child.count,
+                                "sum": round(child.sum, 6)}
+                else:
+                    v = child.value
+                    out[key] = round(v, 6) if isinstance(v, float) else v
+        return out
+
+
+def prom_name(name):
+    clean = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+    if not clean.startswith("mxnet_"):
+        clean = "mxnet_" + clean
+    return clean
+
+
+def _label_str(pairs):
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in pairs)
+
+
+def _le(ub):
+    return "+Inf" if ub == float("inf") else repr(ub)
+
+
+def _fmt(v):
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+# ---------------------------------------------------------------------------
+# default registry + enable switch
+# ---------------------------------------------------------------------------
+
+REGISTRY = Registry()
+
+
+def counter(name, help="", labelnames=()):
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    fam = REGISTRY.gauge(name, help, labelnames)
+    if name in _BRIDGED_GAUGES:
+        fam._bridged = True
+    return fam
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    return REGISTRY.histogram(name, help, labelnames, buckets)
+
+
+def render_prometheus():
+    return REGISTRY.render_prometheus()
+
+
+def _config_enabled():
+    try:
+        from .config import get
+        return bool(get("MXNET_TELEMETRY"))
+    except Exception:
+        return True
+
+
+_enabled = _config_enabled()
+
+
+def enabled():
+    return _enabled
+
+
+def enable(on=True):
+    """Turn hot-path instrumentation on/off (also: MXNET_TELEMETRY=0).
+    Returns the previous state. Registry contents are preserved."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    if _enabled:
+        _ensure_compile_listener()
+    return prev
+
+
+def reset():
+    """Clear every collected series AND the compile totals (test
+    isolation) so snapshot() and the rendered families stay in
+    agreement. Instrument handles cached by hot paths are re-resolved
+    on next use."""
+    global _compile_count, _compile_time
+    REGISTRY.reset()
+    _op_cache.clear()
+    _kv_cache.clear()
+    del _hitmiss[:]
+    with _compile_lock:
+        _compile_count = 0
+        _compile_time = 0.0
+
+
+# ---------------------------------------------------------------------------
+# profiler bridge
+# ---------------------------------------------------------------------------
+
+# gauges mirrored into the profiler chrome trace as ph:"C" counter
+# events while the profiler runs (record_counter is gated on
+# profiler.is_running, so the bridge is free when no trace is active)
+_BRIDGED_GAUGES = {"hbm/bytes_in_use", "hbm/peak_bytes",
+                   "io/queue_depth", "training/throughput"}
+
+
+def bridge_to_profiler(names=("hbm/bytes_in_use", "hbm/peak_bytes",
+                              "io/queue_depth", "training/throughput")):
+    """Select which gauge families mirror into the profiler trace.
+    Pass an empty tuple to disconnect the bridge entirely."""
+    _BRIDGED_GAUGES.clear()
+    _BRIDGED_GAUGES.update(names or ())
+    for fam in REGISTRY.families():
+        if fam.kind == "gauge":
+            fam._bridged = fam.name in _BRIDGED_GAUGES
+            # rebind live children in place — their current values must
+            # survive (a scrape between rebind and next observation
+            # would otherwise see the series vanish)
+            with fam._lock:
+                for labelvalues, child in fam._children.items():
+                    child._bridge_name = fam._bridge_name_for(labelvalues)
+
+
+# ---------------------------------------------------------------------------
+# jit-compile tracking (jax.monitoring feed)
+# ---------------------------------------------------------------------------
+
+_compile_count = 0          # bumped by the jax.monitoring listener
+_compile_time = 0.0
+_compile_lock = threading.Lock()    # compiles fire on whichever thread
+_listener_on = False
+_listener_lock = threading.Lock()
+
+
+def _on_jax_event(name, secs, **_kw):
+    if name.endswith("backend_compile_duration"):
+        global _compile_count, _compile_time
+        with _compile_lock:
+            _compile_count += 1
+            _compile_time += secs
+        counter("jit/backend_compile_total",
+                "XLA backend compiles (all layers)").inc()
+        histogram("jit/backend_compile_seconds",
+                  "XLA backend compile latency").observe(secs)
+
+
+_listener_dead = False      # jax.monitoring unavailable: stop retrying
+
+
+def _ensure_compile_listener():
+    """Install the jax.monitoring compile listener once. A failed
+    import is cached (this sits behind the hot dispatch path — it must
+    not retry the import machinery per op)."""
+    global _listener_on, _listener_dead
+    if _listener_on:
+        return True
+    if _listener_dead:
+        return False
+    with _listener_lock:
+        if _listener_on:
+            return True
+        if _listener_dead:
+            return False
+        try:
+            import jax.monitoring as _jm
+        except Exception:
+            _listener_dead = True
+            return False
+        _jm.register_event_duration_secs_listener(_on_jax_event)
+        _listener_on = True
+    return True
+
+
+def compile_count():
+    return _compile_count
+
+
+def compile_time():
+    return _compile_time
+
+
+# ---------------------------------------------------------------------------
+# hot-path helpers (tiny call sites, children cached here)
+# ---------------------------------------------------------------------------
+
+_op_cache = {}    # op name -> (dispatch Counter, latency Histogram)
+_kv_cache = {}    # kvstore op -> (Counter, Histogram, bytes Counter)
+_hitmiss = []     # [hit Counter, miss Counter] resolved on first dispatch
+
+
+def dispatch_begin():
+    """Start-of-dispatch token for invoke_op: (t0, compile_count)."""
+    if not _listener_on:
+        _ensure_compile_listener()
+    return (monotonic(), _compile_count)
+
+
+def dispatch_end(name, token):
+    """Record one op dispatch: count, latency, jit-cache hit/miss."""
+    dt = monotonic() - token[0]
+    pair = _op_cache.get(name)
+    if pair is None:
+        pair = (counter("op/dispatch_total", "Op dispatches",
+                        ("op",)).labels(name),
+                histogram("op/dispatch_seconds", "Op dispatch latency "
+                          "(host-side, async submit)", ("op",)).labels(name))
+        _op_cache[name] = pair
+    pair[0].inc()
+    pair[1].observe(dt)
+    if not _hitmiss:
+        _hitmiss[:] = [
+            counter("jit/cache_hits_total",
+                    "Op dispatches served from the jit cache")._default(),
+            counter("jit/cache_misses_total",
+                    "Op dispatches that triggered an XLA compile"
+                    )._default()]
+    _hitmiss[_compile_count > token[1]].inc()
+
+
+def record_kvstore(op, dt, nbytes):
+    trip = _kv_cache.get(op)
+    if trip is None:
+        trip = (counter("kvstore/ops_total", "KVStore calls",
+                        ("op",)).labels(op),
+                histogram("kvstore/seconds", "KVStore call latency",
+                          ("op",)).labels(op),
+                counter("kvstore/bytes_total", "Bytes moved through the "
+                        "KVStore", ("op",)).labels(op))
+        _kv_cache[op] = trip
+    trip[0].inc()
+    if dt is not None:
+        trip[1].observe(dt)
+    if nbytes:
+        trip[2].inc(int(nbytes))
+
+
+def record_hbm(device, bytes_in_use, peak_bytes=None):
+    dev = str(device)
+    gauge("hbm/bytes_in_use", "Device memory currently allocated",
+          ("device",)).labels(dev).set(bytes_in_use)
+    if peak_bytes is not None:
+        gauge("hbm/peak_bytes", "Peak device memory allocated",
+              ("device",)).labels(dev).set(peak_bytes)
+
+
+# ---------------------------------------------------------------------------
+# /metrics HTTP server (stdlib only)
+# ---------------------------------------------------------------------------
+
+class TelemetryServer(object):
+    """Handle on a running metrics endpoint (returned by :func:`serve`)."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = httpd.server_address[1]
+        self.url = "http://%s:%d" % (httpd.server_address[0], self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    stop = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve(port=0, addr="127.0.0.1", registry=None):
+    """Start a daemon-thread HTTP server exposing ``/metrics``
+    (Prometheus text format) and ``/healthz``. ``port=0`` picks a free
+    port (read it from the returned handle). Stdlib only — safe to run
+    inside an inference deployment next to the Predictor."""
+    import http.server
+
+    reg = registry or REGISTRY
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/metrics":
+                body = reg.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                body = b"ok\n"
+                ctype = "text/plain; charset=utf-8"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):   # no stderr chatter per scrape
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, port), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mxnet-telemetry", daemon=True)
+    thread.start()
+    return TelemetryServer(httpd, thread)
+
+
+# ---------------------------------------------------------------------------
+# snapshot + diagnostics
+# ---------------------------------------------------------------------------
+
+def snapshot():
+    """Compact summary for benchmark records / bug reports: dispatch and
+    compile totals plus a live allocator poll (the allocator tracks its
+    own peak, so this is meaningful even if no gauge was ever set)."""
+    fam = REGISTRY._families.get("op/dispatch_total")
+    op_total = sum(c.value for _lv, c in fam.series()) if fam else 0
+
+    def _val(name):
+        f = REGISTRY._families.get(name)
+        if f is None:
+            return 0
+        return sum(c.value for _lv, c in f.series())
+
+    out = {"op_dispatch_total": op_total,
+           "jit_cache_hits": _val("jit/cache_hits_total"),
+           "jit_cache_misses": _val("jit/cache_misses_total"),
+           "backend_compile_total": _compile_count,
+           "backend_compile_seconds": round(_compile_time, 3)}
+    try:
+        from . import storage
+        stats = storage.memory_stats()
+        peak = stats.get("peak_bytes_in_use")
+        if peak is None:
+            f = REGISTRY._families.get("hbm/peak_bytes")
+            if f is not None:
+                peaks = [c.value for _lv, c in f.series()]
+                peak = max(peaks) if peaks else 0
+        out["peak_hbm_bytes"] = int(peak or 0)
+    except Exception:
+        out["peak_hbm_bytes"] = 0
+    return out
+
+
+def diagnostics(as_dict=False):
+    """One-shot environment/device/memory/cache report for bug reports —
+    the analog of the reference's ``libinfo`` features dump plus the
+    storage profiler's summary. Returns a printable string (or the raw
+    dict with ``as_dict=True``)."""
+    import platform as _plat
+    import sys
+
+    from .libinfo import __version__
+
+    info = {"mxnet_tpu": __version__,
+            "python": sys.version.split()[0],
+            "platform": _plat.platform()}
+    try:
+        import numpy
+        info["numpy"] = numpy.__version__
+    except Exception:
+        pass
+    try:
+        import jax
+        info["jax"] = jax.__version__
+        try:
+            info["jax_backend"] = jax.default_backend()
+            devs = []
+            from . import storage
+            for d in jax.devices():
+                row = {"id": d.id, "platform": d.platform,
+                       "kind": getattr(d, "device_kind", "?")}
+                stats = storage.memory_stats(d)
+                if stats:
+                    row["bytes_in_use"] = stats.get("bytes_in_use")
+                    row["peak_bytes_in_use"] = stats.get("peak_bytes_in_use")
+                    row["bytes_limit"] = stats.get("bytes_limit")
+                devs.append(row)
+            info["devices"] = devs
+            info["live_bytes_dev0"] = storage.live_bytes()
+        except Exception as e:
+            info["jax_backend"] = "unavailable (%s)" % e
+    except Exception:
+        info["jax"] = "not importable"
+    try:
+        from .ops import registry as _reg
+        ci = _reg._jitted.cache_info()
+        info["eager_jit_cache"] = {"entries": ci.currsize, "hits": ci.hits,
+                                   "misses": ci.misses}
+    except Exception:
+        pass
+    from . import profiler
+    info["profiler_running"] = profiler.is_running()
+    info["telemetry_enabled"] = _enabled
+    info["telemetry"] = snapshot()
+    try:
+        from .config import VARS, get
+        # bug reports get pasted into public issues: never include live
+        # credential values (e.g. MXNET_TPU_PS_TOKEN)
+        info["config"] = {
+            k: ("<redacted>" if ("TOKEN" in k or "SECRET" in k
+                                 or "PASSWORD" in k) and get(k) else get(k))
+            for k in sorted(VARS)}
+    except Exception:
+        pass
+    if as_dict:
+        return info
+    lines = ["----- mxnet_tpu diagnostics -----"]
+    for k, v in info.items():
+        if isinstance(v, (dict, list)):
+            lines.append("%s:" % k)
+            lines.append("  " + json.dumps(v, indent=1, default=str)
+                         .replace("\n", "\n  "))
+        else:
+            lines.append("%s: %s" % (k, v))
+    return "\n".join(lines)

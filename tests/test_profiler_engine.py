@@ -44,6 +44,84 @@ def test_profiler_task_counter_marker(tmp_path):
     assert {"mytask", "cnt", "mark"} <= names
 
 
+def test_profiler_dump_finished_stops(tmp_path):
+    """Reference semantics: MXDumpProfile(finished) sets the profiler
+    state to stop, so nothing accumulates after the final dump."""
+    fname = str(tmp_path / "fin.json")
+    profiler.set_config(filename=fname)
+    profiler.start()
+    x = mx.nd.ones((4, 4))
+    (x + x).wait_to_read()
+    path = profiler.dump(finished=True)
+    assert not profiler.is_running()
+    # events emitted after the finishing dump are dropped
+    t = profiler.Task(profiler.Domain("d"), "after_dump_task")
+    t.start()
+    t.stop()
+    path2 = profiler.dump(finished=False,
+                          filename=str(tmp_path / "fin2.json"))
+    with open(path) as f:
+        n_before = len(json.load(f)["traceEvents"])
+    with open(path2) as f:
+        trace2 = json.load(f)
+    assert len(trace2["traceEvents"]) == n_before
+    assert "after_dump_task" not in {e["name"] for e in trace2["traceEvents"]}
+    # finished=False keeps the profiler running for mid-run snapshots
+    profiler.start()
+    profiler.dump(finished=False, filename=str(tmp_path / "mid.json"))
+    assert profiler.is_running()
+    profiler.stop()
+
+
+def test_profiler_user_objects_gated_on_running(tmp_path):
+    """After stop(), Task/Event/Counter/Marker/scope no longer append
+    events (no unbounded growth between runs); the Domain name rides in
+    the event args (the reference attaches events to their domain)."""
+    profiler.set_config(filename=str(tmp_path / "gate.json"))
+    profiler.start()
+    dom = profiler.Domain("mydomain")
+    task = profiler.Task(dom, "live_task")
+    task.start()
+    task.stop()
+    c_run = profiler.Counter(dom, "live_counter", 0)
+    c_run.set_value(7)
+    m_run = profiler.Marker(dom, "live_marker")
+    m_run.mark()
+    ev = profiler.Event("live_event")
+    ev.start()
+    ev.stop()
+    profiler.stop()
+
+    dead_task = profiler.Task(dom, "dead_task")
+    dead_task.start()
+    dead_task.stop()
+    c = profiler.Counter(dom, "dead_counter", 0)
+    c.set_value(41)
+    c.increment()            # value still tracked, just not emitted
+    m = profiler.Marker(dom, "dead_marker")
+    m.mark()
+    with profiler.scope("dead_scope"):
+        pass
+
+    path = profiler.dump(filename=str(tmp_path / "gate.json"))
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"live_task", "live_counter", "live_marker",
+            "live_event"} <= names
+    assert not {"dead_task", "dead_counter", "dead_marker",
+                "dead_scope"} & names
+    assert c._value == 42
+    task_ev = [e for e in events if e["name"] == "live_task"][0]
+    assert task_ev["args"]["domain"] == "mydomain"
+    counter_ev = [e for e in events if e["name"] == "live_counter"][0]
+    # counter args stay numeric (they are chart series); domain -> cat
+    assert counter_ev["args"] == {"value": 7}
+    assert counter_ev["cat"] == "mydomain"
+    marker_ev = [e for e in events if e["name"] == "live_marker"][0]
+    assert marker_ev["args"]["domain"] == "mydomain"
+
+
 def test_engine_bulk_api():
     prev = engine.set_bulk_size(30)
     assert engine.set_bulk_size(prev) == 30
