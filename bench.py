@@ -99,11 +99,13 @@ def main():
     train_cands = ("resnet50_train_b256_bf16_img_per_sec",
                    "resnet50_train_b128_bf16_img_per_sec",
                    "resnet50_train_b128_img_per_sec",
+                   "resnet50_train_fused_img_per_sec",
                    HEADLINE,
                    "resnet50_train_bf16_img_per_sec")
     fallbacks = (HEADLINE, "resnet50_train_bf16_img_per_sec",
                  "resnet50_infer_img_per_sec",
-                 "transformer_lm_tokens_per_sec", "mlp_train_img_per_sec")
+                 "transformer_lm_tokens_per_sec", "mlp_train_img_per_sec",
+                 "mlp_train_fused_img_per_sec")
 
     def pick(pred):
         best = None

@@ -12,11 +12,30 @@ import numpy as _np
 __all__ = [
     "MXNetError", "string_types", "numeric_types",
     "np_dtype", "dtype_name", "DEFAULT_DTYPE",
+    "install_donation_warning_filter",
 ]
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: python/mxnet/base.py:72)."""
+
+
+_donation_filter_installed = False
+
+
+def install_donation_warning_filter():
+    """Install (once, process-wide) a filter for jax's "donated buffers
+    were not usable" advisory — buffer donation is a deliberate no-op on
+    CPU backends, where every fused-update program build would otherwise
+    warn. Called from the program-BUILD paths, never per step: a
+    per-call ``warnings.catch_warnings`` would mutate global filter
+    state on the hot path (and is documented thread-unsafe)."""
+    global _donation_filter_installed
+    if _donation_filter_installed:
+        return
+    import warnings
+    warnings.filterwarnings("ignore", message=".*onated buffers.*")
+    _donation_filter_installed = True
 
 
 string_types = (str,)

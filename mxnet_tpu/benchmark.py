@@ -39,6 +39,9 @@ BASELINES = {
     # published ResNet-50 train number (b128 fp32)
     "resnet50_train_b128_bf16_img_per_sec": 363.69,
     "resnet50_train_b256_bf16_img_per_sec": 363.69,
+    # Module-path fused train step (one donated XLA program per step);
+    # same workload as the b32 fp32 train row, so the same anchor
+    "resnet50_train_fused_img_per_sec": 298.51,
     "inception-v3_train_img_per_sec": 214.48,
     "resnet50_infer_img_per_sec": 1076.81,         # b32 fp32 infer
     "resnet50_infer_bf16_img_per_sec": 2085.51,    # vs V100 fp16
@@ -700,6 +703,121 @@ def decode_transformer_lm(batch=8, prompt=32, steps=128, dtype="bfloat16",
                    "path": "kv-cache greedy decode, one jitted scan"}
 
 
+def _measure_module_train(sym, batch, input_shape, num_classes, iters,
+                          fused, warmup=3, optimizer="sgd",
+                          optimizer_params=None):
+    """Module-path training throughput: the forward_backward()/update()
+    loop that Executor.train_step fuses into ONE donated XLA program per
+    step. ``fused=False`` measures the same loop through the legacy
+    forward-jit + vjp-jit + per-parameter-update-kernel sequence, so the
+    fused/unfused jobs share one harness. Returns (img/s, extra) with
+    dispatch/compile accounting from telemetry."""
+    import mxnet_tpu as mx
+    from .context import current_context
+    from .io import DataBatch
+    from .module import Module
+    from . import telemetry as _tm
+
+    prev = os.environ.get("MXNET_FUSED_STEP")
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        mod = Module(sym, context=current_context())
+        mod.bind(data_shapes=[("data", (batch,) + tuple(input_shape))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer=optimizer,
+                           optimizer_params=dict(optimizer_params or
+                                                 {"learning_rate": 0.05,
+                                                  "momentum": 0.9}))
+        rng = np.random.RandomState(0)
+        db = DataBatch(
+            data=[mx.nd.array(rng.randn(batch, *input_shape)
+                              .astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, num_classes, size=(batch,))
+                               .astype(np.float32))])
+
+        def step():
+            mod.forward_backward(db)
+            mod.update()
+
+        for _ in range(warmup):
+            step()
+        pname = mod._param_names[0]
+        _fetch(mod._exec.arg_dict[pname]._data)
+        snap0 = _tm.snapshot()
+        t0 = time.time()
+        for _ in range(iters):
+            step()
+        _fetch(mod._exec.arg_dict[pname]._data)
+        dt = (time.time() - t0) / iters
+        snap1 = _tm.snapshot()
+        img_s = batch / dt
+        extra = {
+            "ms_per_step": round(dt * 1e3, 3), "batch": batch,
+            "path": "module fused train_step" if fused
+                    else "module fwd/vjp + per-param updates",
+            "num_params": len(mod._param_names),
+            "dispatches_per_step": round(
+                (snap1["op_dispatch_total"]
+                 - snap0["op_dispatch_total"]) / iters, 2),
+            "recompiles_during_timing": (snap1["backend_compile_total"]
+                                         - snap0["backend_compile_total"]),
+            "fused_step_compiles": (snap1["fused_step_compiles"]
+                                    - snap0["fused_step_compiles"]),
+            "fused_step_cache_hits": (snap1["fused_step_cache_hits"]
+                                      - snap0["fused_step_cache_hits"]),
+        }
+        return img_s, extra
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prev
+
+
+def train_resnet_module_fused(batch=32, iters=10, num_layers=50,
+                              image=(3, 224, 224)):
+    """ResNet-50 through the fused Module step, with the unfused module
+    path measured on the SAME harness for a like-for-like speedup (the
+    acceptance comparison fused >= unfused)."""
+    from .models import resnet
+    sym = resnet(num_classes=1000, num_layers=num_layers,
+                 image_shape=image)
+    unfused_img_s, unfused_x = _measure_module_train(
+        sym, batch, image, 1000, iters, fused=False)
+    img_s, extra = _measure_module_train(sym, batch, image, 1000, iters,
+                                         fused=True)
+    pk = peak_flops("float32")
+    mfu = (img_s * RESNET50_TRAIN_GFLOP_PER_IMG * 1e9) / pk
+    if mfu > 1.05:
+        raise RuntimeError(
+            "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
+            "— transport not blocking, refusing to bank" % (img_s, mfu))
+    extra.update(_mfu_extra(mfu, pk))
+    extra["unfused_img_per_sec"] = round(unfused_img_s, 2)
+    extra["unfused_ms_per_step"] = unfused_x["ms_per_step"]
+    extra["unfused_dispatches_per_step"] = unfused_x["dispatches_per_step"]
+    extra["fused_vs_unfused"] = round(img_s / max(unfused_img_s, 1e-9), 3)
+    return img_s, extra
+
+
+def train_mlp_module_fused(batch=64, iters=50):
+    """MLP through the fused Module step (pure dispatch-latency probe:
+    tiny per-step compute makes the O(num_params)->O(1) dispatch cut the
+    dominant term), with the unfused module path on the same harness."""
+    from .models import mlp
+    sym = mlp()
+    unfused_img_s, unfused_x = _measure_module_train(
+        sym, batch, (784,), 10, iters, fused=False, warmup=5)
+    img_s, extra = _measure_module_train(sym, batch, (784,), 10, iters,
+                                         fused=True, warmup=5)
+    extra["unfused_img_per_sec"] = round(unfused_img_s, 2)
+    extra["unfused_ms_per_step"] = unfused_x["ms_per_step"]
+    extra["unfused_dispatches_per_step"] = unfused_x["dispatches_per_step"]
+    extra["fused_vs_unfused"] = round(img_s / max(unfused_img_s, 1e-9), 3)
+    return img_s, extra
+
+
 def train_mlp(batch=64, iters=50, steps_per_call=32):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run.
@@ -934,6 +1052,18 @@ def _job_mlp_train():
     return persist("mlp_train_img_per_sec", v, "img/s (batch 64, fp32)", x)
 
 
+def _job_resnet50_train_fused():
+    v, x = train_resnet_module_fused()
+    return persist("resnet50_train_fused_img_per_sec", v,
+                   "img/s (batch 32, fp32, 1 chip, fused module step)", x)
+
+
+def _job_mlp_train_fused():
+    v, x = train_mlp_module_fused()
+    return persist("mlp_train_fused_img_per_sec", v,
+                   "img/s (batch 64, fp32, fused module step)", x)
+
+
 def _job_inception_train():
     v, x = train_inception(32, "float32")
     return persist("inception-v3_train_img_per_sec", v,
@@ -991,6 +1121,8 @@ def _make_infer_job(model, dtype, batch=32):
 
 JOBS = {
     "mlp_train": _job_mlp_train,
+    "mlp_train_fused": _job_mlp_train_fused,
+    "resnet50_train_fused": _job_resnet50_train_fused,
     "data_pipeline": _job_data_pipeline,
     "transformer_lm": _job_transformer_lm,
     "data_pipeline_native": _job_data_pipeline_native,
@@ -1014,9 +1146,11 @@ JOBS["resnet50_infer_b128"] = _make_infer_job("resnet50", "float32",
 # priority order for the daemon: cheapest/highest-value first
 JOB_PRIORITY = [
     "mlp_train",
+    "mlp_train_fused",
     "data_pipeline",
     "data_pipeline_native",
     "resnet50_train",
+    "resnet50_train_fused",
     "resnet50_train_bf16",
     "transformer_lm",
     "e2e_train",

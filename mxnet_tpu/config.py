@@ -52,6 +52,13 @@ VARS = {
                                      "input->output aliasing = true "
                                      "in-place updates, no double-"
                                      "buffering)."),
+    "MXNET_FUSED_STEP": (bool, True,
+                         "Compile forward+backward+optimizer update into "
+                         "ONE donated XLA program per train step "
+                         "(Executor.train_step; Module/Gluon Trainer "
+                         "local-update paths). 0 restores the separate "
+                         "forward/vjp programs plus per-parameter update "
+                         "dispatches."),
     "MXNET_TELEMETRY": (bool, True,
                         "Always-on runtime metrics (telemetry.py): op "
                         "dispatch, jit-cache, HBM, kvstore, io "

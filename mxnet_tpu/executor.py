@@ -8,12 +8,17 @@ GraphExecutor::Init's pass pipeline (InitGraph → InferShape → PlanMemory →
 InitCachedOps, graph_executor.cc:297-673), with XLA doing memory planning
 and op bulking. ``backward`` jits the vjp of the same pure graph function,
 rematerializing the forward (FLOPs-for-HBM, the right TPU default).
+``train_step`` goes one step further: forward, every gradient, the
+optimizer update, and the aux-state update in ONE donated XLA program —
+the whole training step is a single Python→XLA dispatch (the analog of
+the reference's engine op bulking plus src/operator/optimizer_op.cc's
+fused update kernels, collapsed across the step boundary).
 """
 from __future__ import annotations
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, install_donation_warning_filter
 from .ndarray.ndarray import NDArray, zeros
 from .context import current_context
 from . import random as _random
@@ -93,6 +98,7 @@ class Executor(object):
             for n in _topo(symbol._entries))
         self._jitted = {}
         self._vjp_jitted = {}
+        self._fused_jitted = {}
         self.outputs = []
         self._monitor_callback = None
         self._dp_mesh = None
@@ -159,15 +165,18 @@ class Executor(object):
             _note_graph_compile()
         return self._jitted[is_train]
 
-    def _vjp(self, grad_names_key):
-        """Jitted (arg_env, fixed_env, key, cotangents) -> grads for the
-        arguments listed in ``grad_names_key``."""
-        if grad_names_key not in self._vjp_jitted:
+    def _vjp(self, grad_names_key, add_names_key=()):
+        """Jitted (arg_env, fixed_env, key, cotangents, accumulators) ->
+        grads for the arguments listed in ``grad_names_key``. Arguments in
+        ``add_names_key`` (grad_req='add') have their existing gradient
+        buffers summed INSIDE the program — no per-parameter host
+        dispatch after it returns."""
+        cache_key = (grad_names_key, add_names_key)
+        if cache_key not in self._vjp_jitted:
             import jax
             fn = _graph_eval_fn(self._symbol, True)
-            grad_names = list(grad_names_key)
 
-            def run(genv, fenv, key, cts):
+            def run(genv, fenv, key, cts, acc):
                 def fwd(ge):
                     env = dict(fenv)
                     env.update(ge)
@@ -176,11 +185,14 @@ class Executor(object):
 
                 _outs, vjp = jax.vjp(fwd, genv)
                 (gs,) = vjp(tuple(cts))
+                gs = dict(gs)
+                for n in add_names_key:
+                    gs[n] = acc[n] + gs[n]
                 return gs
 
-            self._vjp_jitted[grad_names_key] = jax.jit(run)
+            self._vjp_jitted[cache_key] = jax.jit(run)
             _note_graph_compile()
-        return self._vjp_jitted[grad_names_key]
+        return self._vjp_jitted[cache_key]
 
     # -- execution ---------------------------------------------------------
     def _env(self):
@@ -200,18 +212,38 @@ class Executor(object):
                         tgt._set_data(placed)
         return env
 
+    def _stage_input(self, name, value):
+        """Bind one forward/train_step input, committed to this executor's
+        device (and dp-mesh sharding). Host arrays go through
+        jax.device_put to self._ctx — jnp.asarray would land them on
+        JAX's default device and ignore the bound context."""
+        import jax
+        if name not in self.arg_dict:
+            raise MXNetError("unknown forward argument %r" % name)
+        if isinstance(value, NDArray):
+            data = value._data
+            if self._dp_mesh is not None:
+                data = self._dp_place(name, data)
+        else:
+            if isinstance(value, jax.Array):
+                # already on device: cast/move device-side, never via host
+                data = value
+                want = self.arg_dict[name].dtype
+                if data.dtype != want:
+                    data = data.astype(want)
+            else:
+                data = _np.asarray(value, dtype=self.arg_dict[name].dtype)
+            if self._dp_mesh is not None:
+                data = self._dp_place(name, data)
+            else:
+                data = jax.device_put(data, self._ctx.jax_device())
+        self.arg_dict[name]._set_data(data)
+
     def forward(self, is_train=False, **kwargs):
         """Run the compiled forward program
         (reference: GraphExecutor::RunOps, graph_executor.cc:64,1318)."""
         for k, v in kwargs.items():
-            if k not in self.arg_dict:
-                raise MXNetError("unknown forward argument %r" % k)
-            if isinstance(v, NDArray):
-                self.arg_dict[k]._set_data(v._data)
-            else:
-                import jax.numpy as jnp
-                self.arg_dict[k]._set_data(
-                    jnp.asarray(v, dtype=self.arg_dict[k].dtype))
+            self._stage_input(k, v)
         key = _random.next_key() if self._needs_rng else None
         outs, new_aux = self._fwd(bool(is_train))(self._env(), key)
         self._last_key = key
@@ -223,6 +255,16 @@ class Executor(object):
                 self._monitor_callback(name, arr)
         return self.outputs
 
+    @staticmethod
+    def _normalize_out_grads(out_grads):
+        """Output cotangents -> tuple of raw jax arrays (shared by
+        backward() and train_step() so their semantics cannot drift)."""
+        import jax.numpy as jnp
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        return tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads)
+
     def backward(self, out_grads=None, is_train=True):
         """Gradients of outputs w.r.t. bound args, accumulated per
         grad_req (reference: GraphExecutor backward range run)."""
@@ -233,28 +275,168 @@ class Executor(object):
         if out_grads is None:
             cts = [jnp.ones(o.shape, dtype=o.dtype) for o in outs]
         else:
-            if isinstance(out_grads, NDArray):
-                out_grads = [out_grads]
-            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                   for g in out_grads]
+            cts = list(self._normalize_out_grads(out_grads))
         grad_names = tuple(n for n in self._arg_names
                            if self._grad_req[n] != "null")
         if not grad_names:
             return
+        add_names = tuple(n for n in grad_names
+                          if self._grad_req[n] == "add"
+                          and self.grad_dict[n] is not None)
         env = self._env()
         genv = {n: env.pop(n) for n in grad_names}
         key = getattr(self, "_last_key", None)
         if self._needs_rng and key is None:
             key = _random.next_key()
-        gs = self._vjp(grad_names)(genv, env, key, tuple(cts))
+        acc = {n: self.grad_dict[n]._data for n in add_names}
+        gs = self._vjp(grad_names, add_names)(genv, env, key,
+                                              tuple(cts), acc)
         for n in grad_names:
             tgt = self.grad_dict[n]
             if tgt is None:
                 continue
-            if self._grad_req[n] == "add":
-                tgt._set_data(tgt._data + gs[n])
-            else:
-                tgt._set_data(gs[n])
+            tgt._set_data(gs[n])
+
+    # -- fused train step --------------------------------------------------
+    def _build_fused_step(self, rule, update_names, default_ct, donate):
+        """Trace + jit ONE program computing forward outputs, all
+        gradients (jax.vjp over the same pure graph function), the
+        optimizer update for every parameter in ``update_names`` via
+        ``rule``, and the aux-state updates. Parameter and optimizer-state
+        buffers are donated so XLA aliases them input→output: an in-place
+        HBM update with no per-parameter copies."""
+        import jax
+        import jax.numpy as jnp
+        fn = _graph_eval_fn(self._symbol, True)
+
+        def _core(genv, senv, henv, fenv, key, cts):
+            def fwd(ge):
+                env = dict(fenv)
+                env.update(ge)
+                return fn(env, key)     # -> (outputs, new_aux)
+
+            outs, vjp_fn, new_aux = jax.vjp(fwd, genv, has_aux=True)
+            if cts is None:
+                cts = tuple(jnp.ones(o.shape, dtype=o.dtype) for o in outs)
+            (gs,) = vjp_fn(tuple(cts))
+            new_p, new_s = {}, {}
+            for n in update_names:
+                new_p[n], new_s[n] = rule(genv[n], gs[n], senv[n], henv[n])
+            return new_p, new_s, new_aux, outs
+
+        if default_ct:
+            def run(genv, senv, henv, fenv, key):
+                return _core(genv, senv, henv, fenv, key, None)
+        else:
+            def run(genv, senv, henv, fenv, key, cts):
+                return _core(genv, senv, henv, fenv, key, cts)
+
+        return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+    def train_step(self, rule, update_names, states, hyper, feed=None,
+                   out_grads=None):
+        """One fused XLA program per training step: forward + backward +
+        optimizer update (+ gradient all-reduce under ``set_dp_mesh``,
+        inserted by GSPMD inside the SAME program).
+
+        Parameters
+        ----------
+        rule : pure ``(weight, grad, state_tuple, hyper) ->
+            (new_weight, new_state_tuple)`` (``Optimizer.fused_rule()``).
+        update_names : arg names to update; each must be bound with
+            grad_req='write'.
+        states : dict name -> tuple of NDArray optimizer-state buffers
+            (``optimizer.fused_state_arrays``); updated in place.
+        hyper : dict name -> dict of python scalars for ``rule`` — traced
+            arguments, so lr-schedule/rescale changes never recompile.
+        feed : optional dict of input name -> NDArray/host array, staged
+            like ``forward(**kwargs)``.
+        out_grads : optional output cotangents (default: ones, matching
+            ``backward(out_grads=None)``).
+
+        Programs are cached per (rule, grad-name set, cotangent mode);
+        jit re-specializes per shape signature. The step is ONE host
+        dispatch — recorded as a single ``fused_train_step`` op in the
+        telemetry dispatch counters (ops inside the program are invisible
+        to the per-op eager counters by construction).
+        """
+        update_names = tuple(update_names)
+        for n in update_names:
+            if self._grad_req.get(n) != "write":
+                raise MXNetError(
+                    "train_step requires grad_req='write' for %r (got %r)"
+                    % (n, self._grad_req.get(n)))
+        for k, v in (feed or {}).items():
+            self._stage_input(k, v)
+
+        # donation honors the same knob as the per-param update kernels
+        # (ops/registry.py _donation_allowed): with it off, pre-update
+        # buffers held by external code stay valid on TPU
+        from .config import get as _cfg
+        donate = bool(_cfg("MXNET_UPDATE_BUFFER_DONATION"))
+        cache_key = (rule, update_names, out_grads is None, donate)
+        run = self._fused_jitted.get(cache_key)
+        if run is None:
+            install_donation_warning_filter()
+            run = self._build_fused_step(rule, update_names,
+                                         out_grads is None, donate)
+            self._fused_jitted[cache_key] = run
+            if _tm._enabled:
+                _tm._ensure_compile_listener()
+                _tm.counter("executor/fused_step_compile_total",
+                            "Fused train-step program builds "
+                            "(fwd+bwd+update traced as one program)").inc()
+                _tm.counter("executor/fused_step_cache_miss_total",
+                            "Fused train-step calls that built a new "
+                            "program").inc()
+        elif _tm._enabled:
+            _tm.counter("executor/fused_step_cache_hit_total",
+                        "Fused train-step calls served from the program "
+                        "cache").inc()
+
+        env = self._env()
+        genv = {n: env.pop(n) for n in update_names}
+        senv = {}
+        for n in update_names:
+            tup = []
+            for a in states[n]:
+                d = a._data
+                if self._dp_mesh is not None:
+                    # states ride replicated, like the parameters; a
+                    # cheap sharding-equality check steady-state
+                    placed = self._dp_place(n, d)
+                    if placed is not d:
+                        a._set_data(placed)
+                        d = placed
+                tup.append(d)
+            senv[n] = tuple(tup)
+        key = _random.next_key() if self._needs_rng else None
+        args = [genv, senv, hyper, env, key]
+        if out_grads is not None:
+            args.append(self._normalize_out_grads(out_grads))
+
+        from . import engine as _engine
+        from . import profiler as _prof
+        token = _tm.dispatch_begin() if _tm._enabled else None
+        if _engine.profiling_imperative():
+            with _prof.scope("fused_train_step", "executor"):
+                new_p, new_s, new_aux, outs = run(*args)
+        else:
+            new_p, new_s, new_aux, outs = run(*args)
+        if token is not None:
+            _tm.dispatch_end("fused_train_step", token)
+
+        for n in update_names:
+            self.arg_dict[n]._set_data(new_p[n])
+            for tgt, val in zip(states[n], new_s[n]):
+                tgt._set_data(val)
+        for name, val in new_aux.items():
+            self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if _tm._enabled:
+            _tm.counter("executor/fused_step_total",
+                        "Completed fused train steps").inc()
+        return self.outputs
 
     # -- parameter management ---------------------------------------------
     def alias_args(self, other, names):

@@ -131,6 +131,7 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        items = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -139,8 +140,23 @@ class Trainer(object):
                     raise MXNetError(
                         "Parameter %s has not been initialized" % p.name)
                 continue
-            self._optimizer.update_multi_precision(
-                i, p.data(), p.grad(), self._states[i])
+            items.append((i, p.data(), p.grad(), self._states[i]))
+        # Fused path: every parameter's update in ONE donated XLA program
+        # (a single Python→XLA dispatch) instead of one kernel dispatch
+        # per parameter. fused_apply declines (→ per-param fallback) for
+        # sparse grads, multi-precision, optimizers without a pure rule,
+        # dist_* kvstores, or MXNET_FUSED_STEP=0.
+        if items and self._fused_update_ok() \
+                and opt_mod.fused_apply(self._optimizer, items):
+            return
+        for i, weight, grad, state in items:
+            self._optimizer.update_multi_precision(i, weight, grad, state)
+
+    def _fused_update_ok(self):
+        from ..model import fused_step_supported
+        return fused_step_supported(self._optimizer, self._kvstore,
+                                    self._update_on_kvstore,
+                                    self._compression_params)
 
     def save_states(self, fname):
         """Reference: trainer.py save_states."""

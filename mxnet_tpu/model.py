@@ -15,7 +15,32 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
            "load_checkpoint", "_create_kvstore", "_initialize_kvstore",
-           "_update_params", "_update_params_on_kvstore"]
+           "_update_params", "_update_params_on_kvstore",
+           "fused_step_supported"]
+
+
+def fused_step_supported(optimizer, kvstore, update_on_kvstore,
+                         compression_params=None):
+    """Whether the fused single-program train step (Executor.train_step)
+    may replace the forward/backward/_update_params sequence for this
+    configuration. The fused path requires a *local* update: server-side
+    updates (update_on_kvstore), ``dist_*`` kvstores, and gradient
+    compression all need the gradients as separate host-visible arrays,
+    and an optimizer without a pure functional rule (or running
+    multi-precision fp16 master copies) has no in-program update to fuse.
+    """
+    from .config import get as _cfg
+    if not _cfg("MXNET_FUSED_STEP"):
+        return False
+    if update_on_kvstore:
+        return False
+    if kvstore is not None and "dist" in getattr(kvstore, "type", ""):
+        return False
+    if compression_params:
+        return False
+    if optimizer is None or getattr(optimizer, "multi_precision", False):
+        return False
+    return optimizer.fused_rule() is not None
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])

@@ -19,7 +19,7 @@ from ..initializer import Uniform, InitDesc
 from ..io import DataDesc
 from ..model import (_create_kvstore, _initialize_kvstore,
                      _update_params, _update_params_on_kvstore,
-                     load_checkpoint, BatchEndParam)
+                     fused_step_supported, load_checkpoint, BatchEndParam)
 from ..ndarray.ndarray import NDArray, zeros
 from .base_module import (BaseModule, _check_input_names, _parse_data_desc,
                           _as_list)
@@ -76,6 +76,7 @@ class Module(BaseModule):
         self._exec = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_batch = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -358,31 +359,111 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # -- computation -------------------------------------------------------
-    def forward(self, data_batch, is_train=None):
-        """Forward (reference: module.py:589). Reshape-on-the-fly is free:
-        jit respecializes per shape signature."""
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _build_feed(self, data_batch):
+        """Executor input dict for a DataBatch (shared by the unfused
+        forward and the fused train step, so both paths stage identical
+        inputs)."""
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
         if self._label_shapes and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        return feed
+
+    def forward(self, data_batch, is_train=None):
+        """Forward (reference: module.py:589). Reshape-on-the-fly is free:
+        jit respecializes per shape signature."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._exec.forward(is_train=is_train, **self._build_feed(data_batch))
         self._params_dirty = True
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         self._exec.backward(out_grads=out_grads)
 
+    # -- fused train step --------------------------------------------------
+    def _fused_step_ok(self):
+        """True when forward+backward+update may run as ONE donated XLA
+        program (Executor.train_step). Falls back for server-side /
+        dist_* kvstore updates, gradient compression, optimizers without
+        a pure rule, multi-precision, monitors (which need per-op
+        outputs), input gradients, and non-'write' grad_req."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        if not fused_step_supported(self._optimizer, self._kvstore,
+                                    self._update_on_kvstore,
+                                    self._compression_params):
+            return False
+        if not isinstance(self._updater, opt.Updater):
+            return False
+        if self._exec._monitor_callback is not None or self.inputs_need_grad:
+            return False
+        for name in self._param_names:
+            if self._exec._grad_req.get(name, "null") not in ("write",
+                                                              "null"):
+                return False
+        return True
+
+    def forward_backward(self, data_batch):
+        """Forward + backward; when the fused step is engaged the batch
+        is deferred and the whole step (forward, gradients, optimizer
+        update) runs as one XLA program inside the following
+        ``update()`` call — outputs become available after it, and the
+        per-parameter gradient buffers (``_exec.grad_dict``) are NOT
+        materialized: gradients exist only inside the program. Reading
+        ``get_outputs()`` before ``update()`` replays the batch unfused
+        (exact legacy semantics, including grad_dict); code that needs
+        host-visible gradients every step should disable the fused path
+        (``MXNET_FUSED_STEP=0``)."""
+        if not isinstance(data_batch, list) and self._fused_step_ok():
+            self._fused_batch = data_batch
+            return
+        # a batch deferred by an earlier call must not survive into the
+        # next update() once the unfused path runs — it would replay the
+        # stale batch over this one's gradients
+        self._fused_batch = None
+        super().forward_backward(data_batch)
+
+    def _run_fused_step(self, data_batch):
+        """Execute one fused train step on ``data_batch`` through
+        Executor.train_step, keeping the Updater's per-index state dict
+        (save/load_optimizer_states) as the source of truth."""
+        exe = self._exec
+        optimizer = self._optimizer
+        updater = self._updater
+        feed = self._build_feed(data_batch)
+        update_names, states, hyper = [], {}, {}
+        for i, name in enumerate(self._param_names):
+            if exe._grad_req.get(name, "null") == "null":
+                continue
+            weight = exe.arg_dict[name]
+            update_names.append(name)
+            states[name] = opt.fused_state_arrays(
+                updater.ensure_state(i, weight))
+            hyper[name] = optimizer.fused_hyper(i)
+        exe.train_step(optimizer.fused_rule(), tuple(update_names),
+                       states, hyper, feed=feed)
+
     def update(self):
         """Apply optimizer to gradients (reference: module.py:644 →
-        model.py _update_params(_on_kvstore))."""
+        model.py _update_params(_on_kvstore)). With a deferred fused
+        batch pending, runs the whole step as one program instead."""
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        data_batch, self._fused_batch = self._fused_batch, None
+        if data_batch is not None:
+            if self._fused_step_ok():
+                self._run_fused_step(data_batch)
+                return
+            # configuration changed between forward_backward and update
+            # (e.g. fused path disabled): replay the unfused sequence
+            self.forward(data_batch, is_train=True)
+            self.backward()
         param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
         grad_arrays = [self._exec.grad_dict[n] for n in self._param_names]
         if self._update_on_kvstore:
@@ -396,6 +477,14 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_batch is not None:
+            # a caller inspecting outputs between forward_backward() and
+            # update() gets exact legacy semantics: replay the deferred
+            # batch unfused (outputs + grads materialize; the following
+            # update() takes the legacy per-param path)
+            batch, self._fused_batch = self._fused_batch, None
+            self.forward(batch, is_train=True)
+            self.backward()
         return list(self._exec.outputs)
 
     def get_input_grads(self, merge_multi_context=True):

@@ -22,7 +22,162 @@ from . import ndarray as nd
 
 __all__ = ["Optimizer", "SGD", "Signum", "NAG", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Ftrl", "FTML", "Adamax", "Nadam", "SGLD", "DCASGD",
-           "Test", "Updater", "get_updater", "create", "register"]
+           "Test", "Updater", "get_updater", "create", "register",
+           "fused_apply", "fused_state_arrays"]
+
+
+# ---------------------------------------------------------------------------
+# fused functional update rules
+#
+# Each rule is a PURE function ``rule(weight, grad, state, hyper) ->
+# (new_weight, new_state)`` over raw jax arrays: ``state`` is a tuple of
+# state arrays (possibly empty), ``hyper`` a dict of python scalars that
+# jit traces as weak-typed 0-d arguments — so a changing learning-rate
+# schedule (or rescale_grad per batch size) NEVER retriggers XLA
+# compilation. The rules mirror the fused kernels in ops/optimizer_ops.py
+# op for op, and every scalar-scalar expression the kernels fold in python
+# (e.g. Adam's ``1 - beta1``) is folded HOST-side into ``hyper`` here, so
+# a fused train step is bitwise-identical to the unfused
+# forward/vjp/per-param-kernel sequence (asserted by
+# tests/test_fused_step.py).
+# ---------------------------------------------------------------------------
+
+def _rule_prep(g, h):
+    """grad * rescale_grad (+ optional clip) — mirrors optimizer_ops
+    ``_prep_grad``. Clip PRESENCE is static (pytree structure); its value
+    is traced."""
+    import jax.numpy as jnp
+    g = g * h["rescale_grad"]
+    if "clip_gradient" in h:
+        g = jnp.clip(g, -h["clip_gradient"], h["clip_gradient"])
+    return g
+
+
+def _sgd_fused(w, g, state, h):
+    g = _rule_prep(g, h)
+    if state:
+        mom = h["momentum"] * state[0] - h["lr"] * (g + h["wd"] * w)
+        return w + mom, (mom,)
+    return w - h["lr"] * (g + h["wd"] * w), ()
+
+
+def _nag_fused(w, g, state, h):
+    if state:
+        g = _rule_prep(g, h) + h["wd"] * w
+        mom = h["momentum"] * state[0] + g
+        return w - h["lr"] * (g + h["momentum"] * mom), (mom,)
+    g = _rule_prep(g, h)
+    return w - h["lr"] * (g + h["wd"] * w), ()
+
+
+def _signum_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h)
+    if state:
+        mom = h["momentum"] * state[0] - h["one_minus_momentum"] * g
+        wn = (h["wdlh_coef"] * w + h["lr"] * jnp.sign(mom)
+              - h["lr_wd"] * w)
+        return wn, (mom,)
+    return w - h["lr"] * (jnp.sign(g) + h["wd"] * w), ()
+
+
+def _adam_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h) + h["wd"] * w
+    mean, var = state
+    mean_new = h["beta1"] * mean + h["one_minus_beta1"] * g
+    var_new = h["beta2"] * var + h["one_minus_beta2"] * jnp.square(g)
+    return (w - h["lr"] * mean_new / (jnp.sqrt(var_new) + h["epsilon"]),
+            (mean_new, var_new))
+
+
+def _adagrad_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h)
+    hist = state[0] + g * g
+    div = g / (jnp.sqrt(hist) + h["eps"])
+    return w - h["lr"] * (div + w * h["wd"]), (hist,)
+
+
+def _rmsprop_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h) + h["wd"] * w
+    if len(state) == 1:                       # plain (Tieleman)
+        n_new = h["gamma1"] * state[0] + h["one_minus_gamma1"] * jnp.square(g)
+        wn = w - h["lr"] * g / jnp.sqrt(n_new + h["epsilon"])
+        if "clip_weights" in h:
+            wn = jnp.clip(wn, -h["clip_weights"], h["clip_weights"])
+        return wn, (n_new,)
+    n, g_acc, delta = state                   # centered (Graves)
+    n_new = h["gamma1"] * n + h["one_minus_gamma1"] * jnp.square(g)
+    g_acc_new = h["gamma1"] * g_acc + h["one_minus_gamma1"] * g
+    delta_new = h["gamma2"] * delta - h["lr"] * g / jnp.sqrt(
+        n_new - jnp.square(g_acc_new) + h["epsilon"])
+    wn = w + delta_new
+    if "clip_weights" in h:
+        wn = jnp.clip(wn, -h["clip_weights"], h["clip_weights"])
+    return wn, (n_new, g_acc_new, delta_new)
+
+
+def _adadelta_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h)
+    acc_g, acc_delta = state
+    acc_g_new = h["rho"] * acc_g + h["one_minus_rho"] * g * g
+    cd = (jnp.sqrt(acc_delta + h["epsilon"])
+          / jnp.sqrt(acc_g_new + h["epsilon"])) * g
+    acc_delta_new = h["rho"] * acc_delta + h["one_minus_rho"] * cd * cd
+    return w - cd - h["wd"] * w, (acc_g_new, acc_delta_new)
+
+
+def _ftrl_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h)
+    z, n = state
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / h["lr"]
+    z_new = z + g - sigma * w
+    wn = jnp.where(
+        jnp.abs(z_new) <= h["lamda1"], jnp.zeros_like(w),
+        -(z_new - jnp.sign(z_new) * h["lamda1"])
+        / ((h["beta"] + jnp.sqrt(n_new)) / h["lr"] + h["wd"]))
+    return wn, (z_new, n_new)
+
+
+def _ftml_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = _rule_prep(g, h) + h["wd"] * w
+    d, v, z = state
+    v_new = h["beta2"] * v + h["one_minus_beta2"] * jnp.square(g)
+    d_new = h["d_coef"] * (jnp.sqrt(v_new / h["v_coef"]) + h["epsilon"])
+    sigma = d_new - h["beta1"] * d
+    z_new = h["beta1"] * z + h["one_minus_beta1"] * g - sigma * w
+    return -z_new / d_new, (d_new, v_new, z_new)
+
+
+def _adamax_fused(w, g, state, h):
+    import jax.numpy as jnp
+    g = g * h["rescale_grad"] + h["wd"] * w
+    if "clip_gradient" in h:
+        g = jnp.clip(g, -h["clip_gradient"], h["clip_gradient"])
+    m, u = state
+    m_new = h["beta1"] * m + h["one_minus_beta1"] * g
+    u_new = jnp.maximum(h["beta2"] * u, jnp.abs(g))
+    return w - h["lr"] * m_new / u_new, (m_new, u_new)
+
+
+def _test_fused(w, g, state, h):
+    return (w - h["lr"] * g * h["rescale_grad"], (state[0] + g,))
+
+
+def fused_state_arrays(state):
+    """Normalize an optimizer state (None | NDArray | tuple) to the flat
+    tuple of NDArray buffers a fused rule consumes/produces."""
+    if state is None:
+        return ()
+    if isinstance(state, NDArray):
+        return (state,)
+    return tuple(state)
 
 
 class Optimizer(object):
@@ -93,6 +248,27 @@ class Optimizer(object):
     def update(self, index, weight, grad, state):
         """Update the weight given gradient and state. Override."""
         raise NotImplementedError()
+
+    # -- fused train-step support ------------------------------------------
+    def fused_rule(self):
+        """Pure functional update rule for the fused train-step path
+        (Executor.train_step / fused_apply):
+        ``rule(weight, grad, state_tuple, hyper) -> (new_w, new_state_tuple)``
+        on raw jax arrays. None (the default) = no pure rule; fused
+        callers fall back to the per-param update() path."""
+        return None
+
+    def fused_hyper(self, index):
+        """Per-step scalar hyperparameters for ``fused_rule`` — advances
+        the same update-count/lr-schedule bookkeeping as update(), so a
+        fused and an unfused run see identical schedules."""
+        self._update_count(index)
+        h = {"lr": float(self._get_lr(index)),
+             "wd": float(self._get_wd(index)),
+             "rescale_grad": float(self.rescale_grad)}
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            h["clip_gradient"] = float(self.clip_gradient)
+        return h
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == numpy.float16:
@@ -175,6 +351,9 @@ class Optimizer(object):
 
     def __getstate__(self):
         ret = self.__dict__.copy()
+        # jitted fused-update programs are not picklable (and rebuild
+        # cheaply on first use after deserialization)
+        ret.pop("_fused_apply_cache", None)
         return ret
 
     def __setstate__(self, state):
@@ -211,6 +390,14 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return None
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def fused_rule(self):
+        return _sgd_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["momentum"] = float(self.momentum)
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -270,6 +457,17 @@ class Signum(Optimizer):
             return None
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
+    def fused_rule(self):
+        return _signum_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["momentum"] = float(self.momentum)
+        h["one_minus_momentum"] = 1.0 - float(self.momentum)
+        h["wdlh_coef"] = 1.0 - h["lr"] * float(self.wd_lh)
+        h["lr_wd"] = h["lr"] * h["wd"]
+        return h
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -293,6 +491,14 @@ class NAG(Optimizer):
         if self.momentum == 0.0:
             return None
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def fused_rule(self):
+        return _nag_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["momentum"] = float(self.momentum)
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -320,6 +526,22 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def fused_rule(self):
+        return _adam_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        h["lr"] = float(h["lr"] * (numpy.sqrt(coef2) / coef1))
+        h["beta1"] = float(self.beta1)
+        h["beta2"] = float(self.beta2)
+        h["one_minus_beta1"] = 1.0 - float(self.beta1)
+        h["one_minus_beta2"] = 1.0 - float(self.beta2)
+        h["epsilon"] = float(self.epsilon)
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -360,6 +582,18 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
+    def fused_rule(self):
+        return _adagrad_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["eps"] = float(self.float_stable_eps)
+        if self.clip_gradient is not None:
+            # the eager update() clips whenever clip_gradient is set
+            # (not only when > 0, unlike the fused kernels)
+            h["clip_gradient"] = float(self.clip_gradient)
+        return h
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -393,6 +627,20 @@ class RMSProp(Optimizer):
                     zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # delta
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
+    def fused_rule(self):
+        return _rmsprop_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["gamma1"] = float(self.gamma1)
+        h["one_minus_gamma1"] = 1.0 - float(self.gamma1)
+        h["epsilon"] = float(self.epsilon)
+        if self.centered:
+            h["gamma2"] = float(self.gamma2)
+        if self.clip_weights is not None and self.clip_weights > 0:
+            h["clip_weights"] = float(self.clip_weights)
+        return h
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -421,6 +669,19 @@ class AdaDelta(Optimizer):
     def create_state(self, index, weight):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def fused_rule(self):
+        return _adadelta_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["rho"] = float(self.rho)
+        h["one_minus_rho"] = 1.0 - float(self.rho)
+        h["epsilon"] = float(self.epsilon)
+        if self.clip_gradient is not None:
+            # eager update() clips whenever clip_gradient is set
+            h["clip_gradient"] = float(self.clip_gradient)
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -451,6 +712,15 @@ class Ftrl(Optimizer):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # z
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # n
 
+    def fused_rule(self):
+        return _ftrl_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        h["lamda1"] = float(self.lamda1)
+        h["beta"] = float(self.beta)
+        return h
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -474,6 +744,23 @@ class FTML(Optimizer):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # d
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # v
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))  # z
+
+    def fused_rule(self):
+        return _ftml_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        t = self._index_update_count[index]
+        # host-fold the scalar coefficients exactly as the ftml_update
+        # kernel folds its python attrs, for bitwise fused/unfused parity
+        h["beta1"] = float(self.beta1)
+        h["one_minus_beta1"] = 1.0 - float(self.beta1)
+        h["beta2"] = float(self.beta2)
+        h["one_minus_beta2"] = 1.0 - float(self.beta2)
+        h["epsilon"] = float(self.epsilon)
+        h["d_coef"] = (1.0 - self.beta1 ** t) / h["lr"]
+        h["v_coef"] = 1.0 - self.beta2 ** t
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -499,6 +786,21 @@ class Adamax(Optimizer):
     def create_state(self, index, weight):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def fused_rule(self):
+        return _adamax_fused
+
+    def fused_hyper(self, index):
+        h = super().fused_hyper(index)
+        t = self._index_update_count[index]
+        h["lr"] = float(h["lr"] / (1.0 - self.beta1 ** t))
+        h["beta1"] = float(self.beta1)
+        h["one_minus_beta1"] = 1.0 - float(self.beta1)
+        h["beta2"] = float(self.beta2)
+        if self.clip_gradient is not None:
+            # eager update() clips whenever clip_gradient is set
+            h["clip_gradient"] = float(self.clip_gradient)
+        return h
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -703,6 +1005,15 @@ class Test(Optimizer):
     def create_state(self, index, weight):
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
+    def fused_rule(self):
+        return _test_fused
+
+    def fused_hyper(self, index):
+        # mirror update() exactly: raw self.lr, no scheduler/multipliers,
+        # no update-count bookkeeping
+        return {"lr": float(self.lr),
+                "rescale_grad": float(self.rescale_grad)}
+
     def update(self, index, weight, grad, state):
         weight._set_data((weight - self.lr * grad * self.rescale_grad)._data)
         state._set_data((state + grad)._data)
@@ -717,7 +1028,10 @@ class Updater(object):
         self.states = {}
         self.states_synced = {}
 
-    def __call__(self, index, grad, weight):
+    def ensure_state(self, index, weight):
+        """Lazily create (or context-sync a deserialized) state for
+        ``index``; returns it. Shared by the per-param path below and the
+        fused train step, so their bookkeeping can never drift."""
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
@@ -726,8 +1040,12 @@ class Updater(object):
             self.states[index] = self.sync_state_context(self.states[index],
                                                          weight.context)
             self.states_synced[index] = True
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
         self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+                                              self.ensure_state(index,
+                                                                weight))
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
@@ -769,3 +1087,69 @@ def _to_numpy_state(state):
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# fused whole-pytree update (one XLA program for every parameter)
+# ---------------------------------------------------------------------------
+
+def fused_apply(optimizer, items):
+    """Apply ``optimizer`` to every ``(index, weight, grad, state)`` in
+    ``items`` through ONE jitted XLA program with the weight and state
+    buffers donated (input→output aliasing: in-place HBM update, a single
+    Python→XLA dispatch instead of one per parameter — the Gluon Trainer
+    analog of Executor.train_step).
+
+    Returns True when the fused path ran (weights/states updated in
+    place); False when this optimizer/configuration has no pure rule —
+    the caller must then run the per-param update() path. Scalar
+    hyperparameters (lr schedule, rescale_grad) are traced, so their
+    value changes never recompile.
+    """
+    from .config import get as _cfg
+    if not items or not _cfg("MXNET_FUSED_STEP"):
+        return False
+    rule = optimizer.fused_rule()
+    if rule is None or optimizer.multi_precision:
+        return False
+    from .ndarray.sparse import BaseSparseNDArray
+    for _i, w, g, _s in items:
+        if isinstance(w, BaseSparseNDArray) or isinstance(g, BaseSparseNDArray):
+            return False
+
+    state_tuples = [fused_state_arrays(s) for (_i, _w, _g, s) in items]
+    hyper = [optimizer.fused_hyper(i) for (i, _w, _g, _s) in items]
+
+    cache = optimizer.__dict__.setdefault("_fused_apply_cache", {})
+    # donation honors the same knob as the per-param update kernels
+    # (ops/registry.py _donation_allowed)
+    donate = bool(_cfg("MXNET_UPDATE_BUFFER_DONATION"))
+    cache_key = (rule, len(items), donate)
+    jfn = cache.get(cache_key)
+    if jfn is None:
+        import jax
+        from .base import install_donation_warning_filter
+        install_donation_warning_filter()
+
+        def apply_all(ws, gs, ss, hs):
+            new = [rule(w, g, s, h) for w, g, s, h in zip(ws, gs, ss, hs)]
+            return [n[0] for n in new], [n[1] for n in new]
+
+        jfn = jax.jit(apply_all, donate_argnums=(0, 2) if donate else ())
+        cache[cache_key] = jfn
+
+    ws = [w._data for (_i, w, _g, _s) in items]
+    gs = [g._data for (_i, _w, g, _s) in items]
+    ss = [tuple(a._data for a in tup) for tup in state_tuples]
+
+    from . import telemetry as _tm
+    token = _tm.dispatch_begin() if _tm._enabled else None
+    new_ws, new_ss = jfn(ws, gs, ss, hyper)
+    if token is not None:
+        _tm.dispatch_end("fused_optimizer_update", token)
+
+    for (item, nw, ns, tup) in zip(items, new_ws, new_ss, state_tuples):
+        item[1]._set_data(nw)
+        for tgt, val in zip(tup, ns):
+            tgt._set_data(val)
+    return True

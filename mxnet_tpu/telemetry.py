@@ -647,7 +647,16 @@ def snapshot():
            "jit_cache_hits": _val("jit/cache_hits_total"),
            "jit_cache_misses": _val("jit/cache_misses_total"),
            "backend_compile_total": _compile_count,
-           "backend_compile_seconds": round(_compile_time, 3)}
+           "backend_compile_seconds": round(_compile_time, 3),
+           # fused train-step accounting (executor.train_step): steps
+           # run, program builds, and python-cache hit/miss — the
+           # O(1)-dispatch-per-step evidence banked with bench records
+           "fused_step_total": _val("executor/fused_step_total"),
+           "fused_step_compiles": _val("executor/fused_step_compile_total"),
+           "fused_step_cache_hits":
+               _val("executor/fused_step_cache_hit_total"),
+           "fused_step_cache_misses":
+               _val("executor/fused_step_cache_miss_total")}
     try:
         from . import storage
         stats = storage.memory_stats()

@@ -1,0 +1,478 @@
+"""Fused train-step tests: one donated XLA program per step
+(Executor.train_step) must be bitwise-identical to the unfused
+forward-jit / vjp-jit / per-parameter-update sequence, cost exactly ONE
+host dispatch, and never recompile on learning-rate changes.
+
+Reference analogs: the GraphExecutor's op bulking + the fused optimizer
+kernels of src/operator/optimizer_op.cc, collapsed across the step
+boundary.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.module import Module
+
+
+def _mlp_sym(hidden=(32, 16), num_classes=10):
+    net = mx.sym.Variable("data")
+    for i, h in enumerate(hidden):
+        net = mx.sym.FullyConnected(net, name="fc%d" % (i + 1), num_hidden=h)
+        net = mx.sym.Activation(net, name="relu%d" % (i + 1),
+                                act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fcout", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _batches(steps, batch, dim=64, num_classes=10, seed=3):
+    rng = np.random.RandomState(seed)
+    return [io.DataBatch(
+        data=[mx.nd.array(rng.randn(batch, dim).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, num_classes, batch)
+                           .astype(np.float32))])
+        for _ in range(steps)]
+
+
+def _make_module(optimizer, opt_params, batch=16, dim=64, seed=11,
+                 lr_scheduler=None):
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, dim))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    rng = np.random.RandomState(seed)
+    args = {n: mx.nd.array(rng.randn(*a.shape).astype(np.float32) * 0.1)
+            for n, a in mod._exec.arg_dict.items()
+            if n not in ("data", "softmax_label")}
+    mod.set_params(args, {}, allow_missing=True, force_init=True)
+    params = dict(opt_params)
+    if lr_scheduler is not None:
+        params["lr_scheduler"] = lr_scheduler
+    mod.init_optimizer(optimizer=optimizer, optimizer_params=params)
+    return mod
+
+
+def _train(mod, batches):
+    for db in batches:
+        mod.forward_backward(db)
+        mod.update()
+    return {n: mod._exec.arg_dict[n].asnumpy() for n in mod._param_names}
+
+
+OPT_CONFIGS = [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4,
+             "clip_gradient": 0.5, "rescale_grad": 1.0 / 16}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "clip_gradient": 1.0,
+              "rescale_grad": 1.0 / 16}),
+]
+
+
+@pytest.mark.parametrize("optimizer,opt_params", OPT_CONFIGS)
+def test_fused_unfused_bitwise_parity(monkeypatch, optimizer, opt_params):
+    """N fused steps == N unfused steps, bit for bit (SGD momentum/wd,
+    Adam, clip_gradient/rescale_grad)."""
+    batches = _batches(5, 16)
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    mod_f = _make_module(optimizer, opt_params)
+    assert mod_f._fused_step_ok()
+    fused = _train(mod_f, batches)
+    # the fused path must actually have run (one cached program, N steps)
+    assert mod_f._exec._fused_jitted, "fused program cache is empty"
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    mod_u = _make_module(optimizer, opt_params)
+    assert not mod_u._fused_step_ok()
+    unfused = _train(mod_u, batches)
+
+    assert set(fused) == set(unfused)
+    for name in fused:
+        assert np.array_equal(fused[name], unfused[name]), \
+            "param %r diverged (max |d|=%g)" % (
+                name, np.max(np.abs(fused[name] - unfused[name])))
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    # one representative per rule family in tier-1; the rest ride the
+    # slow marker (full coverage, outside the tier-1 time budget)
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9, "wd_lh": 1e-4}),
+    ("rmsprop", {"learning_rate": 1e-3, "centered": True}),
+    ("adagrad", {"learning_rate": 0.05}),
+    # eager update() clips whenever clip_gradient is set, even 0.0 —
+    # the fused hyper must reproduce that (not the kernels' >0 gate)
+    ("adagrad", {"learning_rate": 0.05, "clip_gradient": 0.0}),
+    ("ftrl", {}),
+    pytest.param("nag", {"learning_rate": 0.05, "momentum": 0.9},
+                 marks=pytest.mark.slow),
+    pytest.param("adadelta", {}, marks=pytest.mark.slow),
+    pytest.param("ftml", {}, marks=pytest.mark.slow),
+    pytest.param("adamax", {}, marks=pytest.mark.slow),
+])
+def test_fused_unfused_parity_other_optimizers(monkeypatch, optimizer,
+                                               opt_params):
+    """The remaining fused rules track their unfused kernels. Gradients
+    and optimizer states stay bitwise-identical; the weights themselves
+    may differ in the last ulp because XLA fuses the update arithmetic
+    with the gradient producer (FMA contraction) where the unfused path
+    rounds between separately-compiled kernels — so weights get a
+    one-ulp-tight allclose here (the strict bitwise guarantee is
+    asserted above for SGD/Adam, whose update kernels fuse identically)."""
+    batches = _batches(4, 16)
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    fused = _train(_make_module(optimizer, opt_params), batches)
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    unfused = _train(_make_module(optimizer, opt_params), batches)
+    for name in fused:
+        np.testing.assert_allclose(fused[name], unfused[name],
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_fused_step_single_dispatch(monkeypatch):
+    """One fused step = exactly ONE op dispatch (the fused_train_step
+    program launch); the per-op eager counters must not tick for ops now
+    executing inside the fused program (the double-count fix)."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    prev = tm.enable(True)
+    try:
+        mod = _make_module("sgd", {"learning_rate": 0.1, "momentum": 0.9})
+        batches = _batches(3, 16)
+        _train(mod, batches[:2])            # build + warm the program
+
+        before = tm.snapshot()
+        fam = tm.REGISTRY._families.get("op/dispatch_total")
+        per_op_before = {lv: c.value for lv, c in fam.series()}
+        mod.forward_backward(batches[2])
+        mod.update()
+        after = tm.snapshot()
+
+        assert after["op_dispatch_total"] - before["op_dispatch_total"] == 1
+        assert after["fused_step_total"] - before["fused_step_total"] == 1
+        per_op_after = {lv: c.value for lv, c in fam.series()}
+        for lv, count in per_op_after.items():
+            if lv == ("fused_train_step",):
+                assert count == per_op_before.get(lv, 0) + 1
+            else:
+                assert count == per_op_before.get(lv, 0), \
+                    "per-op counter %r ticked during a fused step" % (lv,)
+    finally:
+        tm.enable(prev)
+
+
+def test_lr_schedule_does_not_recompile(monkeypatch):
+    """10 steps under a per-step decaying LR schedule: zero XLA backend
+    compiles (jax.monitoring listener) and zero fused program rebuilds —
+    the lr is a traced scalar, not a baked constant."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    prev = tm.enable(True)      # installs the jax.monitoring listener
+    try:
+        sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.9)
+        mod = _make_module("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                           lr_scheduler=sched)
+        batches = _batches(12, 16)
+        _train(mod, batches[:2])            # compile + commit buffers
+
+        lr_before = mod._optimizer._get_lr(0)
+        compiles_before = tm.compile_count()
+        builds_before = tm.snapshot()["fused_step_compiles"]
+        _train(mod, batches[2:])
+        assert tm.compile_count() == compiles_before, \
+            "lr schedule step retriggered XLA compilation"
+        assert tm.snapshot()["fused_step_compiles"] == builds_before
+        # the schedule really advanced (so the zero-recompile claim is
+        # about changing lr values, not a frozen schedule)
+        assert mod._optimizer._get_lr(0) < lr_before * 0.5
+    finally:
+        tm.enable(prev)
+
+
+def test_fused_convergence_and_states(monkeypatch):
+    """Fused fit converges like the unfused path and keeps the Updater's
+    state dict live for save/load_optimizer_states."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    rng = np.random.RandomState(7)
+    centers = rng.randn(10, 64).astype(np.float32) * 1.5
+    labels = rng.randint(0, 10, size=500)
+    data = (centers[labels] + rng.randn(500, 64)).astype(np.float32)
+    it = io.NDArrayIter(data, labels.astype(np.float32), batch_size=50,
+                        shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=5, optimizer="sgd",
+            initializer=mx.init.Xavier(magnitude=2.0),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    score = mod.score(io.NDArrayIter(data, labels.astype(np.float32),
+                                     batch_size=50), "acc")
+    assert score[0][1] > 0.95, score
+    # momentum states materialized in the Updater (index-keyed, NDArray)
+    states = mod._updater.states
+    assert states and all(s is not None for s in states.values())
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".states") as f:
+        mod.save_optimizer_states(f.name)
+        mod.load_optimizer_states(f.name)
+
+
+def test_fused_fallbacks(monkeypatch):
+    """Monitors, non-write grad_req, multi-precision, unknown-rule
+    optimizers, and MXNET_FUSED_STEP=0 all disable the fused step."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    mod = _make_module("sgd", {"learning_rate": 0.1})
+    assert mod._fused_step_ok()
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    assert not mod._fused_step_ok()
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+
+    # a monitor needs per-op outputs -> unfused
+    mod._exec.set_monitor_callback(lambda name, arr: None)
+    assert not mod._fused_step_ok()
+    mod._exec._monitor_callback = None
+    assert mod._fused_step_ok()
+
+    # optimizer without a pure rule -> unfused
+    mod2 = _make_module("nadam", {"learning_rate": 1e-3})
+    assert not mod2._fused_step_ok()
+    batches = _batches(2, 16)
+    _train(mod2, batches)                   # still trains via fallback
+    assert not mod2._exec._fused_jitted
+
+    # grad_req='add' -> unfused
+    mod3 = Module(_mlp_sym(), context=mx.cpu())
+    mod3.bind(data_shapes=[("data", (16, 64))],
+              label_shapes=[("softmax_label", (16,))], grad_req="add")
+    mod3.init_params()
+    mod3.init_optimizer(optimizer="sgd")
+    assert not mod3._fused_step_ok()
+
+    # multi-precision -> unfused
+    mod4 = _make_module("sgd", {"learning_rate": 0.1,
+                                "multi_precision": True})
+    assert not mod4._fused_step_ok()
+
+
+def test_get_outputs_mid_step_replays_unfused(monkeypatch):
+    """Inspecting outputs between forward_backward() and update() keeps
+    exact legacy semantics: the deferred batch is replayed unfused, so
+    the user sees THIS batch's outputs and the whole run matches a pure
+    unfused run bitwise."""
+    batches = _batches(3, 16, seed=8)
+
+    def run(fused, peek):
+        monkeypatch.setenv("MXNET_FUSED_STEP", "1" if fused else "0")
+        mod = _make_module("sgd", {"learning_rate": 0.1, "momentum": 0.9})
+        peeked = []
+        for db in batches:
+            mod.forward_backward(db)
+            if peek:
+                peeked.append(mod.get_outputs()[0].asnumpy())
+            mod.update()
+        params = {n: mod._exec.arg_dict[n].asnumpy()
+                  for n in mod._param_names}
+        return params, peeked
+
+    fused_params, fused_outs = run(True, peek=True)
+    ref_params, ref_outs = run(False, peek=True)
+    for a, b in zip(fused_outs, ref_outs):
+        assert np.array_equal(a, b)
+    for name in ref_params:
+        assert np.array_equal(fused_params[name], ref_params[name]), name
+
+
+def test_deferred_batch_cleared_on_unfused_fallback(monkeypatch):
+    """A batch deferred by the fused path must not be replayed by a later
+    update() after the configuration flipped to unfused mid-step — the
+    run must match a pure unfused run on the same batch sequence."""
+    b1, b2 = _batches(2, 16, seed=9)
+
+    def run(flip):
+        monkeypatch.setenv("MXNET_FUSED_STEP", "1" if flip else "0")
+        mod = _make_module("sgd", {"learning_rate": 0.1, "momentum": 0.9})
+        if flip:
+            mod.forward_backward(b1)        # deferred (fused eligible)
+            monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+        mod.forward_backward(b1)            # unfused fwd/bwd on b1
+        mod.update()
+        mod.forward_backward(b2)
+        mod.update()
+        return {n: mod._exec.arg_dict[n].asnumpy()
+                for n in mod._param_names}
+
+    flipped, reference = run(True), run(False)
+    for name in reference:
+        assert np.array_equal(flipped[name], reference[name]), \
+            "stale deferred batch leaked into the unfused step (%s)" % name
+
+
+def test_forward_kwargs_device_placement():
+    """Host inputs fed through forward(**kwargs) must land on the
+    executor's bound context, not JAX's default device."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    ctx = mx.cpu(1)
+    sym = _mlp_sym()
+    exe = sym.simple_bind(
+        ctx, grad_req={n: "null" for n in sym.list_arguments()},
+        data=(8, 64), softmax_label=(8,))
+    exe.forward(is_train=False, data=np.zeros((8, 64), np.float32))
+    placed = exe.arg_dict["data"]._data
+    assert list(placed.devices()) == [ctx.jax_device()]
+    assert exe.outputs[0].shape == (8, 10)
+
+
+def test_backward_add_accumulates_inside_program():
+    """grad_req='add' accumulation runs inside the jitted vjp: two
+    backward passes double the gradient, with no per-parameter host-side
+    add."""
+    sym = _mlp_sym()
+    reqs = {n: "null" if n in ("data", "softmax_label") else "add"
+            for n in sym.list_arguments()}
+    exe = sym.simple_bind(mx.cpu(0), grad_req=reqs, data=(8, 64),
+                          softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    for n, arr in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            arr._set_data(mx.nd.array(
+                rng.randn(*arr.shape).astype(np.float32) * 0.1)._data)
+    feed = {"data": rng.randn(8, 64).astype(np.float32),
+            "softmax_label": rng.randint(0, 10, 8).astype(np.float32)}
+    exe.forward(is_train=True, **feed)
+    exe.backward()
+    g1 = exe.grad_dict["fc1_weight"].asnumpy().copy()
+    assert np.abs(g1).sum() > 0
+    exe.forward(is_train=True, **feed)
+    exe.backward()
+    g2 = exe.grad_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-6, atol=1e-7)
+
+
+def test_trainer_fused_update(monkeypatch):
+    """Gluon Trainer.step: the whole-pytree fused update matches the
+    per-parameter path and costs one dispatch."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        return net
+
+    def run(fused, steps=4):
+        monkeypatch.setenv("MXNET_FUSED_STEP", "1" if fused else "0")
+        rng = np.random.RandomState(2)
+        net = build()
+        net(mx.nd.zeros((8, 8)))        # materialize deferred shapes
+        seed_rng = np.random.RandomState(5)
+        for _name, p in sorted(net.collect_params().items()):
+            p.set_data(mx.nd.array(
+                seed_rng.randn(*p.shape).astype(np.float32) * 0.1))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        x = mx.nd.array(rng.randn(8, 8).astype(np.float32))
+        y = mx.nd.array(rng.randn(8, 4).astype(np.float32))
+        lfn = gluon.loss.L2Loss()
+        for _ in range(steps):
+            with autograd.record():
+                loss = lfn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        # block name counters are process-global, so key by the suffix
+        # (dense0_weight, ...) which is stable across the two runs
+        return {name.split("_", 1)[1]: p.data().asnumpy()
+                for name, p in net.collect_params().items()}
+
+    fused = run(True)
+    unfused = run(False)
+    assert set(fused) == set(unfused) and len(fused) == 4
+    for name in fused:
+        assert np.array_equal(fused[name], unfused[name]), name
+
+
+def test_trainer_fused_single_dispatch(monkeypatch):
+    """After warmup, a Trainer step's update is ONE dispatch
+    (fused_optimizer_update), not one per parameter."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    prev = tm.enable(True)
+    try:
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        rng = np.random.RandomState(2)
+        x = mx.nd.array(rng.randn(8, 8).astype(np.float32))
+        y = mx.nd.array(rng.randn(8, 4).astype(np.float32))
+        lfn = gluon.loss.L2Loss()
+
+        def step():
+            with autograd.record():
+                loss = lfn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+
+        step()                                  # warm
+        fam = tm.REGISTRY._families.get("op/dispatch_total")
+        before = {lv: c.value for lv, c in fam.series()}
+        step()
+        after = {lv: c.value for lv, c in fam.series()}
+        assert (after.get(("fused_optimizer_update",), 0)
+                - before.get(("fused_optimizer_update",), 0)) == 1
+        for name in ("sgd_mom_update", "sgd_update"):
+            assert after.get((name,), 0) == before.get((name,), 0), \
+                "per-param optimizer kernel dispatched on the fused path"
+    finally:
+        tm.enable(prev)
+
+
+def test_fused_step_dp_mesh_matches_single_device(monkeypatch):
+    """The fused program under a data-parallel mesh (GSPMD folds the
+    gradient all-reduce into the same program) tracks single-device
+    training."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+
+    def losses(contexts, steps=6, batch=32):
+        rng = np.random.RandomState(4)
+        centers = rng.randn(10, 64).astype(np.float32) * 1.5
+        labels = rng.randint(0, 10, size=256)
+        data = (centers[labels] + rng.randn(256, 64)).astype(np.float32)
+        mod = Module(_mlp_sym(), context=contexts)
+        mod.bind(data_shapes=[("data", (batch, 64))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+        prng = np.random.RandomState(11)
+        args = {n: mx.nd.array(prng.randn(*a.shape).astype(np.float32)
+                               * 0.05)
+                for n, a in mod._exec.arg_dict.items()
+                if n not in ("data", "softmax_label")}
+        mod.set_params(args, {}, allow_missing=True, force_init=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        out = []
+        for i in range(steps):
+            lo = (i * batch) % (len(data) - batch)
+            db = io.DataBatch(
+                data=[mx.nd.array(data[lo:lo + batch])],
+                label=[mx.nd.array(labels[lo:lo + batch])])
+            mod.forward_backward(db)
+            mod.update()
+            probs = mod.get_outputs()[0].asnumpy()
+            li = labels[lo:lo + batch].astype(int)
+            out.append(float(-np.mean(np.log(np.maximum(
+                probs[np.arange(batch), li], 1e-10)))))
+        assert mod._exec._fused_jitted, "fused path did not engage"
+        return out
+
+    single = losses(mx.cpu(0))
+    multi = losses([mx.cpu(i) for i in range(4)])
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=2e-5)
+    assert single[-1] < single[0], "training did not reduce loss"
