@@ -105,7 +105,8 @@ def main():
     fallbacks = (HEADLINE, "resnet50_train_bf16_img_per_sec",
                  "resnet50_infer_img_per_sec",
                  "transformer_lm_tokens_per_sec", "mlp_train_img_per_sec",
-                 "mlp_train_fused_img_per_sec")
+                 "mlp_train_fused_img_per_sec",
+                 "predictor_serve_req_per_sec")
 
     def pick(pred):
         best = None

@@ -834,6 +834,126 @@ def train_mlp(batch=64, iters=50, steps_per_call=32):
 
 
 # ---------------------------------------------------------------------------
+# serving job (serve.InferenceEngine under offered load)
+
+def serve_predictor(offered_rps=400, clients=16, duration=4.0,
+                    max_batch=16, feature=256, hidden=256, classes=64,
+                    batch_wait_ms=2):
+    """Online-serving throughput/latency at FIXED offered load: N client
+    threads each fire requests on an absolute schedule totalling
+    ``offered_rps`` through the dynamic micro-batcher
+    (serve.InferenceEngine), and we bank achieved req/s, p50/p99
+    latency, the realized mean batch size, and padding waste — the
+    serving analog of the training jobs' img/s+telemetry records. The
+    model is a small MLP so the number probes the BATCHING ENGINE
+    (queueing, coalescing, bucket dispatch), not matmul throughput."""
+    import tempfile
+    import threading
+    import mxnet_tpu as mx
+    from . import telemetry as _tm
+    from .serve import InferenceEngine, ServeConfig
+    from .serving import Predictor
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    sym = mx.sym.softmax(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+        name="prob")
+    rng = np.random.RandomState(0)
+    params = {
+        "arg:fc1_weight": mx.nd.array(
+            rng.randn(hidden, feature).astype(np.float32) * 0.05),
+        "arg:fc1_bias": mx.nd.array(np.zeros(hidden, np.float32)),
+        "arg:fc2_weight": mx.nd.array(
+            rng.randn(classes, hidden).astype(np.float32) * 0.05),
+        "arg:fc2_bias": mx.nd.array(np.zeros(classes, np.float32)),
+    }
+    with tempfile.NamedTemporaryFile(suffix=".params") as f:
+        mx.nd.save(f.name, params)
+        f.seek(0)
+        blob = f.read()
+    import jax
+    dev_type = 2 if jax.devices()[0].platform == "tpu" else 1
+    pred = Predictor(sym.tojson(), blob, dev_type=dev_type,
+                     input_shapes={"data": (1, feature)})
+    cfg = ServeConfig(max_batch=max_batch, queue_depth=4 * max_batch,
+                      batch_wait_ms=batch_wait_ms,
+                      default_timeout_ms=10000, workers=1)
+    eng = InferenceEngine(pred, cfg).start().warmup()
+
+    def _hist_state(name):
+        fam = _tm.REGISTRY._families.get(name)
+        if fam is None:
+            return 0.0, 0
+        series = fam.series()
+        return (sum(c.sum for _lv, c in series),
+                sum(c.count for _lv, c in series))
+
+    # every serving figure is banked as a DELTA over the bench window,
+    # like compiles_after_warmup — cumulative process counters would
+    # fold any earlier serve traffic into this record
+    snap0 = _tm.snapshot()
+    rows0, nb0 = _hist_state("serving/batch_rows")
+    waste0, nw0 = _hist_state("serving/padding_waste_ratio")
+    per_client = [[] for _ in range(clients)]
+    errors = [0] * clients
+    interval = clients / float(offered_rps)
+    t_start = time.time() + 0.05
+
+    def client(idx):
+        # per-thread RandomState: the shared module-level rng is not
+        # thread-safe under concurrent draws
+        x = np.random.RandomState(1000 + idx).randn(
+            1, feature).astype(np.float32) + idx
+        tick = t_start + idx * interval / clients
+        while tick < t_start + duration:
+            now = time.time()
+            if now < tick:
+                time.sleep(tick - now)
+            t0 = time.time()
+            try:
+                eng.predict({"data": x})
+                per_client[idx].append(time.time() - t0)
+            except Exception:
+                errors[idx] += 1
+            tick += interval
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.close(drain=True)
+
+    lat = np.array(sorted(sum(per_client, [])))
+    snap = _tm.snapshot()
+    rows1, nb1 = _hist_state("serving/batch_rows")
+    waste1, nw1 = _hist_state("serving/padding_waste_ratio")
+    if not len(lat):
+        raise RuntimeError("no request completed; nothing to bank")
+    rps = len(lat) / duration
+    nb, nw = max(1, nb1 - nb0), max(1, nw1 - nw0)
+    extra = {
+        "offered_rps": offered_rps, "clients": clients,
+        "duration_s": duration, "errors": int(sum(errors)),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "mean_batch_rows": round((rows1 - rows0) / nb, 3),
+        "padding_waste_pct": round(100 * (waste1 - waste0) / nw, 2),
+        "batches": snap["serve_batches"] - snap0["serve_batches"],
+        "rejected": snap["serve_rejected"] - snap0["serve_rejected"],
+        "timeouts": snap["serve_timeouts"] - snap0["serve_timeouts"],
+        "compiles_after_warmup": (snap["backend_compile_total"]
+                                  - snap0["backend_compile_total"]),
+        "buckets": list(cfg.buckets),
+    }
+    return rps, extra
+
+
+# ---------------------------------------------------------------------------
 # inference jobs (benchmark_score.py port)
 
 _SCORE_MODELS = {
@@ -1102,6 +1222,13 @@ def _job_e2e_train():
                    "img/s (resnet50 bf16 train, data pipeline in loop)", x)
 
 
+def _job_predictor_serve():
+    v, x = serve_predictor()
+    return persist("predictor_serve_req_per_sec", v,
+                   "req/s (MLP predictor, dynamic micro-batching, "
+                   "16 clients fixed offered load)", x)
+
+
 def _job_infer_int8():
     v, x = infer_quantized("resnet50")
     return persist("resnet50_infer_int8_img_per_sec", v,
@@ -1123,6 +1250,7 @@ JOBS = {
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
+    "predictor_serve": _job_predictor_serve,
     "data_pipeline": _job_data_pipeline,
     "transformer_lm": _job_transformer_lm,
     "data_pipeline_native": _job_data_pipeline_native,
@@ -1147,6 +1275,7 @@ JOBS["resnet50_infer_b128"] = _make_infer_job("resnet50", "float32",
 JOB_PRIORITY = [
     "mlp_train",
     "mlp_train_fused",
+    "predictor_serve",
     "data_pipeline",
     "data_pipeline_native",
     "resnet50_train",

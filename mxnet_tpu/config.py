@@ -64,6 +64,36 @@ VARS = {
                         "dispatch, jit-cache, HBM, kvstore, io "
                         "instruments. 0 removes the hot-path hooks "
                         "entirely; telemetry.enable() flips at runtime."),
+    "MXNET_SERVE_MAX_BATCH": (int, 8,
+                              "Largest serving batch bucket "
+                              "(serve.InferenceEngine). Buckets default "
+                              "to the power-of-two ladder 1..max; the "
+                              "jit cache holds at most len(buckets) "
+                              "forward programs."),
+    "MXNET_SERVE_BUCKETS": (str, "", "Explicit serving batch buckets as "
+                            "a comma list (e.g. '1,4,16'); empty = "
+                            "power-of-two ladder up to "
+                            "MXNET_SERVE_MAX_BATCH."),
+    "MXNET_SERVE_QUEUE_DEPTH": (int, 64,
+                                "Serve admission-control bound: requests "
+                                "beyond this many queued are rejected "
+                                "immediately (HTTP 503), never queued "
+                                "into unbounded latency."),
+    "MXNET_SERVE_BATCH_WAIT_MS": (int, 2,
+                                  "How long the micro-batcher holds the "
+                                  "first queued request open for "
+                                  "coalescing (higher = bigger batches, "
+                                  "more latency floor)."),
+    "MXNET_SERVE_DEADLINE_MS": (int, 2000,
+                                "Default per-request serving deadline; "
+                                "expired requests fail with HTTP 504 "
+                                "before wasting a chip dispatch. "
+                                "0 disables."),
+    "MXNET_SERVE_WORKERS": (int, 1,
+                            "Serve worker threads pulling batches off "
+                            "the queue. >1 overlaps host pad/unpad and "
+                            "JSON work with device compute (per-bucket "
+                            "executors are lock-guarded)."),
     "MXNET_DATALOADER_START_METHOD": (str, "fork",
                                       "Process start method for "
                                       "DataLoader workers (fork/spawn/"

@@ -1,0 +1,43 @@
+"""Online inference serving: dynamic micro-batching over bucketed
+shape-specialized XLA programs.
+
+The serving path the north star ("serve heavy traffic from millions of
+users") needs on top of the one-request ``serving.Predictor``:
+
+* :mod:`~mxnet_tpu.serve.batching` — batch buckets + axis-0 padding,
+  bounding the compile surface to ``len(buckets)`` programs;
+* :mod:`~mxnet_tpu.serve.engine` — :class:`InferenceEngine`: bounded
+  queue, request coalescing, per-request deadlines, admission control,
+  ahead-of-time bucket warmup, graceful drain;
+* :mod:`~mxnet_tpu.serve.http` — stdlib HTTP frontend (``POST
+  /predict`` + ``/metrics`` + ``/healthz``) returning 503 on
+  backpressure and 504 on deadline expiry;
+* :mod:`~mxnet_tpu.serve.registry` — :class:`ModelRegistry`: atomic
+  weight hot-swap with zero dropped requests.
+
+Quick start::
+
+    import mxnet_tpu as mx
+
+    reg = mx.serve.ModelRegistry(symbol_json, param_bytes,
+                                 input_shapes={"data": (1, 3, 224, 224)})
+    reg.warmup()                              # compile every bucket
+    srv = mx.serve.serve_http(reg, port=8080)
+    ...
+    reg.swap(new_param_bytes)                 # zero-downtime weight update
+    srv.close(); reg.close()
+
+Tuning and architecture: docs/serving.md. Knobs: ``MXNET_SERVE_*``
+(``python -m mxnet_tpu.config``).
+"""
+from .batching import (pad_axis0, parse_buckets, pick_bucket,
+                       power_of_two_buckets, unpad_axis0)
+from .engine import (DeadlineExceededError, EngineClosedError,
+                     InferenceEngine, QueueFullError, ServeConfig)
+from .http import ServeHTTPServer, serve_http
+from .registry import ModelRegistry
+
+__all__ = ["InferenceEngine", "ServeConfig", "ModelRegistry", "serve_http",
+           "ServeHTTPServer", "QueueFullError", "DeadlineExceededError",
+           "EngineClosedError", "power_of_two_buckets", "parse_buckets",
+           "pick_bucket", "pad_axis0", "unpad_axis0"]

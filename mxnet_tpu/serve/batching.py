@@ -1,0 +1,85 @@
+"""Micro-batching primitives: batch buckets and axis-0 padding.
+
+TPUs amortize their dispatch and pipeline costs over large batches, but
+XLA programs are shape-specialized: every distinct batch size is a
+separate compile. Serving traffic produces arbitrary per-request row
+counts, so an unconstrained shape surface means a recompile storm (the
+classic TPU serving latency cliff — see docs/observability.md). The fix,
+shared with XLA-for-Julia's static-shape specialization and TVM-style
+ahead-of-time bucketing, is a BOUNDED set of batch buckets: requests
+coalesce into one batch, the batch pads up to the nearest bucket, and
+the jit cache holds at most ``len(buckets)`` forward programs no matter
+what the traffic does.
+
+Padding is along axis 0 only (the batch dimension): padded rows are
+zeros, every real row's computation is independent of them for
+row-parallel inference graphs, and un-padding is a mask-free slice. The
+bitwise identity real-rows-of-padded-forward == unpadded-forward is
+asserted by tests/test_serve.py.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["power_of_two_buckets", "parse_buckets", "pick_bucket",
+           "pad_axis0", "unpad_axis0"]
+
+
+def power_of_two_buckets(max_batch):
+    """Power-of-two bucket ladder up to ``max_batch`` (inclusive):
+    ``8 -> (1, 2, 4, 8)``. A non-power-of-two max becomes the final
+    bucket (``6 -> (1, 2, 4, 6)``)."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise MXNetError("max_batch must be >= 1, got %d" % max_batch)
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+def parse_buckets(spec, max_batch):
+    """Bucket tuple from a config spec: an explicit comma list
+    (``"1,4,16"``, MXNET_SERVE_BUCKETS) or, when empty, the
+    power-of-two ladder up to ``max_batch``."""
+    if not spec:
+        return power_of_two_buckets(max_batch)
+    try:
+        buckets = sorted({int(tok) for tok in str(spec).split(",") if tok})
+    except ValueError:
+        raise MXNetError("bad bucket spec %r (want e.g. '1,2,4,8')"
+                         % (spec,))
+    if not buckets or buckets[0] < 1:
+        raise MXNetError("bad bucket spec %r: buckets must be >= 1"
+                         % (spec,))
+    return tuple(buckets)
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket holding ``n`` rows."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise MXNetError("batch of %d rows exceeds the largest bucket %d"
+                     % (n, buckets[-1]))
+
+
+def pad_axis0(arr, target):
+    """Zero-pad ``arr`` along axis 0 up to ``target`` rows."""
+    arr = _np.asarray(arr)
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    if n > target:
+        raise MXNetError("cannot pad %d rows down to %d" % (n, target))
+    pad = _np.zeros((target - n,) + arr.shape[1:], dtype=arr.dtype)
+    return _np.concatenate([arr, pad], axis=0)
+
+
+def unpad_axis0(arr, rows):
+    """Drop padding rows: a mask-free slice of the first ``rows``."""
+    return arr[:rows]

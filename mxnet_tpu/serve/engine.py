@@ -1,0 +1,496 @@
+"""Dynamic micro-batching inference engine.
+
+``InferenceEngine`` turns the one-request-at-a-time ``serving.Predictor``
+into an online serving path: concurrent requests enter a BOUNDED queue,
+worker threads coalesce them into batches padded to a fixed bucket
+ladder (serve/batching.py), and one shape-specialized XLA program per
+bucket does the compute — so the compile surface is bounded by
+``len(buckets)`` regardless of traffic shape, and every chip dispatch
+carries as many requests as arrived within the coalescing window.
+
+Production behaviors the bare Predictor lacks, all here:
+
+* **admission control** — a full queue rejects immediately
+  (:class:`QueueFullError`, HTTP 503) instead of stretching latency
+  unboundedly; queue depth is the knob that trades tail latency for
+  acceptance rate.
+* **per-request deadlines** — a request that expires while queued is
+  failed (:class:`DeadlineExceededError`, HTTP 504) *before* wasting a
+  chip dispatch on it.
+* **ahead-of-time warmup** — :meth:`warmup` compiles every bucket
+  before the server reports healthy, so production traffic never eats
+  a compile.
+* **graceful drain** — :meth:`close` stops admission, flushes every
+  in-flight batch, then joins the workers (what a hot-swap or a
+  rolling restart needs).
+
+Telemetry (scraped via serve/http.py or ``telemetry.serve``):
+``serving/queue_depth`` gauge, ``serving/batch_rows`` +
+``serving/padding_waste_ratio`` histograms, the
+``serving/queue_wait_seconds`` vs ``serving/compute_seconds`` latency
+split, and ``serving/{rejected,timeouts}_total`` counters.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from .batching import parse_buckets, pick_bucket
+
+__all__ = ["ServeConfig", "InferenceEngine", "QueueFullError",
+           "DeadlineExceededError", "EngineClosedError"]
+
+
+class QueueFullError(MXNetError):
+    """Admission control rejected the request (map to HTTP 503)."""
+
+
+class DeadlineExceededError(MXNetError):
+    """The request's deadline expired before compute (map to HTTP 504)."""
+
+
+class EngineClosedError(MXNetError):
+    """The engine is draining or closed (map to HTTP 503)."""
+
+
+class ServeConfig(object):
+    """Serving knobs. Defaults come from the ``MXNET_SERVE_*`` config
+    tier (config.py); constructor arguments override per engine."""
+
+    __slots__ = ("max_batch", "buckets", "queue_depth", "batch_wait",
+                 "default_timeout", "workers")
+
+    def __init__(self, max_batch=None, buckets=None, queue_depth=None,
+                 batch_wait_ms=None, default_timeout_ms=None, workers=None):
+        from ..config import get as _cfg
+
+        def pick(val, name):
+            return _cfg(name) if val is None else val
+
+        self.max_batch = int(pick(max_batch, "MXNET_SERVE_MAX_BATCH"))
+        spec = buckets if buckets is not None \
+            else _cfg("MXNET_SERVE_BUCKETS")
+        if isinstance(spec, (tuple, list)):
+            self.buckets = tuple(sorted(set(int(b) for b in spec)))
+            if not self.buckets or self.buckets[0] < 1:
+                raise MXNetError("buckets must be a non-empty list of "
+                                 "sizes >= 1, got %r" % (spec,))
+        else:
+            self.buckets = parse_buckets(spec, self.max_batch)
+        # the ladder caps the admissible request size
+        self.max_batch = self.buckets[-1]
+        self.queue_depth = int(pick(queue_depth, "MXNET_SERVE_QUEUE_DEPTH"))
+        self.batch_wait = float(
+            pick(batch_wait_ms, "MXNET_SERVE_BATCH_WAIT_MS")) / 1e3
+        self.default_timeout = float(
+            pick(default_timeout_ms, "MXNET_SERVE_DEADLINE_MS")) / 1e3
+        self.workers = max(1, int(pick(workers, "MXNET_SERVE_WORKERS")))
+        if self.queue_depth < 1:
+            raise MXNetError("queue_depth must be >= 1")
+
+
+class _Request(object):
+    """One submitted inference request; a thread-event future."""
+
+    __slots__ = ("feed", "rows", "deadline", "t_enq", "_event", "outputs",
+                 "error", "_tc_lock", "_timeout_counted")
+
+    def __init__(self, feed, rows, deadline):
+        self.feed = feed
+        self.rows = rows
+        self.deadline = deadline
+        self.t_enq = _tm.monotonic()
+        self._event = threading.Event()
+        self.outputs = None
+        self.error = None
+        self._tc_lock = threading.Lock()
+        self._timeout_counted = False
+
+    def _count_timeout(self):
+        """Bump serving/timeouts_total ONCE per request, whether the
+        expiry is noticed client-side (result() wait), worker-side
+        (dequeue past deadline), or both racing."""
+        with self._tc_lock:
+            if self._timeout_counted:
+                return
+            self._timeout_counted = True
+        _tm.counter("serving/timeouts_total",
+                    "Requests failed on deadline expiry").inc()
+
+    def set_result(self, outputs):
+        self.outputs = outputs
+        self._event.set()
+
+    def set_error(self, exc):
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        """Block until completion; True when a result/error is set."""
+        return self._event.wait(timeout)
+
+    def result(self):
+        """Outputs (list of np arrays, one per graph output), waiting at
+        most until the request's absolute deadline; raises the request's
+        error, or :class:`DeadlineExceededError` at deadline expiry."""
+        if self.deadline is None:
+            self.wait()
+        elif not self.wait(max(0.0, self.deadline - _tm.monotonic())
+                           + 0.05):
+            self._count_timeout()
+            raise DeadlineExceededError(
+                "no result within the %.0f ms deadline"
+                % ((self.deadline - self.t_enq) * 1e3))
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+
+class InferenceEngine(object):
+    """Micro-batching execution engine over one bound model.
+
+    Parameters
+    ----------
+    predictor : serving.Predictor
+        The bound model. Its input shapes define the per-row feature
+        shapes (axis 0 is the batch axis on every input); per-bucket
+        executors are derived with :meth:`Predictor.reshape`, which
+        shares the device-resident parameter buffers — N buckets cost
+        one copy of the weights in HBM.
+    config : ServeConfig, optional
+    """
+
+    def __init__(self, predictor, config=None):
+        self._cfg = config or ServeConfig()
+        self._base = predictor
+        self._input_names = list(predictor._input_names)
+        if not self._input_names:
+            raise MXNetError("predictor was bound without input_shapes; "
+                             "the engine needs named inputs")
+        self._feature = {}
+        self._dtypes = {}
+        for k in self._input_names:
+            arr = predictor._exe.arg_dict[k]
+            if len(arr.shape) < 1:
+                raise MXNetError("input %r is a scalar; the batch axis "
+                                 "(axis 0) is required" % k)
+            self._feature[k] = tuple(arr.shape[1:])
+            self._dtypes[k] = arr.dtype
+        self._preds = {}                 # bucket -> Predictor
+        self._pred_locks = {}            # bucket -> forward lock
+        self._build_lock = threading.Lock()
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._accepting = True
+        self._ready = False
+        self._workers = []
+
+        self._m_requests = _tm.counter(
+            "serving/requests_total", "Inference requests accepted")
+        self._m_rejected = _tm.counter(
+            "serving/rejected_total",
+            "Requests rejected by admission control (full queue / closed)")
+        self._m_batches = _tm.counter(
+            "serving/batches_total", "Coalesced batches executed")
+        self._m_depth = _tm.gauge(
+            "serving/queue_depth", "Requests waiting in the serve queue")
+        self._m_batch_rows = _tm.histogram(
+            "serving/batch_rows", "Real rows per executed batch",
+            buckets=tuple(float(b) for b in self._cfg.buckets))
+        self._m_waste = _tm.histogram(
+            "serving/padding_waste_ratio",
+            "Padding rows / bucket rows per executed batch",
+            buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9))
+        self._m_qwait = _tm.histogram(
+            "serving/queue_wait_seconds",
+            "Time a request waited before its batch launched")
+        self._m_compute = _tm.histogram(
+            "serving/compute_seconds",
+            "Forward wall time per batch (pad + run + fetch)")
+        self._m_latency = _tm.histogram(
+            "serving/request_seconds",
+            "Inference request latency (host-side, submit to result)")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Spawn the worker thread(s). Idempotent."""
+        with self._cond:
+            if self._workers:
+                return self
+            self._accepting = True
+            for i in range(self._cfg.workers):
+                t = threading.Thread(target=self._worker_loop,
+                                     name="mxnet-serve-worker-%d" % i,
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
+        return self
+
+    def warmup(self):
+        """Ahead-of-time compile every bucket's forward program (zeros
+        feed, fetched to host so compile + first execute both finish).
+        The server must not report healthy before this returns: after
+        it, steady-state traffic never triggers an XLA compile."""
+        for b in self._cfg.buckets:
+            feed = {k: _np.zeros((b,) + self._feature[k],
+                                 dtype=self._dtypes[k])
+                    for k in self._input_names}
+            pred = self._bucket_pred(b)
+            with self._pred_locks[b]:
+                outs = pred._exe.forward(is_train=False, **feed)
+                for o in outs:
+                    o.asnumpy()
+        self._ready = True
+        return self
+
+    @property
+    def ready(self):
+        """Health-check gate: every bucket compiled AND workers live —
+        a warmed engine with no one to pop the queue must not attract
+        load-balancer traffic."""
+        return self._ready and bool(self._workers)
+
+    @property
+    def config(self):
+        return self._cfg
+
+    def engine(self):
+        """Uniform access for the HTTP frontend (ModelRegistry has the
+        same method returning its *current* engine)."""
+        return self
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop admission; with ``drain`` flush every queued request
+        through the model, else fail them with EngineClosedError. Then
+        join the workers."""
+        with self._cond:
+            if not self._accepting and not self._workers:
+                return
+            self._accepting = False
+            if not drain or not self._workers:
+                # no worker will ever pop these: failing them beats a
+                # future that never resolves (drain needs live workers)
+                while self._queue:
+                    req = self._queue.popleft()
+                    req.set_error(EngineClosedError("engine closed"))
+                self._m_depth.set(0)
+            self._cond.notify_all()
+        for t in self._workers:
+            t.join(timeout=timeout)
+        # a worker that outlived the join timeout (forward hung on the
+        # device) stays tracked: start() must not spawn a second crew
+        # over the same queue, and callers can see the drain was partial
+        self._workers = [t for t in self._workers if t.is_alive()]
+        self._ready = False
+
+    # -- request path ------------------------------------------------------
+    def submit(self, feed, timeout_ms=None):
+        """Enqueue one request; returns its future (:class:`_Request`).
+
+        ``feed``: ``{input_name: array-like}`` with every input carrying
+        the same axis-0 row count ``1 <= rows <= max_batch``. Raises
+        :class:`QueueFullError` immediately when the queue is at depth
+        (admission control — never unbounded latency) and
+        :class:`EngineClosedError` when draining/closed.
+
+        Requests submitted before :meth:`start` queue up and are served
+        once the workers spawn (deliberate: fill-then-start); on an
+        engine that is never started they can only expire against their
+        deadline, or fail at :meth:`close`.
+        """
+        feed, rows = self._check_feed(feed)
+        timeout = (self._cfg.default_timeout if timeout_ms is None
+                   else float(timeout_ms) / 1e3)
+        deadline = (_tm.monotonic() + timeout) if timeout > 0 else None
+        req = _Request(feed, rows, deadline)
+        with self._cond:
+            if not self._accepting:
+                self._m_rejected.inc()
+                raise EngineClosedError("engine is draining/closed")
+            if len(self._queue) >= self._cfg.queue_depth:
+                self._m_rejected.inc()
+                raise QueueFullError(
+                    "serve queue full (%d requests); retry later"
+                    % self._cfg.queue_depth)
+            self._queue.append(req)
+            self._m_requests.inc()
+            self._m_depth.set(len(self._queue))
+            self._cond.notify()
+        return req
+
+    def predict(self, feed, timeout_ms=None):
+        """Synchronous convenience: submit + wait + unpack."""
+        return self.submit(feed, timeout_ms).result()
+
+    def _check_feed(self, feed):
+        if not isinstance(feed, dict):
+            if len(self._input_names) != 1:
+                raise MXNetError(
+                    "model has inputs %s; pass a feed dict"
+                    % self._input_names)
+            feed = {self._input_names[0]: feed}
+        missing = [k for k in self._input_names if k not in feed]
+        if missing:
+            raise MXNetError("feed missing inputs %s" % missing)
+        out, rows = {}, None
+        for k in self._input_names:
+            arr = _np.asarray(feed[k], dtype=self._dtypes[k])
+            if arr.ndim == len(self._feature[k]):
+                arr = arr[None]          # single row without batch axis
+            if tuple(arr.shape[1:]) != self._feature[k]:
+                raise MXNetError(
+                    "input %r has feature shape %s, model expects %s"
+                    % (k, tuple(arr.shape[1:]), self._feature[k]))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise MXNetError("inputs disagree on the batch axis")
+            out[k] = arr
+        if rows < 1:
+            raise MXNetError("empty request (0 rows)")
+        if rows > self._cfg.max_batch:
+            raise MXNetError(
+                "request of %d rows exceeds max_batch=%d; split it "
+                "client-side" % (rows, self._cfg.max_batch))
+        return out, rows
+
+    # -- batching worker ---------------------------------------------------
+    def _take_batch(self):
+        """Pop a coalesced FIFO run of requests totalling at most
+        ``max_batch`` rows, waiting up to ``batch_wait`` after the first
+        arrival for more to coalesce. None = engine closed and empty."""
+        with self._cond:
+            while not self._queue:
+                if not self._accepting:
+                    return None
+                self._cond.wait(0.1)
+            batch = [self._queue.popleft()]
+            rows = batch[0].rows
+
+            def grab():
+                r = rows
+                while (self._queue
+                       and r + self._queue[0].rows <= self._cfg.max_batch):
+                    req = self._queue.popleft()
+                    batch.append(req)
+                    r += req.rows
+                return r
+
+            rows = grab()
+            if self._cfg.batch_wait > 0:
+                t_end = _tm.monotonic() + self._cfg.batch_wait
+                while rows < self._cfg.max_batch and self._accepting:
+                    if self._queue:      # strict FIFO: a head that no
+                        break            # longer fits ends the window
+                    remaining = t_end - _tm.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    rows = grab()
+            self._m_depth.set(len(self._queue))
+            if self._queue:
+                self._cond.notify()      # more work for another worker
+        return batch
+
+    def _worker_loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch)
+            except Exception as exc:     # never let the worker die: fail
+                err = MXNetError(        # the batch, keep serving
+                    "batch processing failed: %s" % exc)
+                for req in batch:
+                    if not req._event.is_set():
+                        req.set_error(err)
+
+    def _run_batch(self, batch):
+        now = _tm.monotonic()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                req._count_timeout()
+                req.set_error(DeadlineExceededError(
+                    "deadline expired after %.0f ms in queue"
+                    % ((now - req.t_enq) * 1e3)))
+            else:
+                live.append(req)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        bucket = pick_bucket(rows, self._cfg.buckets)
+        if len(live) == 1 and live[0].rows == bucket:
+            feed = live[0].feed          # exact fit: zero host copies
+        else:
+            # one zeroed bucket buffer per input, each request's rows
+            # copied in once (padding comes free)
+            feed = {}
+            for k in self._input_names:
+                buf = _np.zeros((bucket,) + self._feature[k],
+                                dtype=self._dtypes[k])
+                offset = 0
+                for r in live:
+                    buf[offset:offset + r.rows] = r.feed[k]
+                    offset += r.rows
+                feed[k] = buf
+
+        t0 = _tm.monotonic()
+        try:
+            pred = self._bucket_pred(bucket)
+            with self._pred_locks[bucket]:
+                outs = pred._exe.forward(is_train=False, **feed)
+                outs_np = [o.asnumpy() for o in outs]
+        except Exception as exc:          # surface, don't kill the worker
+            err = MXNetError("batch execution failed: %s" % exc)
+            for req in live:
+                req.set_error(err)
+            return
+        t1 = _tm.monotonic()
+
+        self._m_batches.inc()
+        self._m_batch_rows.observe(rows)
+        self._m_waste.observe((bucket - rows) / float(bucket))
+        self._m_compute.observe(t1 - t0)
+        exact_fit = len(live) == 1 and live[0].rows == outs_np[0].shape[0]
+        offset = 0
+        for req in live:
+            if exact_fit:
+                req.set_result(outs_np)
+            else:
+                # copy the rows out: a view would pin the whole padded
+                # bucket output for the lifetime of each request future
+                req.set_result([o[offset:offset + req.rows].copy()
+                                for o in outs_np])
+            self._m_qwait.observe(t0 - req.t_enq)
+            self._m_latency.observe(t1 - req.t_enq)
+            offset += req.rows
+
+    # -- bucket executors --------------------------------------------------
+    def _bucket_pred(self, bucket):
+        """Predictor bound at ``bucket`` rows. Built once per bucket;
+        parameters are shared device buffers (Predictor.reshape), so the
+        ladder costs one weight copy in HBM plus len(buckets) compiled
+        programs."""
+        pred = self._preds.get(bucket)
+        if pred is not None:
+            return pred
+        with self._build_lock:
+            pred = self._preds.get(bucket)
+            if pred is None:
+                base_rows = self._base._exe.arg_dict[
+                    self._input_names[0]].shape[0]
+                if base_rows == bucket:
+                    pred = self._base
+                else:
+                    shapes = {k: (bucket,) + self._feature[k]
+                              for k in self._input_names}
+                    pred = self._base.reshape(shapes)
+                self._pred_locks[bucket] = threading.Lock()
+                self._preds[bucket] = pred
+        return pred

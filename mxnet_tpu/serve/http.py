@@ -1,0 +1,185 @@
+"""Backpressure-aware HTTP frontend for the inference engine.
+
+Stdlib-only (http.server), like ``telemetry.serve`` — safe to run in any
+deployment without adding dependencies. One threaded server mounts:
+
+* ``POST /predict`` — JSON in, JSON out (below). Maps engine outcomes
+  onto the status codes a load balancer expects: **503** on admission
+  rejection (full queue / draining; ``Retry-After`` set), **504** on
+  deadline expiry, **400** on malformed input.
+* ``GET /healthz`` — ``ok`` once every batch bucket is compiled
+  (:meth:`InferenceEngine.warmup`) and the workers are live
+  (:meth:`InferenceEngine.start`), **503** ``warming`` before that; a
+  rollout gate that keeps compile latency out of production traffic.
+* ``GET /metrics`` — the shared telemetry registry in Prometheus text
+  format (same payload as ``telemetry.serve``; scrape either).
+
+Request body::
+
+    {"inputs": {"data": [[...], ...]}, "timeout_ms": 500}
+
+or, for single-input models, the bare array ``{"data": [[...], ...]}``
+/ ``[[...], ...]``. Response::
+
+    {"outputs": [[[...], ...]], "rows": N}
+
+``target`` is an :class:`InferenceEngine` or a
+:class:`serve.ModelRegistry` (hot-swap safe) — anything with
+``submit(feed, timeout_ms)`` and ``ready``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from .engine import DeadlineExceededError, EngineClosedError, QueueFullError
+
+__all__ = ["serve_http", "ServeHTTPServer"]
+
+
+class ServeHTTPServer(object):
+    """Handle on a running serving frontend (from :func:`serve_http`)."""
+
+    def __init__(self, httpd, thread, target):
+        self._httpd = httpd
+        self._thread = thread
+        self.target = target
+        self.port = httpd.server_address[1]
+        self.url = "http://%s:%d" % (httpd.server_address[0], self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    stop = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _parse_body(target, body):
+    """(feed, timeout_ms) from a request body; raises MXNetError on
+    malformed input (mapped to 400)."""
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MXNetError("request body is not valid JSON: %s" % e)
+    timeout_ms = None
+    if isinstance(payload, dict) and "inputs" in payload:
+        timeout_ms = payload.get("timeout_ms")
+        feed = payload["inputs"]
+        if not isinstance(feed, dict):
+            raise MXNetError('"inputs" must be an object of '
+                             'name -> array')
+    else:
+        feed = payload                   # bare array / {input: array}
+    input_names = target.engine()._input_names
+    if not isinstance(feed, dict):
+        if len(input_names) != 1:
+            raise MXNetError("model has inputs %s; post "
+                             '{"inputs": {...}}' % input_names)
+        feed = {input_names[0]: feed}
+    unknown = [k for k in feed if k not in input_names]
+    if unknown:
+        raise MXNetError("unknown inputs %s (model has %s)"
+                         % (unknown, input_names))
+    return feed, timeout_ms
+
+
+def serve_http(target, port=0, addr="127.0.0.1"):
+    """Start the serving frontend; returns a :class:`ServeHTTPServer`
+    (``port=0`` picks a free port — read it from the handle)."""
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code, payload, ctype="application/json",
+                   headers=()):
+            body = (json.dumps(payload).encode() + b"\n"
+                    if not isinstance(payload, bytes) else payload)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/metrics":
+                self._reply(200, _tm.render_prometheus().encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+            elif path == "/healthz":
+                if target.ready:
+                    self._reply(200, b"ok\n",
+                                ctype="text/plain; charset=utf-8")
+                else:
+                    self._reply(503, b"warming\n",
+                                ctype="text/plain; charset=utf-8")
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)   # always drain: HTTP/1.1
+            if self.path.split("?")[0] != "/predict":
+                # keep-alive reuses the socket; an unread body would be
+                # parsed as the next request line
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                feed, timeout_ms = _parse_body(target, body)
+                req = target.submit(feed, timeout_ms)
+            except (QueueFullError, EngineClosedError) as e:
+                self._reply(503, {"error": str(e)},
+                            headers=(("Retry-After", "1"),))
+                return
+            except (MXNetError, ValueError, TypeError) as e:
+                # ValueError/TypeError cover np.asarray on ragged input
+                # and a non-numeric timeout_ms — still a client error
+                self._reply(400, {"error": str(e)})
+                return
+
+            try:
+                outputs = req.result()
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e)})
+                return
+            except EngineClosedError as e:
+                self._reply(503, {"error": str(e)},
+                            headers=(("Retry-After", "1"),))
+                return
+            except MXNetError as e:
+                self._reply(500, {"error": str(e)})
+                return
+            try:
+                # bare NaN/Infinity literals are invalid JSON to strict
+                # (RFC 8259) parsers: surface a 500, not a 200 the
+                # client cannot parse
+                body = json.dumps(
+                    {"outputs": [o.tolist() for o in outputs],
+                     "rows": req.rows}, allow_nan=False).encode() + b"\n"
+            except ValueError:
+                self._reply(500, {"error": "model output contains "
+                                           "non-finite values"})
+                return
+            self._reply(200, body)
+
+        def log_message(self, *args):    # no stderr chatter per request
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, port), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mxnet-serve-http", daemon=True)
+    thread.start()
+    return ServeHTTPServer(httpd, thread, target)

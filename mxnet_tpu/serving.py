@@ -28,7 +28,7 @@ class Predictor(object):
     Predictor struct)."""
 
     def __init__(self, symbol_json, param_bytes, dev_type=1, dev_id=0,
-                 input_shapes=None):
+                 input_shapes=None, input_types=None):
         from .symbol.symbol import load_json
         from .ndarray import utils as _utils
         from . import context as _ctx
@@ -52,11 +52,21 @@ class Predictor(object):
                 arg_params[k] = v
         ctx = _ctx.tpu(dev_id) if dev_type == 2 else _ctx.cpu(dev_id)
         shapes = dict(input_shapes or {})
+        # bind every buffer (args AND aux) in its checkpoint dtype
+        # (fp16/bf16/int checkpoints must not silently widen to f4);
+        # inputs default to f4 unless input_types overrides
+        type_dict = {k: v.dtype for k, v in arg_params.items()}
+        type_dict.update({k: v.dtype for k, v in aux_params.items()})
+        type_dict.update({k: _np.dtype(t)
+                          for k, t in (input_types or {}).items()})
         self._sym = sym
         self._arg_params = arg_params
         self._aux_params = aux_params
         self._ctx = ctx
-        self._exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+        self._input_types = {k: _np.dtype(t)
+                             for k, t in (input_types or {}).items()}
+        self._exe = sym.simple_bind(ctx=ctx, grad_req="null",
+                                    type_dict=type_dict, **shapes)
         for k, v in arg_params.items():
             if k in self._exe.arg_dict:
                 self._exe.arg_dict[k][:] = v
@@ -67,26 +77,40 @@ class Predictor(object):
         self._outputs = None
 
     def set_input(self, key, data_bytes):
-        """data_bytes: raw float32 little-endian in the bound shape."""
+        """``data_bytes``: raw little-endian bytes in the bound array's
+        dtype and shape (the C predict ABI hands over an opaque buffer;
+        the bound dtype — f4 by default, or whatever ``input_types``
+        declared — defines its layout, so fp16/bf16/int inputs
+        round-trip without a silent f4 reinterpretation)."""
         if key not in self._exe.arg_dict:
             raise MXNetError("unknown input %r" % key)
         arr = self._exe.arg_dict[key]
-        flat = _np.frombuffer(data_bytes, dtype="<f4")
-        if flat.size != int(_np.prod(arr.shape)):
-            raise MXNetError("input %r size mismatch: got %d want %d"
-                             % (key, flat.size, int(_np.prod(arr.shape))))
+        dt = _np.dtype(arr.dtype)
+        want_bytes = int(_np.prod(arr.shape)) * dt.itemsize
+        if len(data_bytes) != want_bytes:
+            raise MXNetError(
+                "input %r size mismatch: got %d bytes, want %d "
+                "(shape %s, dtype %s)"
+                % (key, len(data_bytes), want_bytes, tuple(arr.shape),
+                   dt.name))
+        try:
+            wire_dt = dt.newbyteorder("<") if dt.itemsize > 1 else dt
+        except (TypeError, ValueError):   # ml_dtypes (bf16) are LE-only
+            wire_dt = dt
+        flat = _np.frombuffer(data_bytes, dtype=wire_dt).astype(dt,
+                                                                copy=False)
         from .ndarray.ndarray import array
-        arr[:] = array(flat.reshape(arr.shape))
+        arr[:] = array(flat.reshape(arr.shape), dtype=dt)
 
     def forward(self):
         t0 = _tm.monotonic() if _tm._enabled else None
         self._outputs = self._exe.forward(is_train=False)
         if t0 is not None:
             _tm.counter("serving/requests_total",
-                        "Predictor forward calls").inc()
+                        "Inference requests accepted").inc()
             _tm.histogram("serving/request_seconds",
-                          "Predictor forward latency (host-side)").observe(
-                _tm.monotonic() - t0)
+                          "Inference request latency (host-side, submit "
+                          "to result)").observe(_tm.monotonic() - t0)
 
     def serve_metrics(self, port=0, addr="127.0.0.1"):
         """Start the telemetry ``/metrics`` + ``/healthz`` endpoint next
@@ -117,20 +141,50 @@ class Predictor(object):
     def reshape(self, input_shapes):
         """Rebind for new input shapes (reference: MXPredReshape). The
         graph program is shape-specialized by the jit cache; only the
-        argument buffers are reallocated."""
+        INPUT buffers are reallocated. Parameter and aux buffers whose
+        shapes are input-independent are SHARED with this predictor
+        (Executor.alias_args) — no host->device re-upload and no second
+        copy of the weights in HBM, which is what makes a per-bucket
+        executor ladder (serve.InferenceEngine) cost one weight set."""
+        input_shapes = dict(input_shapes)
         new = Predictor.__new__(Predictor)
         new._sym = self._sym
         new._arg_params = self._arg_params
         new._aux_params = self._aux_params
         new._ctx = self._ctx
+        new._input_types = getattr(self, "_input_types", {})
+        type_dict = {k: v.dtype for k, v in self._arg_params.items()}
+        type_dict.update({k: v.dtype for k, v in self._aux_params.items()})
+        type_dict.update(new._input_types)
         new._exe = self._sym.simple_bind(ctx=self._ctx, grad_req="null",
-                                         **dict(input_shapes))
+                                         type_dict=type_dict,
+                                         **input_shapes)
+        # never alias an input buffer — not even one omitted from this
+        # reshape call (a partial reshape infers the rest): set_input on
+        # the new predictor must not overwrite the old one's feed
+        no_share = set(input_shapes) | set(self._input_names)
+        shared = [n for n in new._exe.arg_dict
+                  if n not in no_share and n in self._exe.arg_dict
+                  and new._exe.arg_dict[n].shape
+                  == self._exe.arg_dict[n].shape]
+        shared += [n for n in new._exe.aux_dict
+                   if n in self._exe.aux_dict
+                   and new._exe.aux_dict[n].shape
+                   == self._exe.aux_dict[n].shape]
+        new._exe.alias_args(self._exe, shared)
+        # anything shape-coupled to the inputs (rare: e.g. a param whose
+        # shape inference tracks the batch axis) still needs the copy
+        resident = set(shared)
         for k, v in self._arg_params.items():
-            if k in new._exe.arg_dict:
+            if k in new._exe.arg_dict and k not in resident:
                 new._exe.arg_dict[k][:] = v
         for k, v in self._aux_params.items():
-            if k in new._exe.aux_dict:
+            if k in new._exe.aux_dict and k not in resident:
                 new._exe.aux_dict[k][:] = v
-        new._input_names = list(input_shapes)
+        # the input set is a property of the MODEL, not of this call:
+        # keep any input name simple_bind inferred rather than narrowing
+        # to the keys passed here
+        new._input_names = list(input_shapes) + [
+            n for n in self._input_names if n not in input_shapes]
         new._outputs = None
         return new

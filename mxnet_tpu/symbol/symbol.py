@@ -442,7 +442,9 @@ class Symbol(object):
         arg_names = self.list_arguments()
         args = [zeros(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
                 for n, s in zip(arg_names, arg_shapes)]
-        aux = [zeros(s, ctx=ctx, dtype=_np.float32) for s in aux_shapes]
+        aux_names = self.list_auxiliary_states()
+        aux = [zeros(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
+               for n, s in zip(aux_names, aux_shapes)]
         return self.bind(ctx, args, grad_req=grad_req, aux_states=aux)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

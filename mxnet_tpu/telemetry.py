@@ -656,7 +656,27 @@ def snapshot():
            "fused_step_cache_hits":
                _val("executor/fused_step_cache_hit_total"),
            "fused_step_cache_misses":
-               _val("executor/fused_step_cache_miss_total")}
+               _val("executor/fused_step_cache_miss_total"),
+           # serving-path accounting (serve.InferenceEngine): volume,
+           # backpressure, and the realized batching efficiency banked
+           # with predictor_serve bench records
+           "serve_requests": _val("serving/requests_total"),
+           "serve_rejected": _val("serving/rejected_total"),
+           "serve_timeouts": _val("serving/timeouts_total"),
+           "serve_batches": _val("serving/batches_total"),
+           "serve_swaps": _val("serving/swaps_total")}
+    fam = REGISTRY._families.get("serving/batch_rows")
+    if fam is not None:
+        rows = sum(c.sum for _lv, c in fam.series())
+        n = sum(c.count for _lv, c in fam.series())
+        if n:
+            out["serve_mean_batch_rows"] = round(rows / n, 3)
+    fam = REGISTRY._families.get("serving/padding_waste_ratio")
+    if fam is not None:
+        waste = sum(c.sum for _lv, c in fam.series())
+        n = sum(c.count for _lv, c in fam.series())
+        if n:
+            out["serve_mean_padding_waste"] = round(waste / n, 4)
     try:
         from . import storage
         stats = storage.memory_stats()
