@@ -45,7 +45,7 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
          "util", "registry", "misc", "executor_manager", "ndarray_doc",
-         "symbol_doc", "telemetry", "serving", "serve")
+         "symbol_doc", "telemetry", "serving", "serve", "fault")
 
 
 def __getattr__(name):

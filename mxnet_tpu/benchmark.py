@@ -818,6 +818,76 @@ def train_mlp_module_fused(batch=64, iters=50):
     return img_s, extra
 
 
+def train_resume(steps=27, period=8, batch=64):
+    """Fault-tolerance numbers for the training path: crash-consistent
+    checkpoint save latency (params + optimizer states + manifest
+    through the atomic write-temp→fsync→rename path), restore latency
+    through ``checkpoint.load_latest_valid`` (checksum verification
+    included), and steps lost at a simulated preemption — batches since
+    the last periodic checkpoint, i.e. what the SIGTERM grace-window
+    save reduces to zero when the preemption notice is delivered."""
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+    from .checkpoint import load_latest_valid
+    from .context import current_context
+    from .io import DataBatch
+    from .models import mlp
+    from .module import Module
+
+    sym = mlp()
+    mod = Module(sym, context=current_context())
+    mod.bind(data_shapes=[("data", (batch, 784))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    db = DataBatch(
+        data=[mx.nd.array(rng.randn(batch, 784).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, size=(batch,))
+                           .astype(np.float32))])
+    tmpdir = tempfile.mkdtemp(prefix="mx_train_resume_")
+    prefix = os.path.join(tmpdir, "ck")
+    try:
+        save_times, restore_times, ckpt_steps = [], [], []
+        for step in range(1, steps + 1):
+            mod.forward_backward(db)
+            mod.update()
+            if step % period == 0:
+                t0 = time.time()
+                mod.save_checkpoint(prefix, step,
+                                    save_optimizer_states=True)
+                save_times.append(time.time() - t0)
+                ckpt_steps.append(step)
+        # preempted without a grace-window save: everything since the
+        # last periodic checkpoint replays on resume
+        steps_lost = steps - (max(ckpt_steps) if ckpt_steps else 0)
+        for _ in range(3):
+            t0 = time.time()
+            state = load_latest_valid(prefix)
+            restore_times.append(time.time() - t0)
+        assert state is not None and state.epoch == ckpt_steps[-1]
+        params_bytes = os.path.getsize(
+            "%s-%04d.params" % (prefix, ckpt_steps[-1]))
+        save_s = sum(save_times) / len(save_times)
+        restore_s = sum(restore_times) / len(restore_times)
+        mbps = params_bytes / 1e6 / save_s
+        extra = {
+            "save_ms": round(save_s * 1e3, 2),
+            "restore_ms": round(restore_s * 1e3, 2),
+            "params_mb": round(params_bytes / 1e6, 3),
+            "steps_lost_on_preemption": steps_lost,
+            "ckpt_period_steps": period,
+            "num_checkpoints": len(ckpt_steps),
+            "with_optimizer_states": True,
+        }
+        return mbps, extra
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def train_mlp(batch=64, iters=50, steps_per_call=32):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run.
@@ -1178,6 +1248,14 @@ def _job_resnet50_train_fused():
                    "img/s (batch 32, fp32, 1 chip, fused module step)", x)
 
 
+def _job_train_resume():
+    v, x = train_resume()
+    return persist("train_resume_ckpt_mb_per_sec", v,
+                   "MB/s checkpoint save (MLP module, params + states + "
+                   "manifest, atomic path; host metric)", x,
+                   host_metric=True)
+
+
 def _job_mlp_train_fused():
     v, x = train_mlp_module_fused()
     return persist("mlp_train_fused_img_per_sec", v,
@@ -1247,6 +1325,7 @@ def _make_infer_job(model, dtype, batch=32):
 
 
 JOBS = {
+    "train_resume": _job_train_resume,
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
@@ -1275,6 +1354,7 @@ JOBS["resnet50_infer_b128"] = _make_infer_job("resnet50", "float32",
 JOB_PRIORITY = [
     "mlp_train",
     "mlp_train_fused",
+    "train_resume",
     "predictor_serve",
     "data_pipeline",
     "data_pipeline_native",

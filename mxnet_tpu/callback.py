@@ -13,7 +13,9 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Checkpoint the Module at the end of every ``period`` epochs
-    (reference: callback.py module_checkpoint)."""
+    (reference: callback.py module_checkpoint). Crash-consistent: the
+    save goes through Module.save_checkpoint's atomic
+    write-temp→fsync→rename path with a checksum manifest."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
@@ -24,7 +26,10 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
 def do_checkpoint(prefix, period=1):
     """Checkpoint params each ``period`` epochs
-    (reference: callback.py do_checkpoint)."""
+    (reference: callback.py do_checkpoint). Crash-consistent: a SIGKILL
+    mid-save never clobbers the previous checkpoint, and the manifest
+    written alongside lets ``checkpoint.load_latest_valid`` verify this
+    one before trusting it."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):

@@ -94,6 +94,42 @@ VARS = {
                             "the queue. >1 overlaps host pad/unpad and "
                             "JSON work with device compute (per-bucket "
                             "executors are lock-guarded)."),
+    "MXNET_SERVE_WORKER_RESTARTS": (int, 16,
+                                    "Restart budget for crashed serve "
+                                    "worker threads (shared across the "
+                                    "crew, counted in serving/"
+                                    "worker_restarts_total). Past it a "
+                                    "crashed worker stays down; with no "
+                                    "worker alive /healthz degrades to "
+                                    "not-ready."),
+    "MXNET_CKPT_GRACE_S": (int, 30,
+                           "Preemption grace window: on SIGTERM, fit "
+                           "finishes the in-flight batch and takes a "
+                           "final checkpoint; a watchdog hard-exits the "
+                           "process when the window ends (the platform "
+                           "reclaims the VM then anyway). 0 disables "
+                           "the watchdog."),
+    "MXNET_KV_RETRIES": (int, 4,
+                         "Max retries per kvstore op after a transient "
+                         "transport failure (jittered exponential "
+                         "backoff; kvstore/retries_total counts them). "
+                         "Exhaustion raises a clear MXNetError naming "
+                         "the op and attempt count."),
+    "MXNET_KV_TIMEOUT_MS": (int, 60000,
+                            "Per-op kvstore deadline: bounds each "
+                            "socket wait AND the total retry budget, "
+                            "so a dead parameter server degrades to an "
+                            "error, never a hang. 0 = no deadline."),
+    "MXNET_KV_BACKOFF_MS": (int, 50,
+                            "Base kvstore retry backoff; attempt n "
+                            "sleeps ~base*2^(n-1) with full jitter, "
+                            "capped by the remaining op deadline."),
+    "MXNET_FAULT_INJECT": (str, "",
+                           "Arm fault-injection points at import: "
+                           "point:step:kind[:count] comma list "
+                           "(kinds: raise/transient/delay/crash; see "
+                           "mxnet_tpu/fault.py). Test-only — never set "
+                           "in production."),
     "MXNET_DATALOADER_START_METHOD": (str, "fork",
                                       "Process start method for "
                                       "DataLoader workers (fork/spawn/"

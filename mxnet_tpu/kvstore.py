@@ -18,11 +18,19 @@ from __future__ import annotations
 import functools
 import pickle
 
+from . import fault as _fault
 from .base import MXNetError
+from .fault import FaultInjected, TransientKVError
 from .ndarray.ndarray import NDArray, zeros
 from . import telemetry as _tm
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "TransientKVError"]
+
+# PS ops that mutate server state: they carry a sequence number so a
+# retried/resent RPC whose first copy already applied (reply lost on a
+# dead connection) is deduplicated server-side instead of double-applied
+_MUTATING_OPS = frozenset(
+    ("PUSH", "INIT", "SET_OPTIMIZER", "SET_COMPRESSION", "BARRIER"))
 
 
 def _approx_nbytes(value):
@@ -92,6 +100,8 @@ class KVStore(object):
         self._barrier_count = 0
         self._sock = None
         self._sock_lock = None
+        self._ps_host = None
+        self._seq = 0
         if kv_type.startswith("dist") and os.environ.get("MXNET_TPU_PS_URI"):
             self._connect_ps()
 
@@ -102,33 +112,137 @@ class KVStore(object):
         Used for dist_async / cross-pod coordination; the synchronous
         intra-pod path stays on XLA allreduce."""
         import os
-        import socket
         import threading
-        host = os.environ["MXNET_TPU_PS_URI"]
-        port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.connect((host, port))
-        self._sock_lock = threading.Lock()
+        self._ps_host = os.environ["MXNET_TPU_PS_URI"]
+        self._ps_port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
         self._env_rank = int(os.environ.get("MXNET_TPU_RANK", "0"))
         self._env_nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
-        token = os.environ.get("MXNET_TPU_PS_TOKEN", "")
-        if token:
-            from .kvstore_server import send_msg, recv_msg
-            send_msg(self._sock, ("AUTH", None, token))
-            status, payload = recv_msg(self._sock)
+        self._ps_token = os.environ.get("MXNET_TPU_PS_TOKEN", "")
+        self._sock_lock = threading.Lock()
+        with self._sock_lock:
+            self._dial()
+
+    def _dial(self):
+        """(Re-)establish the PS connection: socket (with the
+        ``MXNET_KV_TIMEOUT_MS`` deadline so a dead server can never hang
+        an op), auth, and rank-registration HELLO. Caller holds
+        ``_sock_lock``."""
+        import socket
+        from .config import get as _cfg
+        from .kvstore_server import send_msg, recv_msg
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        timeout_ms = int(_cfg("MXNET_KV_TIMEOUT_MS"))
+        if timeout_ms > 0:
+            sock.settimeout(timeout_ms / 1e3)
+        try:
+            sock.connect((self._ps_host, self._ps_port))
+            if self._ps_token:
+                send_msg(sock, ("AUTH", None, self._ps_token))
+                status, payload = recv_msg(sock)
+                if status != "OK":
+                    raise MXNetError(
+                        "kvstore server auth failed: %s" % payload)
+            # register this rank for liveness tracking
+            send_msg(sock, ("HELLO", None, self._env_rank))
+            status, payload = recv_msg(sock)
             if status != "OK":
-                raise MXNetError("kvstore server auth failed: %s" % payload)
-        # register this rank for liveness tracking
-        self._ps_call("HELLO", None, self._env_rank)
+                raise MXNetError(
+                    "kvstore server rejected HELLO: %s" % payload)
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
 
     def _ps_call(self, op, key=None, value=None):
+        """One PS RPC under the retry policy. Mutating ops carry a
+        sequence number assigned ONCE here, so every resend after a
+        reconnect is deduplicated server-side — at-most-once apply,
+        zero lost and zero doubled updates."""
+        seq = None
+        if op in _MUTATING_OPS:
+            self._seq += 1
+            seq = self._seq
+        return self._retrying(
+            "ps_" + op.lower(),
+            lambda: self._ps_call_once(op, key, value, seq))
+
+    def _ps_call_once(self, op, key, value, seq):
         from .kvstore_server import send_msg, recv_msg
         with self._sock_lock:
-            send_msg(self._sock, (op, key, value))
+            if self._sock is None:
+                raise ConnectionError("kvstore server connection lost")
+            send_msg(self._sock, (op, key, value, seq))
             status, payload = recv_msg(self._sock)
+        if status == "RETRY":
+            raise TransientKVError(
+                "kvstore server asked to retry %s: %s" % (op, payload))
         if status != "OK":
             raise MXNetError("kvstore server error: %s" % payload)
         return payload
+
+    def _retrying(self, op, fn):
+        """Run ``fn`` under the kvstore transport retry policy: up to
+        ``MXNET_KV_RETRIES`` retries with jittered exponential backoff
+        (base ``MXNET_KV_BACKOFF_MS``), bounded by the
+        ``MXNET_KV_TIMEOUT_MS`` per-op deadline, reconnecting to the PS
+        between attempts. Only transport-class failures
+        (:class:`TransientKVError`, :class:`FaultInjected`, socket/OS
+        errors) are retried; exhausting the policy raises a clear
+        :class:`MXNetError` naming the op and attempt count — a dead
+        server degrades to an error, never a hang."""
+        import random as _pyrandom
+        import socket
+        import time as _time
+        from .config import get as _cfg
+        retries = int(_cfg("MXNET_KV_RETRIES"))
+        budget_s = int(_cfg("MXNET_KV_TIMEOUT_MS")) / 1e3
+        base_s = max(1, int(_cfg("MXNET_KV_BACKOFF_MS"))) / 1e3
+        deadline = (_tm.monotonic() + budget_s) if budget_s > 0 else None
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except (TransientKVError, FaultInjected, ConnectionError,
+                    socket.timeout, TimeoutError, OSError) as exc:
+                attempt += 1
+                timed_out = (deadline is not None
+                             and _tm.monotonic() >= deadline)
+                if attempt > retries or timed_out:
+                    if _tm._enabled:
+                        _tm.counter(
+                            "kvstore/giveups_total",
+                            "KVStore ops abandoned after exhausting "
+                            "retries or deadline", ("op",)).labels(op).inc()
+                    reason = ("deadline of %d ms exceeded"
+                              % int(budget_s * 1e3)) if timed_out \
+                        else "%d retries exhausted" % retries
+                    raise MXNetError(
+                        "kvstore %s failed after %d attempt(s) (%s); "
+                        "last error: %s" % (op, attempt, reason, exc)
+                    ) from exc
+                if _tm._enabled:
+                    _tm.counter("kvstore/retries_total",
+                                "KVStore attempts retried after a "
+                                "transient failure", ("op",)
+                                ).labels(op).inc()
+                delay = base_s * (2 ** (attempt - 1))
+                delay *= 0.5 + _pyrandom.random() * 0.5    # full jitter
+                if deadline is not None:
+                    delay = min(delay, max(0.0,
+                                           deadline - _tm.monotonic()))
+                _time.sleep(delay)
+                if self._ps_host is not None:
+                    with self._sock_lock:
+                        try:
+                            self._dial()
+                        except (OSError, MXNetError):
+                            pass   # next attempt surfaces the failure
 
     def _server_profiler_command(self, cmd, payload):
         """Route a profiler command to the PS server process
@@ -165,29 +279,39 @@ class KVStore(object):
     def init(self, key, value):
         """Initialize a key. Rank-0 value wins (reference:
         kvstore_dist.h rank-0 init + broadcast; with allreduce semantics
-        every worker holds the full value, so init is local assignment)."""
+        every worker holds the full value, so init is local assignment).
+        The PS INIT RPC runs under the transport retry policy and
+        precedes the local store mutation, so a retried init never trips
+        the double-init check."""
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
-            self._store[k] = vlist[0].copy()
             if self._sock is not None:
                 self._ps_call("INIT", k, vlist[0].asnumpy())
+            self._store[k] = vlist[0].copy()
         if _tm._enabled:
             _tm.record_kvstore("init", None, _approx_nbytes(value))
 
     def push(self, key, value, priority=0):
         """Aggregate values; if an optimizer is installed, run the update
         on the store (reference: kvstore_local.h:184-212 PushImpl:
-        comm_->Reduce then updater_)."""
+        comm_->Reduce then updater_). Transient transport failures
+        (injected at the ``kv.push`` point, or socket-level in PS mode)
+        retry with jittered backoff under the per-op deadline; the
+        ``kv.push`` injection point fires before any mutation, so a
+        retried push applies exactly once."""
         if not _tm._enabled:
-            return self._push_impl(key, value, priority)
+            return self._retrying(
+                "push", lambda: self._push_impl(key, value, priority))
         t0 = _tm.monotonic()
-        self._push_impl(key, value, priority)
+        self._retrying("push",
+                       lambda: self._push_impl(key, value, priority))
         _tm.record_kvstore("push", _tm.monotonic() - t0,
                            _approx_nbytes(value))
 
     def _push_impl(self, key, value, priority=0):
+        _fault.inject("kv.push")
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -244,13 +368,18 @@ class KVStore(object):
         """Broadcast the stored value into ``out`` (reference:
         kvstore_local.h PullImpl → comm_->Broadcast)."""
         if not _tm._enabled:
-            return self._pull_impl(key, out, priority, ignore_sparse)
+            return self._retrying(
+                "pull",
+                lambda: self._pull_impl(key, out, priority, ignore_sparse))
         t0 = _tm.monotonic()
-        self._pull_impl(key, out, priority, ignore_sparse)
+        self._retrying(
+            "pull",
+            lambda: self._pull_impl(key, out, priority, ignore_sparse))
         _tm.record_kvstore("pull", _tm.monotonic() - t0,
                            _approx_nbytes(out))
 
     def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
+        _fault.inject("kv.pull")
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
@@ -410,7 +539,8 @@ class KVStore(object):
     # -- optimizer state io ------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not initialized"
-        with open(fname, "wb") as fout:
+        from .checkpoint import atomic_writer
+        with atomic_writer(fname) as fout:
             fout.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

@@ -36,9 +36,18 @@ import threading
 
 import numpy as np
 
+from . import fault as _fault
+from .fault import FaultInjected, TransientKVError
+
 __all__ = ["KVStoreServer", "send_msg", "recv_msg", "serve_forever"]
 
 _LEN = struct.Struct("!Q")
+
+# ops that mutate server state; their RPCs carry a client-assigned
+# sequence number and are deduplicated per rank (at-most-once apply
+# under worker retries/reconnects)
+_MUTATING_OPS = frozenset(
+    ("PUSH", "INIT", "SET_OPTIMIZER", "SET_COMPRESSION", "BARRIER"))
 
 
 def send_msg(sock, obj):
@@ -85,6 +94,10 @@ class KVStoreServer(object):
                              "MXNET_TPU_PS_TOKEN to be set")
         self._lock = threading.Lock()
         self._round_done = threading.Condition(self._lock)
+        # per-rank RPC dedup: rank -> {"seq", "done", "resp"} for the
+        # most recent mutating RPC (see _client_loop)
+        self._seq_cond = threading.Condition()
+        self._rank_rpc = {}
         self._barrier_waiting = 0
         self._barrier_gen = 0
         import time as _t
@@ -105,6 +118,7 @@ class KVStoreServer(object):
         return value
 
     def _handle(self, op, key=None, value=None):
+        _fault.inject("kv.server")
         if op == "INIT":
             with self._lock:
                 # rank-0 init wins; later INITs for the key are ignored
@@ -233,8 +247,13 @@ class KVStoreServer(object):
             rank = None
             while not self._stop.is_set():
                 msg = recv_msg(conn)
-                if msg[0] == "HELLO":
-                    rank = int(msg[2])
+                # wire compat: (op[, key[, value[, seq]]]) all legal
+                op = msg[0]
+                key = msg[1] if len(msg) > 1 else None
+                value = msg[2] if len(msg) > 2 else None
+                seq = msg[3] if len(msg) > 3 else None
+                if op == "HELLO":
+                    rank = int(value)
                 elif rank is not None:
                     # heartbeat BEFORE handling: sync PUSH/BARRIER block
                     # inside _handle waiting for stragglers, and a
@@ -242,24 +261,58 @@ class KVStoreServer(object):
                     import time as _t
                     with self._lock:
                         self._last_seen[rank] = _t.monotonic()
+                # replay shield: a worker that reconnected and resent a
+                # mutating RPC whose first copy already ran (the reply
+                # died with the old connection) must get that copy's
+                # response, not a second apply — at-most-once under the
+                # client retry policy
+                ent = None
+                if seq is not None and rank is not None \
+                        and op in _MUTATING_OPS:
+                    with self._seq_cond:
+                        cur = self._rank_rpc.get(rank)
+                        if cur is not None and cur["seq"] == seq:
+                            while not cur["done"] and \
+                                    not self._stop.is_set():
+                                self._seq_cond.wait(1.0)
+                            send_msg(conn, cur["resp"] if cur["resp"]
+                                     is not None else
+                                     ("ERR", "duplicate rpc interrupted"))
+                            continue
+                        ent = {"seq": seq, "done": False, "resp": None}
+                        self._rank_rpc[rank] = ent
                 try:
                     from . import profiler as _prof
-                    if _prof.is_running() and msg[0] != "PROFILER":
+                    if _prof.is_running() and op != "PROFILER":
                         # server-side op timeline for the remote
                         # profiler (reference: the PS server registers
                         # its handlers with the process profiler)
-                        with _prof.scope("kvstore_" + msg[0], "kvstore"):
-                            resp = self._handle(*msg)
+                        with _prof.scope("kvstore_" + op, "kvstore"):
+                            resp = self._handle(op, key, value)
                     else:
-                        resp = self._handle(*msg)
+                        resp = self._handle(op, key, value)
+                except (TransientKVError, FaultInjected) as e:
+                    # transient: tell the worker to retry (its transport
+                    # layer backs off and resends with the same seq)
+                    resp = ("RETRY", str(e))
                 except Exception:
                     # surface handler failures to the worker instead of
                     # dropping the connection (the reference propagates
                     # server errors back through ps-lite responses)
                     import traceback
                     resp = ("ERR", traceback.format_exc())
+                if ent is not None:
+                    with self._seq_cond:
+                        ent["done"] = True
+                        ent["resp"] = resp
+                        if resp[0] != "OK" and \
+                                self._rank_rpc.get(rank) is ent:
+                            # failed attempts must re-execute on retry,
+                            # not replay the failure from the cache
+                            del self._rank_rpc[rank]
+                        self._seq_cond.notify_all()
                 send_msg(conn, resp)
-                if msg[0] == "STOP":
+                if op == "STOP":
                     break
         except (ConnectionError, OSError):
             pass

@@ -5,6 +5,7 @@ _update_params(_on_kvstore)) and :383,413 (save_checkpoint/load_checkpoint).
 """
 from __future__ import annotations
 
+import os
 from collections import namedtuple
 
 import numpy as np
@@ -14,9 +15,9 @@ from .ndarray import save as nd_save, load as nd_load
 from .ndarray.ndarray import NDArray
 
 __all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
-           "load_checkpoint", "_create_kvstore", "_initialize_kvstore",
-           "_update_params", "_update_params_on_kvstore",
-           "fused_step_supported"]
+           "load_checkpoint", "load_latest_valid", "_create_kvstore",
+           "_initialize_kvstore", "_update_params",
+           "_update_params_on_kvstore", "fused_step_supported"]
 
 
 def fused_step_supported(optimizer, kvstore, update_on_kvstore,
@@ -112,20 +113,41 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True):
+                    remove_amp_cast=True, nbatch=0, states_fname=None):
     """Checkpoint to ``prefix-symbol.json`` + ``prefix-%04d.params``
-    (reference: model.py:383)."""
+    (reference: model.py:383), crash-consistently: every file is staged
+    to a temp, fsynced, and renamed, and a ``.manifest.json`` sidecar
+    records content checksums, the epoch/batch position, the RNG state,
+    and optimizer-state presence — what ``checkpoint.load_latest_valid``
+    verifies before trusting a checkpoint after a crash.
+
+    ``nbatch`` > 0 marks a mid-epoch (preemption) checkpoint;
+    ``states_fname`` names an optimizer-state file saved alongside (the
+    Module path passes it so the manifest covers it)."""
+    from . import telemetry as _tm
+    from .checkpoint import record_checkpoint_save, write_manifest
+    t0 = _tm.monotonic()
+    sym_file = None
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        sym_file = "%s-symbol.json" % prefix
+        symbol.save(sym_file)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd_save(param_name, save_dict)
+    write_manifest(prefix, epoch,
+                   {"params": param_name, "symbol": sym_file,
+                    "states": states_fname}, nbatch=nbatch)
+    record_checkpoint_save(param_name, t0)
 
 
 def load_checkpoint(prefix, epoch):
     """Load a checkpoint (reference: model.py:413). Returns
-    (symbol, arg_params, aux_params)."""
+    (symbol, arg_params, aux_params). A torn or corrupt params file
+    raises a :class:`MXNetError` naming the file and what failed
+    (magic / length / checksum) — use
+    :func:`mxnet_tpu.checkpoint.load_latest_valid` to fall back to the
+    newest checkpoint that still verifies."""
     from . import symbol as sym_mod
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
@@ -138,6 +160,20 @@ def load_checkpoint(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+def load_latest_valid(prefix):
+    """Newest checkpoint under ``prefix`` whose checksums verify, as
+    the classic ``(symbol, arg_params, aux_params, epoch)`` tuple —
+    the crash-tolerant counterpart of :func:`load_checkpoint`. Falls
+    back across torn/corrupt checkpoints; None when none exist. Full
+    resume state (RNG, batch position, optimizer-state file) lives on
+    :func:`mxnet_tpu.checkpoint.load_latest_valid`."""
+    from .checkpoint import load_latest_valid as _llv
+    state = _llv(prefix)
+    if state is None:
+        return None
+    return (state.symbol, state.arg_params, state.aux_params, state.epoch)
 
 
 class FeedForward(object):

@@ -245,12 +245,16 @@ class BucketingModule(BaseModule):
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save the default bucket's symbol + shared params."""
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        nbatch=0):
+        """Save the default bucket's symbol + shared params
+        (crash-consistently, with a manifest — see
+        :meth:`Module.save_checkpoint`)."""
         assert self.binded
         default_mod = self._buckets[self._default_bucket_key]
         # params live in the current module; sync them over
         if self._curr_module is not default_mod and self.params_initialized:
             arg_params, aux_params = self.get_params()
             default_mod.set_params(arg_params, aux_params, allow_missing=True)
-        default_mod.save_checkpoint(prefix, epoch, save_optimizer_states)
+        default_mod.save_checkpoint(prefix, epoch, save_optimizer_states,
+                                    nbatch=nbatch)

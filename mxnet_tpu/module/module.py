@@ -91,17 +91,34 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        nbatch=0):
         """Save symbol json + params (+ optimizer states)
-        (reference: module.py save_checkpoint → model.py:383)."""
-        self._symbol.save("%s-symbol.json" % prefix)
+        (reference: module.py save_checkpoint → model.py:383).
+
+        Crash-consistent: every file goes through the atomic
+        write-temp→fsync→rename path and a ``.manifest.json`` sidecar
+        records checksums, epoch/batch position, and RNG state, so a
+        SIGKILL at any instant never clobbers the previous good
+        checkpoint and ``checkpoint.load_latest_valid`` can verify this
+        one. ``nbatch`` > 0 marks a mid-epoch (preemption) save."""
+        from .. import telemetry as _tm
+        from ..checkpoint import record_checkpoint_save, write_manifest
+        t0 = _tm.monotonic()
+        sym_file = "%s-symbol.json" % prefix
+        self._symbol.save(sym_file)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info("Saved checkpoint to \"%s\"", param_name)
+        state_name = None
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
             logging.info("Saved optimizer state to \"%s\"", state_name)
+        write_manifest(prefix, epoch,
+                       {"params": param_name, "symbol": sym_file,
+                        "states": state_name}, nbatch=nbatch)
+        record_checkpoint_save(param_name, t0)
 
     # -- properties --------------------------------------------------------
     @property
@@ -505,7 +522,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            from ..checkpoint import atomic_writer
+            with atomic_writer(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -46,27 +46,39 @@ def save(fname, data, format="mxtpu"):
     if format not in ("mxtpu", "mxnet"):
         raise MXNetError("unknown save format %r (use 'mxtpu' or "
                          "'mxnet')" % (format,))
+    # crash consistency: both layouts stage into <fname>.tmp.<pid>,
+    # fsync, then os.replace — a SIGKILL at any instant leaves either
+    # the previous good file or the complete new one, never a torn one
+    # (atomic_writer also hosts the ckpt.mid_write/ckpt.pre_rename
+    # fault-injection points that prove it)
+    from ..checkpoint import atomic_writer
     if format == "mxnet":
         from . import mxnet_format
-        with open(fname, "wb") as f:
+        with atomic_writer(fname) as f:
             f.write(mxnet_format.dumps(items, keyed))
         return
-    with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
-        zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
-                    (_MAGIC, int(keyed), len(items)))
-        for i, (k, v) in enumerate(items):
-            from .sparse import BaseSparseNDArray
-            if isinstance(v, BaseSparseNDArray):
-                v = v.todense()      # zip/NPY layout is dense-only
-            buf = io.BytesIO()
-            _np.save(buf, v.asnumpy(), allow_pickle=False)
-            zf.writestr("%05d:%s" % (i, k), buf.getvalue())
+    with atomic_writer(fname) as f:
+        with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as zf:
+            zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
+                        (_MAGIC, int(keyed), len(items)))
+            for i, (k, v) in enumerate(items):
+                from .sparse import BaseSparseNDArray
+                if isinstance(v, BaseSparseNDArray):
+                    v = v.todense()      # zip/NPY layout is dense-only
+                buf = io.BytesIO()
+                _np.save(buf, v.asnumpy(), allow_pickle=False)
+                zf.writestr("%05d:%s" % (i, k), buf.getvalue())
 
 
 def load(fname, ctx=None):
     """Load NDArrays saved by :func:`save` OR by the reference
     framework (binary ``.params``, detected by magic — so published
-    MXNet checkpoints load directly; reference: utils.py:222)."""
+    MXNet checkpoints load directly; reference: utils.py:222).
+
+    A truncated or corrupt file raises a :class:`MXNetError` that names
+    the file and what failed (magic / length / per-member checksum)
+    instead of an opaque struct or zip parse error — the message an
+    operator staring at a post-crash checkpoint directory needs."""
     if not os.path.exists(fname):
         raise MXNetError("no such file %r" % fname)
     with open(fname, "rb") as f:
@@ -74,22 +86,49 @@ def load(fname, ctx=None):
     from . import mxnet_format
     if mxnet_format.is_mxnet_params(head):
         with open(fname, "rb") as f:
-            keys, arrays = mxnet_format.loads(f.read(), ctx=ctx)
+            buf = f.read()
+        try:
+            keys, arrays = mxnet_format.loads(buf, ctx=ctx)
+        except MXNetError as e:
+            raise MXNetError(
+                "checkpoint %r is corrupt or truncated (mxnet binary "
+                "layout: %s); it was likely torn by a crash mid-write — "
+                "fall back to an older checkpoint (see "
+                "checkpoint.load_latest_valid)" % (fname, e)) from e
         if keys:
             return dict(zip(keys, arrays))
         return arrays
-    with zipfile.ZipFile(fname, "r") as zf:
-        meta = zf.read("__meta__").decode().splitlines()
-        if meta[0] != _MAGIC:
-            raise MXNetError("not an NDArray file: %r" % fname)
-        keyed = bool(int(meta[1].split("=")[1]))
-        names = [n for n in zf.namelist() if n != "__meta__"]
-        names.sort()
-        out_items = []
-        for n in names:
-            idx, key = n.split(":", 1)
-            arr = _np.load(io.BytesIO(zf.read(n)), allow_pickle=False)
-            out_items.append((key, array(arr, ctx=ctx, dtype=arr.dtype)))
+    try:
+        with zipfile.ZipFile(fname, "r") as zf:
+            meta = zf.read("__meta__").decode().splitlines()
+            if meta[0] != _MAGIC:
+                raise MXNetError(
+                    "%r is not an NDArray file: magic %r != %r"
+                    % (fname, meta[0][:32], _MAGIC))
+            keyed = bool(int(meta[1].split("=")[1]))
+            count = int(meta[2].split("=")[1])
+            names = [n for n in zf.namelist() if n != "__meta__"]
+            if len(names) != count:
+                raise MXNetError(
+                    "checkpoint %r is truncated: holds %d of %d arrays"
+                    % (fname, len(names), count))
+            names.sort()
+            out_items = []
+            for n in names:
+                idx, key = n.split(":", 1)
+                # zf.read verifies the member's stored CRC-32
+                arr = _np.load(io.BytesIO(zf.read(n)), allow_pickle=False)
+                out_items.append((key, array(arr, ctx=ctx,
+                                             dtype=arr.dtype)))
+    except MXNetError:
+        raise
+    except (zipfile.BadZipFile, KeyError, IndexError, ValueError,
+            EOFError, OSError) as e:
+        raise MXNetError(
+            "checkpoint %r is corrupt or truncated (%s: %s); it was "
+            "likely torn by a crash mid-write — fall back to an older "
+            "checkpoint (see checkpoint.load_latest_valid)"
+            % (fname, type(e).__name__, e)) from e
     if keyed:
         return dict(out_items)
     return [v for _, v in out_items]

@@ -128,6 +128,16 @@ def _out_vma(*xs):
     return vma
 
 
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct carrying `vma` where this jax supports it;
+    jax 0.4.x has no varying-axes tracking to propagate (shard_map
+    check_rep covers replication there)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd_xla(q, k, v, causal, sm_scale):
     """Plain-XLA twin of the kernel (same (o, lse) contract).
 
@@ -187,17 +197,17 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sp_q, d), q.dtype,
-                                 vma=_out_vma(q, k, v)),
-            jax.ShapeDtypeStruct((b * h, sp_q, _LANES), jnp.float32,
-                                 vma=_out_vma(q, k, v)),
+            _sds((b * h, sp_q, d), q.dtype, _out_vma(q, k, v)),
+            _sds((b * h, sp_q, _LANES), jnp.float32, _out_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        # renamed TPUCompilerParams -> CompilerParams across jax releases
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)

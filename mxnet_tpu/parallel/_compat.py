@@ -1,0 +1,24 @@
+"""jax version compatibility for the parallelism stack.
+
+One definition of ``shard_map`` for every module in this package:
+jax >= 0.5 exposes it as public API with varying-manual-axes (vma)
+replication tracking; jax 0.4.x has it under ``jax.experimental`` with
+the older ``check_rep`` checker, which lacks rules for ``pallas_call``
+and the ring collectives used here — so on 0.4.x the wrapper maps any
+``check_vma`` argument away and disables ``check_rep``.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:                                  # jax >= 0.5: public API
+    from jax import shard_map
+except ImportError:                   # jax 0.4.x: experimental namespace
+    import functools as _ft
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @_ft.wraps(_shard_map_04)
+    def shard_map(*args, **kwargs):
+        kwargs.pop("check_vma", None)
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_04(*args, **kwargs)

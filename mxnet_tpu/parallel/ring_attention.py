@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 NEG_INF = -1e30
 

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 from .ring_attention import _ring_attention_local
 from .moe import top_k_gating
@@ -202,7 +202,11 @@ def init_transformer_params(cfg: TransformerConfig, mesh: Mesh, seed=0):
 
 def _pvary(x, axes):
     """pcast to varying only over axes x is not already varying on
-    (pcast rejects varying->varying)."""
+    (pcast rejects varying->varying). jax 0.4.x has no varying-manual-
+    axes tracking (no jax.typeof/pcast) — there shard_map's own
+    replication checking covers this and the cast is a no-op."""
+    if not hasattr(jax, "typeof"):
+        return x
     cur = getattr(jax.typeof(x), "vma", frozenset())
     missing = tuple(a for a in axes if a not in cur)
     return jax.lax.pcast(x, missing, to="varying") if missing else x

@@ -33,7 +33,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 __all__ = ["ulysses_attention", "ulysses_self_attention"]
 

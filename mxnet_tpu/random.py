@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "get_state", "set_state"]
 
 _state = threading.local()
 
@@ -31,6 +31,21 @@ def seed(seed_state: int, ctx=None):
 def current_seed():
     _ensure()
     return _state.seed
+
+
+def get_state():
+    """Snapshot of this thread's generator (seed + counter) — what a
+    checkpoint manifest records so a resumed run draws the exact keys
+    the interrupted run would have drawn."""
+    _ensure()
+    return {"seed": int(_state.seed), "counter": int(_state.counter)}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (checkpoint resume)."""
+    _ensure()
+    _state.seed = int(state["seed"])
+    _state.counter = int(state["counter"])
 
 
 def next_key():

@@ -37,6 +37,7 @@ from collections import deque
 
 import numpy as _np
 
+from .. import fault as _fault
 from ..base import MXNetError
 from .. import telemetry as _tm
 from .batching import parse_buckets, pick_bucket
@@ -62,10 +63,11 @@ class ServeConfig(object):
     tier (config.py); constructor arguments override per engine."""
 
     __slots__ = ("max_batch", "buckets", "queue_depth", "batch_wait",
-                 "default_timeout", "workers")
+                 "default_timeout", "workers", "worker_restarts")
 
     def __init__(self, max_batch=None, buckets=None, queue_depth=None,
-                 batch_wait_ms=None, default_timeout_ms=None, workers=None):
+                 batch_wait_ms=None, default_timeout_ms=None, workers=None,
+                 worker_restarts=None):
         from ..config import get as _cfg
 
         def pick(val, name):
@@ -89,6 +91,8 @@ class ServeConfig(object):
         self.default_timeout = float(
             pick(default_timeout_ms, "MXNET_SERVE_DEADLINE_MS")) / 1e3
         self.workers = max(1, int(pick(workers, "MXNET_SERVE_WORKERS")))
+        self.worker_restarts = max(0, int(pick(
+            worker_restarts, "MXNET_SERVE_WORKER_RESTARTS")))
         if self.queue_depth < 1:
             raise MXNetError("queue_depth must be >= 1")
 
@@ -188,6 +192,7 @@ class InferenceEngine(object):
         self._accepting = True
         self._ready = False
         self._workers = []
+        self._restarts_used = 0
 
         self._m_requests = _tm.counter(
             "serving/requests_total", "Inference requests accepted")
@@ -222,8 +227,9 @@ class InferenceEngine(object):
             if self._workers:
                 return self
             self._accepting = True
+            self._restarts_used = 0
             for i in range(self._cfg.workers):
-                t = threading.Thread(target=self._worker_loop,
+                t = threading.Thread(target=self._worker_main,
                                      name="mxnet-serve-worker-%d" % i,
                                      daemon=True)
                 t.start()
@@ -249,10 +255,12 @@ class InferenceEngine(object):
 
     @property
     def ready(self):
-        """Health-check gate: every bucket compiled AND workers live —
-        a warmed engine with no one to pop the queue must not attract
-        load-balancer traffic."""
-        return self._ready and bool(self._workers)
+        """Health-check gate: every bucket compiled AND at least one
+        worker actually alive — a warmed engine whose crew all crashed
+        past the restart budget (or that has no one to pop the queue)
+        must not attract load-balancer traffic; /healthz degrades to
+        not-ready and the balancer routes elsewhere."""
+        return self._ready and any(t.is_alive() for t in self._workers)
 
     @property
     def config(self):
@@ -396,8 +404,40 @@ class InferenceEngine(object):
                 self._cond.notify()      # more work for another worker
         return batch
 
+    def _worker_main(self):
+        """Worker thread entry: run the loop, and when it CRASHES (an
+        exception escaping the per-batch containment — a bug, an
+        injected ``serve.worker`` fault, a device wedge) restart it in
+        place, up to ``MXNET_SERVE_WORKER_RESTARTS`` restarts shared
+        across the crew. Each restart is counted in
+        ``serving/worker_restarts_total``; past the budget the worker
+        stays down and ``ready`` (hence /healthz) degrades once no
+        worker is left alive."""
+        while True:
+            try:
+                self._worker_loop()
+                return                   # clean exit: engine closed
+            except BaseException as exc:
+                with self._cond:
+                    if not self._accepting:
+                        return           # crash during drain: no restart
+                    if self._restarts_used >= self._cfg.worker_restarts:
+                        import logging
+                        logging.error(
+                            "serve worker crashed (%s) with the restart "
+                            "budget (%d) exhausted; worker stays down",
+                            exc, self._cfg.worker_restarts)
+                        return
+                    self._restarts_used += 1
+                # counted only when a restart actually happens — the
+                # metric is the alerting signal for budget burn-down
+                _tm.counter("serving/worker_restarts_total",
+                            "Serve worker threads restarted after a "
+                            "crash").inc()
+
     def _worker_loop(self):
         while True:
+            _fault.inject("serve.worker")
             batch = self._take_batch()
             if batch is None:
                 return

@@ -422,7 +422,9 @@ class Symbol(object):
                           indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # atomic: a crash mid-save must not tear an existing symbol file
+        from ..checkpoint import atomic_writer
+        with atomic_writer(fname, "w") as f:
             f.write(self.tojson())
 
     # -- binding -----------------------------------------------------------

@@ -664,7 +664,19 @@ def snapshot():
            "serve_rejected": _val("serving/rejected_total"),
            "serve_timeouts": _val("serving/timeouts_total"),
            "serve_batches": _val("serving/batches_total"),
-           "serve_swaps": _val("serving/swaps_total")}
+           "serve_swaps": _val("serving/swaps_total"),
+           # fault-tolerance accounting: crash-consistent checkpoint
+           # traffic, kvstore transport retries, serve worker crashes,
+           # and armed faults fired (test runs) — the robustness
+           # evidence banked with train_resume bench records
+           "ckpt_saves": _val("checkpoint/saves_total"),
+           "ckpt_restores": _val("checkpoint/restores_total"),
+           "ckpt_fallbacks": _val("checkpoint/fallbacks_total"),
+           "ckpt_corrupt": _val("checkpoint/corrupt_total"),
+           "kv_retries": _val("kvstore/retries_total"),
+           "kv_giveups": _val("kvstore/giveups_total"),
+           "serve_worker_restarts": _val("serving/worker_restarts_total"),
+           "faults_injected": _val("fault/injected_total")}
     fam = REGISTRY._families.get("serving/batch_rows")
     if fam is not None:
         rows = sum(c.sum for _lv, c in fam.series())

@@ -55,6 +55,55 @@ def test_max_to_keep_and_resume(tmp_path):
                                np.asarray(state["b"]))
 
 
+def test_restore_with_no_checkpoints_raises(tmp_path):
+    """restore()/restore_latest_valid() on an empty directory raise a
+    clear MXNetError instead of an opaque orbax failure."""
+    from mxnet_tpu.base import MXNetError
+    mgr = ShardedCheckpointManager(str(tmp_path))
+    with pytest.raises(MXNetError, match="no checkpoint found"):
+        mgr.restore()
+    with pytest.raises(MXNetError, match="no checkpoint found"):
+        mgr.restore_latest_valid()
+    mgr.close()
+
+
+def test_max_to_keep_prunes_old_steps(tmp_path):
+    mgr = ShardedCheckpointManager(str(tmp_path), max_to_keep=2)
+    state = {"w": np.ones((4,), np.float32)}
+    import jax.numpy as jnp
+    for step in (1, 2, 3, 4):
+        mgr.save(step, {"w": jnp.full((4,), float(step))})
+    steps = mgr.all_steps()
+    mgr.close()
+    assert steps == [3, 4]
+
+
+def test_restore_latest_valid_falls_back_over_corrupt_step(tmp_path):
+    """Fallback across a corrupted latest step: every file of the
+    newest step is truncated; restore_latest_valid returns the previous
+    good step with its values intact."""
+    import os
+    import jax.numpy as jnp
+    from mxnet_tpu import telemetry as tm
+    mgr = ShardedCheckpointManager(str(tmp_path))
+    like = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+    mgr.save(1, {"w": jnp.full((4, 4), 1.0), "b": jnp.full((4,), 1.0)})
+    mgr.save(2, {"w": jnp.full((4, 4), 2.0), "b": jnp.full((4,), 2.0)})
+    for root, _dirs, files in os.walk(str(tmp_path / "2")):
+        for fn in files:
+            with open(os.path.join(root, fn), "r+b") as f:
+                f.truncate(1)
+    snap0 = tm.snapshot()
+    step, restored = mgr.restore_latest_valid(like=like)
+    snap1 = tm.snapshot()
+    mgr.close()
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.full((4, 4), 1.0))
+    assert snap1["ckpt_corrupt"] - snap0["ckpt_corrupt"] >= 1
+    assert snap1["ckpt_fallbacks"] - snap0["ckpt_fallbacks"] == 1
+
+
 def test_checkpoint_accepts_ndarrays(tmp_path):
     mgr = ShardedCheckpointManager(str(tmp_path))
     state = {"w": mx.nd.array(np.ones((3, 3), np.float32))}
