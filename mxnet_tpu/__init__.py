@@ -45,7 +45,8 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "checkpoint", "gradient_compression", "kvstore_server", "storage",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
          "util", "registry", "misc", "executor_manager", "ndarray_doc",
-         "symbol_doc", "telemetry", "serving", "serve", "fault")
+         "symbol_doc", "telemetry", "serving", "serve", "fault",
+         "tracing")
 
 
 def __getattr__(name):

@@ -904,6 +904,99 @@ def train_mlp(batch=64, iters=50, steps_per_call=32):
 
 
 # ---------------------------------------------------------------------------
+# tracing overhead job (tracing.py cost model proof)
+
+def trace_overhead(iters=300, rounds=12):
+    """Span-tracer cost on the ``op/dispatch`` microbench, banked for
+    the three modes that matter: disabled (``MXNET_TRACING=0`` — one
+    module-bool check, the fault.py pattern), enabled with sampling 0
+    (one contextvar read per dispatch), and enabled with sampling 1
+    under an active root span (a real span recorded per dispatch).
+
+    Dispatch wall time on a busy host jitters far more than the
+    sampling-0 effect (~60 ns on a tens-of-us dispatch), so two
+    measurements are banked: min-of-rounds wall times with the mode
+    order ALTERNATED each round (drift hits every mode equally), and
+    the deterministic per-call cost of the hook itself
+    (``tracing.active()`` via timeit) divided into the dispatch time —
+    the honest sampling-0 overhead figure the ISSUE 5 acceptance
+    (< 5%) is judged on."""
+    import timeit
+    import mxnet_tpu as mx
+    from . import tracing as _tr
+
+    x = mx.nd.array(np.random.rand(16, 16).astype(np.float32))
+    mx.nd.dot(x, x).wait_to_read()       # warm the jit cache
+
+    def chunk_disabled():
+        prev = _tr.enable(False)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            mx.nd.dot(x, x)
+        dt = time.perf_counter() - t0
+        _tr.enable(prev)
+        return dt
+
+    def chunk_sampled(rate):
+        # arm MXNET_TRACE_OPS so the banked figures bound the OPTED-IN
+        # per-op path; the shipped default (trace_ops off) pays one
+        # module-attr read per dispatch, cheaper than the s0 number
+        prev_on = _tr.enable(True)
+        prev_rate = _tr.set_sample(rate)
+        prev_ops = _tr.set_trace_ops(True)
+        try:
+            with _tr.start_span("bench.trace_overhead"):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    mx.nd.dot(x, x)
+                return time.perf_counter() - t0
+        finally:
+            _tr.set_trace_ops(prev_ops)
+            _tr.set_sample(prev_rate)
+            _tr.enable(prev_on)
+            _tr.reset()
+
+    modes = (("off", chunk_disabled),
+             ("s0", lambda: chunk_sampled(0.0)),
+             ("s1", lambda: chunk_sampled(1.0)))
+    for _name, fn in modes:
+        fn()                             # warm each path once
+    best = {"off": float("inf"), "s0": float("inf"), "s1": float("inf")}
+    for r in range(rounds):
+        order = modes if r % 2 == 0 else tuple(reversed(modes))
+        for name, fn in order:
+            best[name] = min(best[name], fn())
+
+    us = {k: v / iters * 1e6 for k, v in best.items()}
+    # deterministic hook cost: what one dispatch pays at sampling 0
+    # (tracing enabled, nothing recording) over the disabled check
+    prev = _tr.enable(True)
+    prev_rate = _tr.set_sample(0.0)
+    hook_on_ns = timeit.timeit(_tr.active, number=200000) / 200000 * 1e9
+    _tr.enable(False)
+    hook_off_ns = timeit.timeit(_tr.active, number=200000) / 200000 * 1e9
+    _tr.enable(prev)
+    _tr.set_sample(prev_rate)
+    extra = {
+        "dispatch_us_tracing_off": round(us["off"], 3),
+        "dispatch_us_sampling0": round(us["s0"], 3),
+        "dispatch_us_sampling1": round(us["s1"], 3),
+        "overhead_pct_sampling0_wall":
+            round((us["s0"] / us["off"] - 1.0) * 100, 2),
+        "overhead_pct_sampling1_wall":
+            round((us["s1"] / us["off"] - 1.0) * 100, 2),
+        "hook_ns_sampling0": round(hook_on_ns, 1),
+        "hook_ns_disabled": round(hook_off_ns, 1),
+        "overhead_pct_sampling0_derived":
+            round((hook_on_ns - hook_off_ns) / (us["off"] * 1e3) * 100,
+                  3),
+    }
+    # persist() keeps the highest value per metric, so bank a
+    # higher-is-better rate (dispatches/s with tracing compiled out)
+    return 1e6 / us["off"], extra
+
+
+# ---------------------------------------------------------------------------
 # serving job (serve.InferenceEngine under offered load)
 
 def serve_predictor(offered_rps=400, clients=16, duration=4.0,
@@ -1300,6 +1393,14 @@ def _job_e2e_train():
                    "img/s (resnet50 bf16 train, data pipeline in loop)", x)
 
 
+def _job_trace_overhead():
+    v, x = trace_overhead()
+    return persist("trace_overhead_dispatch_per_sec", v,
+                   "dispatch/s (16x16 dot, tracing disabled; "
+                   "sampling-0/1 overhead % in extras)", x,
+                   host_metric=True)
+
+
 def _job_predictor_serve():
     v, x = serve_predictor()
     return persist("predictor_serve_req_per_sec", v,
@@ -1325,6 +1426,7 @@ def _make_infer_job(model, dtype, batch=32):
 
 
 JOBS = {
+    "trace_overhead": _job_trace_overhead,
     "train_resume": _job_train_resume,
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
@@ -1354,6 +1456,7 @@ JOBS["resnet50_infer_b128"] = _make_infer_job("resnet50", "float32",
 JOB_PRIORITY = [
     "mlp_train",
     "mlp_train_fused",
+    "trace_overhead",
     "train_resume",
     "predictor_serve",
     "data_pipeline",

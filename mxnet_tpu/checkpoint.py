@@ -71,26 +71,29 @@ def atomic_writer(fname, mode="wb"):
     before fsync (the torn-write window); ``ckpt.pre_rename`` fires
     after fsync, before the rename makes the file visible.
     """
+    from . import tracing as _tr
     fname = os.fspath(fname)
     tmp = "%s.tmp.%d" % (fname, os.getpid())
-    f = open(tmp, mode)
-    try:
-        yield f
-        _fault.inject("ckpt.mid_write")
-        f.flush()
-        os.fsync(f.fileno())
-        f.close()
-        _fault.inject("ckpt.pre_rename")
-        os.replace(tmp, fname)
-        _fsync_dir(os.path.dirname(os.path.abspath(fname)))
-    except BaseException:
-        if not f.closed:
-            f.close()
+    with _tr.child_span("ckpt.write",
+                        attrs={"file": os.path.basename(fname)}):
+        f = open(tmp, mode)
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            yield f
+            _fault.inject("ckpt.mid_write")
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            _fault.inject("ckpt.pre_rename")
+            os.replace(tmp, fname)
+            _fsync_dir(os.path.dirname(os.path.abspath(fname)))
+        except BaseException:
+            if not f.closed:
+                f.close()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
 
 def _fsync_dir(path):

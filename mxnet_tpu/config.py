@@ -124,6 +124,41 @@ VARS = {
                             "Base kvstore retry backoff; attempt n "
                             "sleeps ~base*2^(n-1) with full jitter, "
                             "capped by the remaining op deadline."),
+    "MXNET_TRACING": (bool, True,
+                      "End-to-end span tracing (tracing.py): request/"
+                      "step timelines propagated across serve, "
+                      "executor, kvstore, and module layers. 0 removes "
+                      "every call-site hook (one module-bool check, "
+                      "like fault.py)."),
+    "MXNET_TRACE_SAMPLE": (float, 1.0,
+                           "Head-sampling probability for new traces "
+                           "(decided once at the root: an HTTP request "
+                           "or a train step). 0 disables recording but "
+                           "keeps X-Request-Id echo; lower in "
+                           "production to bound tracer work."),
+    "MXNET_TRACE_OPS": (bool, False,
+                        "Record a per-op op.dispatch span for every "
+                        "eager dispatch under a sampled trace. Off by "
+                        "default: on microsecond-scale ops the span "
+                        "write dominates the dispatch itself (the "
+                        "trace_overhead bench banks it), so structural "
+                        "spans stay cheap and per-op detail is opt-in."),
+    "MXNET_TRACE_SLOW_MS": (int, 1000,
+                            "Slow-exemplar threshold: sampled traces "
+                            "whose root span exceeds this many ms (and "
+                            "every sampled trace ending in an error/"
+                            "timeout/injected fault) are retained in a "
+                            "separate always-kept ring."),
+    "MXNET_TRACE_RING": (int, 64,
+                         "How many finished traces the in-memory ring "
+                         "keeps for /traces and the chrome-trace "
+                         "merge."),
+    "MXNET_LOG_JSON": (bool, False,
+                       "log.get_logger emits one JSON object per "
+                       "record (ts/level/name/msg + trace_id/span_id "
+                       "from the active trace context). 0 keeps the "
+                       "plain formatter, which appends [trace=…] when "
+                       "a context is active."),
     "MXNET_FAULT_INJECT": (str, "",
                            "Arm fault-injection points at import: "
                            "point:step:kind[:count] comma list "

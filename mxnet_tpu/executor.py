@@ -23,6 +23,7 @@ from .ndarray.ndarray import NDArray, zeros
 from .context import current_context
 from . import random as _random
 from . import telemetry as _tm
+from . import tracing as _tr
 from .ops import registry as _reg
 from .symbol.symbol import _graph_eval_fn, _topo
 
@@ -245,7 +246,9 @@ class Executor(object):
         for k, v in kwargs.items():
             self._stage_input(k, v)
         key = _random.next_key() if self._needs_rng else None
-        outs, new_aux = self._fwd(bool(is_train))(self._env(), key)
+        with _tr.child_span("executor.forward",
+                            attrs={"is_train": bool(is_train)}):
+            outs, new_aux = self._fwd(bool(is_train))(self._env(), key)
         self._last_key = key
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
@@ -418,11 +421,12 @@ class Executor(object):
         from . import engine as _engine
         from . import profiler as _prof
         token = _tm.dispatch_begin() if _tm._enabled else None
-        if _engine.profiling_imperative():
-            with _prof.scope("fused_train_step", "executor"):
+        with _tr.child_span("executor.train_step"):
+            if _engine.profiling_imperative():
+                with _prof.scope("fused_train_step", "executor"):
+                    new_p, new_s, new_aux, outs = run(*args)
+            else:
                 new_p, new_s, new_aux, outs = run(*args)
-        else:
-            new_p, new_s, new_aux, outs = run(*args)
         if token is not None:
             _tm.dispatch_end("fused_train_step", token)
 

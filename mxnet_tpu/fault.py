@@ -176,6 +176,13 @@ def inject(point):
                         ("point",)).labels(point).inc()
     except Exception:
         pass
+    try:
+        # a sampled trace that eats an injected fault is always worth
+        # keeping: flag it for the slow/error exemplar ring
+        from . import tracing as _tr
+        _tr.mark_error("fault injected at %r (hit %d)" % (point, hit))
+    except Exception:
+        pass
     if kind == "crash":
         # SIGKILL-grade: no atexit, no finally, buffers not flushed —
         # the honest preemption simulation

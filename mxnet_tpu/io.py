@@ -21,6 +21,7 @@ import numpy as np
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 from . import telemetry as _tm
+from . import tracing as _tr
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter"]
@@ -475,12 +476,25 @@ class PrefetchingIter(DataIter):
                       "of the consumer").set(
                 sum(1 for e in self.data_ready if e.is_set()))
             t0 = _tm.monotonic()
+        # the trace hook rides independently of the telemetry gate: the
+        # step timeline must keep its input-stall span even with
+        # MXNET_TELEMETRY=0
+        tctx = _tr.active()
+        if tctx is not None and t0 is None:
+            t0 = _tm.monotonic()
         for e in self.data_ready:
             e.wait()
         if t0 is not None:
-            _tm.histogram("io/batch_wait_seconds",
-                          "Time the consumer blocked waiting for the "
-                          "prefetcher").observe(_tm.monotonic() - t0)
+            t1 = _tm.monotonic()
+            if _tm._enabled:
+                _tm.histogram("io/batch_wait_seconds",
+                              "Time the consumer blocked waiting for the "
+                              "prefetcher").observe(
+                    t1 - t0, trace_id=tctx.trace_id if tctx else None)
+            if tctx is not None:
+                # inside a train.step timeline this is the input-stall
+                # share of the step's data-wait
+                _tr.record_span("io.batch_wait", tctx, t0, t1)
         if self.next_batch[0] is None:
             # all sub-iterators end together
             assert all(b is None for b in self.next_batch), \

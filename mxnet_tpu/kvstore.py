@@ -23,6 +23,7 @@ from .base import MXNetError
 from .fault import FaultInjected, TransientKVError
 from .ndarray.ndarray import NDArray, zeros
 from . import telemetry as _tm
+from . import tracing as _tr
 
 __all__ = ["KVStore", "create", "TransientKVError"]
 
@@ -174,11 +175,26 @@ class KVStore(object):
 
     def _ps_call_once(self, op, key, value, seq):
         from .kvstore_server import send_msg, recv_msg
+        # the active span context (the kv.attempt span) rides in the
+        # RPC payload, so server-side handling — and the seq-cache
+        # replay shield — surfaces under the client's trace
+        tctx = _tr.wire_context()
+        msg = (op, key, value, seq) if tctx is None \
+            else (op, key, value, seq, tctx)
         with self._sock_lock:
             if self._sock is None:
                 raise ConnectionError("kvstore server connection lost")
-            send_msg(self._sock, (op, key, value, seq))
-            status, payload = recv_msg(self._sock)
+            send_msg(self._sock, msg)
+            resp = recv_msg(self._sock)
+        status, payload = resp[0], resp[1]
+        if len(resp) > 2 and resp[2]:
+            # (proc_token, server_now, spans) recorded for this RPC;
+            # graft() deduplicates on span id (a cache-replayed response
+            # cannot double-count them) and rebases an out-of-process
+            # server's perf_counter epoch onto ours via the clock pair
+            token, server_now, spans = resp[2]
+            _tr.graft(spans,
+                      clock=(token, server_now, _tm.monotonic()))
         if status == "RETRY":
             raise TransientKVError(
                 "kvstore server asked to retry %s: %s" % (op, payload))
@@ -207,7 +223,16 @@ class KVStore(object):
         attempt = 0
         while True:
             try:
-                return fn()
+                if _tr.active() is None:
+                    return fn()
+                # one span per attempt under the op's client span: a
+                # retried op shows each try (the second onward marked
+                # retried), all sharing the same parent
+                attrs = {"op": op, "attempt": attempt + 1}
+                if attempt:
+                    attrs["retried"] = True
+                with _tr.child_span("kv.attempt", attrs=attrs):
+                    return fn()
             except (TransientKVError, FaultInjected, ConnectionError,
                     socket.timeout, TimeoutError, OSError) as exc:
                 attempt += 1
@@ -283,13 +308,14 @@ class KVStore(object):
         The PS INIT RPC runs under the transport retry policy and
         precedes the local store mutation, so a retried init never trips
         the double-init check."""
-        keys, vals = _ctype_key_value(key, value)
-        for k, vlist in zip(keys, vals):
-            if k in self._store:
-                raise MXNetError("key %r already initialized" % (k,))
-            if self._sock is not None:
-                self._ps_call("INIT", k, vlist[0].asnumpy())
-            self._store[k] = vlist[0].copy()
+        with _tr.child_span("kv.init"):
+            keys, vals = _ctype_key_value(key, value)
+            for k, vlist in zip(keys, vals):
+                if k in self._store:
+                    raise MXNetError("key %r already initialized" % (k,))
+                if self._sock is not None:
+                    self._ps_call("INIT", k, vlist[0].asnumpy())
+                self._store[k] = vlist[0].copy()
         if _tm._enabled:
             _tm.record_kvstore("init", None, _approx_nbytes(value))
 
@@ -301,14 +327,16 @@ class KVStore(object):
         retry with jittered backoff under the per-op deadline; the
         ``kv.push`` injection point fires before any mutation, so a
         retried push applies exactly once."""
-        if not _tm._enabled:
-            return self._retrying(
+        ctx = _tr.active()
+        t0 = _tm.monotonic() if _tm._enabled else None
+        with _tr.child_span("kv.push", ctx=ctx):
+            ret = self._retrying(
                 "push", lambda: self._push_impl(key, value, priority))
-        t0 = _tm.monotonic()
-        self._retrying("push",
-                       lambda: self._push_impl(key, value, priority))
-        _tm.record_kvstore("push", _tm.monotonic() - t0,
-                           _approx_nbytes(value))
+        if t0 is not None:
+            _tm.record_kvstore("push", _tm.monotonic() - t0,
+                               _approx_nbytes(value),
+                               trace_id=ctx.trace_id if ctx else None)
+        return ret
 
     def _push_impl(self, key, value, priority=0):
         _fault.inject("kv.push")
@@ -367,16 +395,17 @@ class KVStore(object):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast the stored value into ``out`` (reference:
         kvstore_local.h PullImpl → comm_->Broadcast)."""
-        if not _tm._enabled:
-            return self._retrying(
+        ctx = _tr.active()
+        t0 = _tm.monotonic() if _tm._enabled else None
+        with _tr.child_span("kv.pull", ctx=ctx):
+            ret = self._retrying(
                 "pull",
                 lambda: self._pull_impl(key, out, priority, ignore_sparse))
-        t0 = _tm.monotonic()
-        self._retrying(
-            "pull",
-            lambda: self._pull_impl(key, out, priority, ignore_sparse))
-        _tm.record_kvstore("pull", _tm.monotonic() - t0,
-                           _approx_nbytes(out))
+        if t0 is not None:
+            _tm.record_kvstore("pull", _tm.monotonic() - t0,
+                               _approx_nbytes(out),
+                               trace_id=ctx.trace_id if ctx else None)
+        return ret
 
     def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         _fault.inject("kv.pull")

@@ -33,10 +33,13 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 from . import fault as _fault
+from . import telemetry as _tm
+from . import tracing as _tr
 from .fault import FaultInjected, TransientKVError
 
 __all__ = ["KVStoreServer", "send_msg", "recv_msg", "serve_forever"]
@@ -247,50 +250,93 @@ class KVStoreServer(object):
             rank = None
             while not self._stop.is_set():
                 msg = recv_msg(conn)
-                # wire compat: (op[, key[, value[, seq]]]) all legal
+                # wire compat: (op[, key[, value[, seq[, tctx]]]]) all
+                # legal; tctx is the client's serialized span context
                 op = msg[0]
                 key = msg[1] if len(msg) > 1 else None
                 value = msg[2] if len(msg) > 2 else None
                 seq = msg[3] if len(msg) > 3 else None
+                tctx = msg[4] if len(msg) > 4 else None
+                # server spans recorded for THIS rpc collect here and
+                # ship back inside the response, surfacing under the
+                # client's trace
+                sink = []
+                tr_ctx = _tr.from_wire(tctx, sink=sink)
                 if op == "HELLO":
                     rank = int(value)
                 elif rank is not None:
                     # heartbeat BEFORE handling: sync PUSH/BARRIER block
                     # inside _handle waiting for stragglers, and a
                     # blocked-but-alive worker must not read as dead
-                    import time as _t
                     with self._lock:
-                        self._last_seen[rank] = _t.monotonic()
+                        self._last_seen[rank] = time.monotonic()
                 # replay shield: a worker that reconnected and resent a
                 # mutating RPC whose first copy already ran (the reply
                 # died with the old connection) must get that copy's
                 # response, not a second apply — at-most-once under the
                 # client retry policy
                 ent = None
+                dedup = None
                 if seq is not None and rank is not None \
                         and op in _MUTATING_OPS:
+                    t_c0 = time.perf_counter()
                     with self._seq_cond:
                         cur = self._rank_rpc.get(rank)
                         if cur is not None and cur["seq"] == seq:
                             while not cur["done"] and \
                                     not self._stop.is_set():
                                 self._seq_cond.wait(1.0)
-                            send_msg(conn, cur["resp"] if cur["resp"]
+                            dedup = (cur["resp"] if cur["resp"]
                                      is not None else
                                      ("ERR", "duplicate rpc interrupted"))
-                            continue
-                        ent = {"seq": seq, "done": False, "resp": None}
-                        self._rank_rpc[rank] = ent
+                            orig_spans = list(cur.get("spans") or ())
+                        else:
+                            ent = {"seq": seq, "done": False,
+                                   "resp": None, "spans": None}
+                            self._rank_rpc[rank] = ent
+                    if dedup is not None:
+                        # at-most-once applies to observability too: the
+                        # replay served from the seq-cache gets a span
+                        # marked cached=true covering only the cache
+                        # lookup, NOT a re-recorded handler latency; the
+                        # original execution's spans are re-shipped (the
+                        # first reply may have died with the old
+                        # connection) and the client deduplicates them
+                        # by span id
+                        if tr_ctx is not None:
+                            _tr.record_span(
+                                "kv.server", tr_ctx, t_c0,
+                                time.perf_counter(),
+                                attrs={"op": op, "cached": True})
+                        spans = orig_spans + sink
+                        # (proc_token, server_now, spans): the token +
+                        # clock reading let the client rebase a foreign
+                        # perf_counter epoch, and ONLY a foreign one
+                        send_msg(conn,
+                                 dedup + ((_tr._PROC_TOKEN,
+                                           time.perf_counter(), spans),)
+                                 if spans else dedup)
+                        continue
+                t_h0 = time.perf_counter()
                 try:
                     from . import profiler as _prof
-                    if _prof.is_running() and op != "PROFILER":
-                        # server-side op timeline for the remote
-                        # profiler (reference: the PS server registers
-                        # its handlers with the process profiler)
-                        with _prof.scope("kvstore_" + op, "kvstore"):
-                            resp = self._handle(op, key, value)
+
+                    def _execute():
+                        if _prof.is_running() and op != "PROFILER":
+                            # server-side op timeline for the remote
+                            # profiler (reference: the PS server
+                            # registers its handlers with the process
+                            # profiler)
+                            with _prof.scope("kvstore_" + op, "kvstore"):
+                                return self._handle(op, key, value)
+                        return self._handle(op, key, value)
+
+                    if tr_ctx is not None:
+                        with _tr.start_span("kv.server", ctx=tr_ctx,
+                                            attrs={"op": op}):
+                            resp = _execute()
                     else:
-                        resp = self._handle(op, key, value)
+                        resp = _execute()
                 except (TransientKVError, FaultInjected) as e:
                     # transient: tell the worker to retry (its transport
                     # layer backs off and resends with the same seq)
@@ -301,17 +347,32 @@ class KVStoreServer(object):
                     # server errors back through ps-lite responses)
                     import traceback
                     resp = ("ERR", traceback.format_exc())
+                if _tm._enabled:
+                    # real executions only — the dedup path above never
+                    # reaches here, so a replayed RPC cannot
+                    # double-count handler latency
+                    _tm.histogram(
+                        "kvstore/server_handle_seconds",
+                        "PS server request handling latency "
+                        "(real executions; seq-cache replays excluded)",
+                        ("op",)).labels(op).observe(
+                        time.perf_counter() - t_h0,
+                        trace_id=tr_ctx.trace_id if tr_ctx else None)
                 if ent is not None:
                     with self._seq_cond:
                         ent["done"] = True
                         ent["resp"] = resp
+                        ent["spans"] = list(sink)
                         if resp[0] != "OK" and \
                                 self._rank_rpc.get(rank) is ent:
                             # failed attempts must re-execute on retry,
                             # not replay the failure from the cache
                             del self._rank_rpc[rank]
                         self._seq_cond.notify_all()
-                send_msg(conn, resp)
+                send_msg(conn,
+                         resp + ((_tr._PROC_TOKEN,
+                                  time.perf_counter(), sink),)
+                         if sink else resp)
                 if op == "STOP":
                     break
         except (ConnectionError, OSError):

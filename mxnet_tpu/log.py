@@ -1,12 +1,21 @@
 """Logging helpers (reference: python/mxnet/log.py — a thin veneer over
-the stdlib with a compact colored formatter)."""
+the stdlib with a compact colored formatter).
+
+Trace correlation: when a span context is active (tracing.py), the
+plain formatter appends ``[trace=<id> span=<id>]`` to every record, and
+``MXNET_LOG_JSON=1`` switches :func:`get_logger` to one JSON object per
+record with explicit ``trace_id``/``span_id`` fields — so a log line
+from a slow request links directly to its ``/traces`` timeline.
+"""
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 
-__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING",
-           "ERROR", "NOTSET"]
+__all__ = ["get_logger", "getLogger", "JsonFormatter", "TraceFormatter",
+           "DEBUG", "INFO", "WARNING", "ERROR", "NOTSET"]
 
 DEBUG = logging.DEBUG
 INFO = logging.INFO
@@ -16,6 +25,60 @@ NOTSET = logging.NOTSET
 
 _FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _DATEFMT = "%m%d %H:%M:%S"
+
+
+def _trace_ids():
+    """(trace_id, span_id) of the active span context, or (None, None).
+    Lazy import: log must stay importable before/without tracing."""
+    try:
+        from . import tracing
+        ctx = tracing.active()
+        if ctx is not None:
+            return ctx.trace_id, ctx.span_id
+    except Exception:
+        pass
+    return None, None
+
+
+class TraceFormatter(logging.Formatter):
+    """The plain formatter plus a ``[trace=…]`` suffix whenever a span
+    context is active on the logging thread."""
+
+    def format(self, record):
+        s = super().format(record)
+        trace_id, span_id = _trace_ids()
+        if trace_id is not None:
+            s += " [trace=%s span=%s]" % (trace_id, span_id)
+        return s
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record (``MXNET_LOG_JSON=1``), stamped with
+    the active trace/span ids so logs and traces correlate."""
+
+    def format(self, record):
+        out = {"ts": round(time.time(), 3),
+               "level": record.levelname,
+               "name": record.name,
+               "msg": record.getMessage()}
+        trace_id, span_id = _trace_ids()
+        if trace_id is not None:
+            out["trace_id"] = trace_id
+            out["span_id"] = span_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def _make_formatter():
+    try:
+        from .config import get as _cfg
+        json_mode = bool(_cfg("MXNET_LOG_JSON"))
+    except Exception:
+        json_mode = False
+    if json_mode:
+        return JsonFormatter()
+    return TraceFormatter(_FMT, _DATEFMT)
 
 
 def get_logger(name=None, filename=None, filemode=None, level=WARNING):
@@ -29,7 +92,7 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
         handler = logging.FileHandler(filename, filemode or "a")
     else:
         handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    handler.setFormatter(_make_formatter())
     logger.addHandler(handler)
     logger.setLevel(level)
     logger._mxnet_tpu_configured = True

@@ -14,6 +14,7 @@ import time
 from .. import fault as _fault
 from .. import metric as _metric
 from .. import io as _io
+from .. import tracing as _tr
 from ..base import MXNetError
 from ..initializer import Uniform
 from ..ndarray.ndarray import NDArray
@@ -306,22 +307,41 @@ class BaseModule(object):
                 while not end_of_batch:
                     data_batch = next_data_batch
                     _fault.inject("engine.step")
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                    if isinstance(data_batch, list):
-                        self.update_metric(eval_metric,
-                                           [db.label for db in data_batch],
-                                           pre_sliced=True)
-                    else:
-                        self.update_metric(eval_metric, data_batch.label)
-                    try:
-                        next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    except StopIteration:
-                        end_of_batch = True
+                    # per-step trace timeline: one root span per step
+                    # (head-sampled), with the phase split a stall
+                    # investigation needs — was the step waiting on
+                    # data, on forward-backward, or on the optimizer?
+                    with _tr.start_span("train.step",
+                                        attrs={"epoch": epoch,
+                                               "nbatch": nbatch}):
+                        if monitor is not None:
+                            monitor.tic()
+                        with _tr.child_span("train.forward_backward"):
+                            self.forward_backward(data_batch)
+                        with _tr.child_span("train.update"):
+                            self.update()
+                        if isinstance(data_batch, list):
+                            self.update_metric(
+                                eval_metric,
+                                [db.label for db in data_batch],
+                                pre_sliced=True)
+                        else:
+                            self.update_metric(eval_metric,
+                                               data_batch.label)
+                        fetched = None
+                        with _tr.child_span("train.data_wait"):
+                            try:
+                                fetched = next(data_iter)
+                            except StopIteration:
+                                end_of_batch = True
+                        if fetched is not None:
+                            next_data_batch = fetched
+                            try:
+                                self.prepare(
+                                    next_data_batch,
+                                    sparse_row_id_fn=sparse_row_id_fn)
+                            except StopIteration:
+                                end_of_batch = True
                     if monitor is not None:
                         monitor.toc_print()
                     if end_of_batch:
@@ -388,21 +408,23 @@ class BaseModule(object):
         + manifest (epoch/batch position, RNG state). Numbered by
         completed epochs; a mid-epoch save reuses the epoch number with
         ``nbatch`` > 0 and supersedes that epoch's boundary save."""
-        saver = getattr(self, "save_checkpoint", None)
-        if saver is not None:
-            saver(prefix, epoch, save_optimizer_states, nbatch=nbatch)
-            return
-        # modules without a save_checkpoint of their own (Sequential,
-        # Python): params + manifest through the model-level writer
-        from ..model import save_checkpoint as _model_save
-        arg_p, aux_p = self.get_params()
-        states = None
-        if save_optimizer_states and self.optimizer_initialized and \
-                hasattr(self, "save_optimizer_states"):
-            states = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(states)
-        _model_save(prefix, epoch, self._symbol, arg_p, aux_p,
-                    nbatch=nbatch, states_fname=states)
+        with _tr.start_span("train.checkpoint",
+                            attrs={"epoch": epoch, "nbatch": nbatch}):
+            saver = getattr(self, "save_checkpoint", None)
+            if saver is not None:
+                saver(prefix, epoch, save_optimizer_states, nbatch=nbatch)
+                return
+            # modules without a save_checkpoint of their own (Sequential,
+            # Python): params + manifest through the model-level writer
+            from ..model import save_checkpoint as _model_save
+            arg_p, aux_p = self.get_params()
+            states = None
+            if save_optimizer_states and self.optimizer_initialized and \
+                    hasattr(self, "save_optimizer_states"):
+                states = "%s-%04d.states" % (prefix, epoch)
+                self.save_optimizer_states(states)
+            _model_save(prefix, epoch, self._symbol, arg_p, aux_p,
+                        nbatch=nbatch, states_fname=states)
 
     # -- properties --------------------------------------------------------
     @property

@@ -256,6 +256,14 @@ def dump(finished=True, filename=None, profile_process="worker"):
     path = filename or _config["filename"]
     with _events_lock:
         events = list(_events)
+    try:
+        # merge the span tracer's retained traces onto the same time
+        # base, so request/step timelines, per-op events, and the
+        # bridged telemetry gauges land in ONE chrome trace
+        from . import tracing as _tracing
+        events = events + _tracing.chrome_events()
+    except Exception:
+        pass
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return path

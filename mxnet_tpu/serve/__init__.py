@@ -33,11 +33,12 @@ Tuning and architecture: docs/serving.md. Knobs: ``MXNET_SERVE_*``
 from .batching import (pad_axis0, parse_buckets, pick_bucket,
                        power_of_two_buckets, unpad_axis0)
 from .engine import (DeadlineExceededError, EngineClosedError,
-                     InferenceEngine, QueueFullError, ServeConfig)
+                     InferenceEngine, QueueFullError, ServeConfig,
+                     engines_status)
 from .http import ServeHTTPServer, serve_http
 from .registry import ModelRegistry
 
 __all__ = ["InferenceEngine", "ServeConfig", "ModelRegistry", "serve_http",
            "ServeHTTPServer", "QueueFullError", "DeadlineExceededError",
-           "EngineClosedError", "power_of_two_buckets", "parse_buckets",
-           "pick_bucket", "pad_axis0", "unpad_axis0"]
+           "EngineClosedError", "engines_status", "power_of_two_buckets",
+           "parse_buckets", "pick_bucket", "pad_axis0", "unpad_axis0"]

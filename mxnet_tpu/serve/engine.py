@@ -33,6 +33,7 @@ split, and ``serving/{rejected,timeouts}_total`` counters.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import deque
 
 import numpy as _np
@@ -40,10 +41,37 @@ import numpy as _np
 from .. import fault as _fault
 from ..base import MXNetError
 from .. import telemetry as _tm
+from .. import tracing as _tr
 from .batching import parse_buckets, pick_bucket
 
 __all__ = ["ServeConfig", "InferenceEngine", "QueueFullError",
-           "DeadlineExceededError", "EngineClosedError"]
+           "DeadlineExceededError", "EngineClosedError", "engines_status"]
+
+# live engines of this process, for mxnet_tpu.diagnostics(): serve queue
+# depth + worker liveness belong in a support-ticket snapshot
+_ENGINES = weakref.WeakSet()
+
+
+def engines_status():
+    """One status row per live InferenceEngine (queue depth, worker
+    liveness, restart-budget burn) — surfaced by
+    ``mxnet_tpu.diagnostics()``."""
+    out = []
+    for eng in list(_ENGINES):
+        if not eng._accepting and not eng._workers:
+            # cleanly closed (close()'s own already-closed test), just
+            # not GC'd yet — noise in a support snapshot, unlike a
+            # draining or dead-crew engine which must stay visible
+            continue
+        out.append({
+            "ready": eng.ready,
+            "accepting": eng._accepting,
+            "queue_depth": len(eng._queue),
+            "workers": len(eng._workers),
+            "workers_alive": sum(t.is_alive() for t in eng._workers),
+            "restarts_used": eng._restarts_used,
+            "buckets": list(eng._cfg.buckets)})
+    return out
 
 
 class QueueFullError(MXNetError):
@@ -101,9 +129,9 @@ class _Request(object):
     """One submitted inference request; a thread-event future."""
 
     __slots__ = ("feed", "rows", "deadline", "t_enq", "_event", "outputs",
-                 "error", "_tc_lock", "_timeout_counted")
+                 "error", "_tc_lock", "_timeout_counted", "tctx")
 
-    def __init__(self, feed, rows, deadline):
+    def __init__(self, feed, rows, deadline, tctx=None):
         self.feed = feed
         self.rows = rows
         self.deadline = deadline
@@ -113,6 +141,9 @@ class _Request(object):
         self.error = None
         self._tc_lock = threading.Lock()
         self._timeout_counted = False
+        # span context carried across the queue (explicit handoff: the
+        # worker thread has no view of the submitter's contextvars)
+        self.tctx = tctx
 
     def _count_timeout(self):
         """Bump serving/timeouts_total ONCE per request, whether the
@@ -124,6 +155,12 @@ class _Request(object):
             self._timeout_counted = True
         _tm.counter("serving/timeouts_total",
                     "Requests failed on deadline expiry").inc()
+        # a timed-out trace is always worth keeping as an exemplar
+        # (only THIS request's trace: an untraced request must not
+        # flag whatever ambient span the waiting thread happens to
+        # be under via mark_error's active() fallback)
+        if self.tctx is not None:
+            _tr.mark_error("deadline exceeded", ctx=self.tctx)
 
     def set_result(self, outputs):
         self.outputs = outputs
@@ -193,6 +230,7 @@ class InferenceEngine(object):
         self._ready = False
         self._workers = []
         self._restarts_used = 0
+        _ENGINES.add(self)
 
         self._m_requests = _tm.counter(
             "serving/requests_total", "Inference requests accepted")
@@ -296,7 +334,7 @@ class InferenceEngine(object):
         self._ready = False
 
     # -- request path ------------------------------------------------------
-    def submit(self, feed, timeout_ms=None):
+    def submit(self, feed, timeout_ms=None, ctx=None):
         """Enqueue one request; returns its future (:class:`_Request`).
 
         ``feed``: ``{input_name: array-like}`` with every input carrying
@@ -304,6 +342,10 @@ class InferenceEngine(object):
         :class:`QueueFullError` immediately when the queue is at depth
         (admission control — never unbounded latency) and
         :class:`EngineClosedError` when draining/closed.
+
+        ``ctx``: optional :class:`tracing.SpanContext` the batch worker
+        parents its spans under (the HTTP frontend passes its request
+        root); defaults to the caller's active context.
 
         Requests submitted before :meth:`start` queue up and are served
         once the workers spawn (deliberate: fill-then-start); on an
@@ -314,7 +356,8 @@ class InferenceEngine(object):
         timeout = (self._cfg.default_timeout if timeout_ms is None
                    else float(timeout_ms) / 1e3)
         deadline = (_tm.monotonic() + timeout) if timeout > 0 else None
-        req = _Request(feed, rows, deadline)
+        req = _Request(feed, rows, deadline,
+                       tctx=ctx if ctx is not None else _tr.active())
         with self._cond:
             if not self._accepting:
                 self._m_rejected.inc()
@@ -370,12 +413,15 @@ class InferenceEngine(object):
     def _take_batch(self):
         """Pop a coalesced FIFO run of requests totalling at most
         ``max_batch`` rows, waiting up to ``batch_wait`` after the first
-        arrival for more to coalesce. None = engine closed and empty."""
+        arrival for more to coalesce. None = engine closed and empty;
+        otherwise ``(batch, t_coalesce0, t_coalesce1)`` — the window
+        bounds feed the ``serve.coalesce`` trace span."""
         with self._cond:
             while not self._queue:
                 if not self._accepting:
                     return None
                 self._cond.wait(0.1)
+            t_co0 = _tm.monotonic()
             batch = [self._queue.popleft()]
             rows = batch[0].rows
 
@@ -402,7 +448,7 @@ class InferenceEngine(object):
             self._m_depth.set(len(self._queue))
             if self._queue:
                 self._cond.notify()      # more work for another worker
-        return batch
+        return batch, t_co0, _tm.monotonic()
 
     def _worker_main(self):
         """Worker thread entry: run the loop, and when it CRASHES (an
@@ -438,11 +484,12 @@ class InferenceEngine(object):
     def _worker_loop(self):
         while True:
             _fault.inject("serve.worker")
-            batch = self._take_batch()
-            if batch is None:
+            taken = self._take_batch()
+            if taken is None:
                 return
+            batch, t_co0, t_co1 = taken
             try:
-                self._run_batch(batch)
+                self._run_batch(batch, t_co0, t_co1)
             except Exception as exc:     # never let the worker die: fail
                 err = MXNetError(        # the batch, keep serving
                     "batch processing failed: %s" % exc)
@@ -450,12 +497,17 @@ class InferenceEngine(object):
                     if not req._event.is_set():
                         req.set_error(err)
 
-    def _run_batch(self, batch):
+    def _run_batch(self, batch, t_co0=None, t_co1=None):
         now = _tm.monotonic()
         live = []
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 req._count_timeout()
+                if req.tctx is not None and req.tctx.sampled:
+                    # the retained 504 exemplar is exactly the trace
+                    # that needs its breakdown: all its time was queue
+                    _tr.record_span("serve.queue_wait", req.tctx,
+                                    req.t_enq, now)
                 req.set_error(DeadlineExceededError(
                     "deadline expired after %.0f ms in queue"
                     % ((now - req.t_enq) * 1e3)))
@@ -465,6 +517,8 @@ class InferenceEngine(object):
             return
         rows = sum(r.rows for r in live)
         bucket = pick_bucket(rows, self._cfg.buckets)
+        traced = [r for r in live if r.tctx is not None and r.tctx.sampled]
+        t_pad0 = _tm.monotonic()
         if len(live) == 1 and live[0].rows == bucket:
             feed = live[0].feed          # exact fit: zero host copies
         else:
@@ -479,16 +533,30 @@ class InferenceEngine(object):
                     buf[offset:offset + r.rows] = r.feed[k]
                     offset += r.rows
                 feed[k] = buf
+        t_pad1 = _tm.monotonic()
 
+        # the batch is ONE unit of work fanning in N request parents:
+        # its spans get one shared id each, recorded into every
+        # participating trace. Nested executor spans adopt the batch
+        # leader's context (first traced request).
+        batch_sid = _tr.new_span_id() if traced else None
+        comp_sid = _tr.new_span_id() if traced else None
+        leader = traced[0].tctx if traced else None
+        # nested executor spans adopt the leader's trace, parented under
+        # the (to-be-recorded) serve.compute span
+        compute_ctx = (leader.child_of(comp_sid)
+                       if leader is not None else None)
         t0 = _tm.monotonic()
         try:
             pred = self._bucket_pred(bucket)
             with self._pred_locks[bucket]:
-                outs = pred._exe.forward(is_train=False, **feed)
-                outs_np = [o.asnumpy() for o in outs]
+                with _tr.use_context(compute_ctx):
+                    outs = pred._exe.forward(is_train=False, **feed)
+                    outs_np = [o.asnumpy() for o in outs]
         except Exception as exc:          # surface, don't kill the worker
             err = MXNetError("batch execution failed: %s" % exc)
             for req in live:
+                _tr.mark_error(err, ctx=req.tctx)
                 req.set_error(err)
             return
         t1 = _tm.monotonic()
@@ -496,20 +564,53 @@ class InferenceEngine(object):
         self._m_batches.inc()
         self._m_batch_rows.observe(rows)
         self._m_waste.observe((bucket - rows) / float(bucket))
-        self._m_compute.observe(t1 - t0)
+        self._m_compute.observe(
+            t1 - t0, trace_id=leader.trace_id if leader else None)
         exact_fit = len(live) == 1 and live[0].rows == outs_np[0].shape[0]
         offset = 0
+        results = []
+        t_slice0 = _tm.monotonic()
         for req in live:
             if exact_fit:
-                req.set_result(outs_np)
+                results.append(outs_np)
             else:
                 # copy the rows out: a view would pin the whole padded
                 # bucket output for the lifetime of each request future
-                req.set_result([o[offset:offset + req.rows].copy()
+                results.append([o[offset:offset + req.rows].copy()
                                 for o in outs_np])
-            self._m_qwait.observe(t0 - req.t_enq)
-            self._m_latency.observe(t1 - req.t_enq)
             offset += req.rows
+        t_slice1 = _tm.monotonic()
+
+        if traced:
+            # record spans BEFORE delivering results: the submitter's
+            # root span may close the trace the instant result() returns
+            pad_sid = _tr.new_span_id()
+            slice_sid = _tr.new_span_id()
+            co_sid = _tr.new_span_id() if t_co0 is not None else None
+            battrs = {"rows": rows, "bucket": bucket, "fanin": len(live)}
+            for req in traced:
+                ctx = req.tctx
+                _tr.record_span("serve.queue_wait", ctx, req.t_enq, now)
+                _tr.record_span("serve.batch", ctx, t_co0 or t_pad0,
+                                t_slice1, span_id=batch_sid,
+                                parent_id=ctx.span_id, attrs=battrs)
+                if co_sid is not None:
+                    _tr.record_span("serve.coalesce", ctx, t_co0, t_co1,
+                                    span_id=co_sid, parent_id=batch_sid)
+                _tr.record_span("serve.pad", ctx, t_pad0, t_pad1,
+                                span_id=pad_sid, parent_id=batch_sid)
+                _tr.record_span("serve.compute", ctx, t0, t1,
+                                span_id=comp_sid, parent_id=batch_sid,
+                                attrs={"bucket": bucket})
+                _tr.record_span("serve.slice", ctx, t_slice0, t_slice1,
+                                span_id=slice_sid, parent_id=batch_sid)
+
+        for req, res in zip(live, results):
+            req.set_result(res)
+            self._m_qwait.observe(t0 - req.t_enq)
+            self._m_latency.observe(
+                t1 - req.t_enq,
+                trace_id=req.tctx.trace_id if req.tctx else None)
 
     # -- bucket executors --------------------------------------------------
     def _bucket_pred(self, bucket):

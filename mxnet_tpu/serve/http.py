@@ -30,13 +30,20 @@ or, for single-input models, the bare array ``{"data": [[...], ...]}``
 from __future__ import annotations
 
 import json
+import re
 import threading
 
 from ..base import MXNetError
 from .. import telemetry as _tm
+from .. import tracing as _tr
 from .engine import DeadlineExceededError, EngineClosedError, QueueFullError
 
 __all__ = ["serve_http", "ServeHTTPServer"]
+
+# accepted X-Request-Id shape; anything else gets a fresh id (the
+# header is echoed verbatim into responses and trace ids — never let a
+# client smuggle header-splitting bytes through it)
+_REQ_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
 
 
 class ServeHTTPServer(object):
@@ -99,6 +106,7 @@ def serve_http(target, port=0, addr="127.0.0.1"):
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        _rid = None
 
         def _reply(self, code, payload, ctype="application/json",
                    headers=()):
@@ -107,13 +115,18 @@ def serve_http(target, port=0, addr="127.0.0.1"):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if self._rid is not None:
+                # every outcome — 200, 503, 504, 400 — echoes the
+                # request id, so a client log line links to /traces
+                self.send_header("X-Request-Id", self._rid)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
-            path = self.path.split("?")[0]
+            self._rid = None             # keep-alive: no stale echo
+            path, _, query = self.path.partition("?")
             if path == "/metrics":
                 self._reply(200, _tm.render_prometheus().encode(),
                             ctype="text/plain; version=0.0.4; "
@@ -125,10 +138,14 @@ def serve_http(target, port=0, addr="127.0.0.1"):
                 else:
                     self._reply(503, b"warming\n",
                                 ctype="text/plain; charset=utf-8")
+            elif path == "/traces":
+                code, payload = _tr.traces_endpoint(query)
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": "not found"})
 
         def do_POST(self):
+            self._rid = None             # keep-alive: no stale echo
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)   # always drain: HTTP/1.1
             if self.path.split("?")[0] != "/predict":
@@ -136,29 +153,49 @@ def serve_http(target, port=0, addr="127.0.0.1"):
                 # parsed as the next request line
                 self._reply(404, {"error": "not found"})
                 return
+            # accept the caller's X-Request-Id as the trace id (echoed
+            # either way, sampled or not); mint one otherwise
+            rid = self.headers.get("X-Request-Id", "")
+            if not _REQ_ID_RE.match(rid):
+                rid = _tr.new_trace_id()
+            self._rid = rid
+            with _tr.start_span("http.request", trace_id=rid,
+                                attrs={"path": "/predict"}) as span:
+                self._predict(body, span)
+
+        def _predict(self, body, span):
             try:
                 feed, timeout_ms = _parse_body(target, body)
-                req = target.submit(feed, timeout_ms)
+                req = target.submit(feed, timeout_ms, ctx=span.ctx)
             except (QueueFullError, EngineClosedError) as e:
+                span.set_attr("http_status", 503)
+                _tr.mark_error(e, ctx=span.ctx)
                 self._reply(503, {"error": str(e)},
                             headers=(("Retry-After", "1"),))
                 return
             except (MXNetError, ValueError, TypeError) as e:
                 # ValueError/TypeError cover np.asarray on ragged input
                 # and a non-numeric timeout_ms — still a client error
+                span.set_attr("http_status", 400)
                 self._reply(400, {"error": str(e)})
                 return
 
             try:
                 outputs = req.result()
             except DeadlineExceededError as e:
+                span.set_attr("http_status", 504)
+                _tr.mark_error(e, ctx=span.ctx)
                 self._reply(504, {"error": str(e)})
                 return
             except EngineClosedError as e:
+                span.set_attr("http_status", 503)
+                _tr.mark_error(e, ctx=span.ctx)
                 self._reply(503, {"error": str(e)},
                             headers=(("Retry-After", "1"),))
                 return
             except MXNetError as e:
+                span.set_attr("http_status", 500)
+                _tr.mark_error(e, ctx=span.ctx)
                 self._reply(500, {"error": str(e)})
                 return
             try:
@@ -169,9 +206,11 @@ def serve_http(target, port=0, addr="127.0.0.1"):
                     {"outputs": [o.tolist() for o in outputs],
                      "rows": req.rows}, allow_nan=False).encode() + b"\n"
             except ValueError:
+                span.set_attr("http_status", 500)
                 self._reply(500, {"error": "model output contains "
                                            "non-finite values"})
                 return
+            span.set_attr("rows", req.rows)
             self._reply(200, body)
 
         def log_message(self, *args):    # no stderr chatter per request

@@ -64,14 +64,14 @@ class ModelRegistry(object):
         self.engine().warmup()
         return self
 
-    def submit(self, feed, timeout_ms=None):
+    def submit(self, feed, timeout_ms=None, ctx=None):
         """Engine submit that is safe across a concurrent swap: a
         request refused because ITS engine started draining re-routes
         to the replacement instead of surfacing a 503."""
         while True:
             eng = self.engine()
             try:
-                return eng.submit(feed, timeout_ms)
+                return eng.submit(feed, timeout_ms, ctx=ctx)
             except EngineClosedError:
                 if self.engine() is eng:     # closed for real, no swap
                     raise

@@ -47,7 +47,7 @@ __all__ = ["Registry", "Counter", "Gauge", "Histogram", "REGISTRY",
            "counter", "gauge", "histogram", "enable", "enabled",
            "render_prometheus", "serve", "TelemetryServer",
            "bridge_to_profiler", "snapshot", "diagnostics", "reset",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "exemplars", "DEFAULT_LATENCY_BUCKETS"]
 
 # Fixed log-scale latency buckets (seconds): 1-2.5-5 per decade from
 # 10us to 10s — op dispatch sits in the left decades, XLA compiles and
@@ -57,6 +57,10 @@ DEFAULT_LATENCY_BUCKETS = (
     1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 monotonic = time.perf_counter
+
+# a histogram's worst-case exemplar decays after this long, so "worst
+# recent" tracks the current regime rather than a cold-start outlier
+EXEMPLAR_WINDOW_S = 300.0
 
 
 # ---------------------------------------------------------------------------
@@ -115,9 +119,15 @@ class Gauge(object):
 
 
 class Histogram(object):
-    """Cumulative histogram over fixed upper bounds (+Inf implicit)."""
+    """Cumulative histogram over fixed upper bounds (+Inf implicit).
 
-    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+    ``observe(value, trace_id=...)`` additionally keeps a worst-recent
+    exemplar — the trace id of the largest observation in the last
+    ``EXEMPLAR_WINDOW_S`` seconds — so a /metrics p99 links to a
+    concrete /traces timeline."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock",
+                 "_worst_v", "_worst_id", "_worst_t")
 
     def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS):
         self.buckets = tuple(sorted(buckets))
@@ -125,13 +135,40 @@ class Histogram(object):
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self._worst_v = None
+        self._worst_id = None
+        self._worst_t = 0.0
 
-    def observe(self, value):
+    def observe(self, value, trace_id=None):
         i = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if trace_id is not None:
+                now = monotonic()
+                if (self._worst_v is None or value >= self._worst_v
+                        or now - self._worst_t > EXEMPLAR_WINDOW_S):
+                    self._worst_v = value
+                    self._worst_id = trace_id
+                    self._worst_t = now
+
+    def exemplar(self):
+        """(value, trace_id, age_seconds) of the worst recent traced
+        observation, or None when nothing traced was observed within
+        the decay window — a frozen exemplar from before traffic went
+        idle (or sampling was turned off) would point an operator at a
+        long-evicted timeline presented as current."""
+        with self._lock:
+            if self._worst_id is None:
+                return None
+            age = monotonic() - self._worst_t
+            if age > EXEMPLAR_WINDOW_S:
+                self._worst_v = None
+                self._worst_id = None
+                self._worst_t = 0.0
+                return None
+            return (self._worst_v, self._worst_id, age)
 
     @property
     def count(self):
@@ -169,16 +206,21 @@ class Family(object):
         self._lock = threading.Lock()
         self._bridged = False
 
+    def _label_suffix(self, labelvalues):
+        """``{name=value,...}`` series-key suffix ("" when unlabeled) —
+        the one spelling shared by snapshot(), exemplars() and the
+        chrome-trace bridge."""
+        if not labelvalues:
+            return ""
+        return "{%s}" % ",".join(
+            "%s=%s" % kv for kv in zip(self.labelnames, labelvalues))
+
     def _bridge_name_for(self, labelvalues):
         """Chrome-trace counter name for a bridged gauge child (None
         when this family is not bridged)."""
         if not self._bridged:
             return None
-        name = prom_name(self.name)
-        if labelvalues:
-            name += "{%s}" % ",".join(
-                "%s=%s" % kv for kv in zip(self.labelnames, labelvalues))
-        return name
+        return prom_name(self.name) + self._label_suffix(labelvalues)
 
     def _make(self, labelvalues):
         if self.kind == "counter":
@@ -217,8 +259,8 @@ class Family(object):
     def dec(self, amount=1):
         self._default().dec(amount)
 
-    def observe(self, value):
-        self._default().observe(value)
+    def observe(self, value, trace_id=None):
+        self._default().observe(value, trace_id=trace_id)
 
     @property
     def value(self):
@@ -305,11 +347,7 @@ class Registry(object):
         out = {}
         for fam in self.families():
             for labelvalues, child in fam.series():
-                key = fam.name
-                if labelvalues:
-                    key += "{%s}" % ",".join(
-                        "%s=%s" % kv for kv in zip(fam.labelnames,
-                                                   labelvalues))
+                key = fam.name + fam._label_suffix(labelvalues)
                 if fam.kind == "histogram":
                     out[key] = {"count": child.count,
                                 "sum": round(child.sum, 6)}
@@ -458,8 +496,23 @@ def _on_jax_event(name, secs, **_kw):
             _compile_time += secs
         counter("jit/backend_compile_total",
                 "XLA backend compiles (all layers)").inc()
-        histogram("jit/backend_compile_seconds",
-                  "XLA backend compile latency").observe(secs)
+        hist = histogram("jit/backend_compile_seconds",
+                         "XLA backend compile latency")
+        try:
+            # the listener fires on the compiling thread, so the active
+            # trace context (if any) is the dispatch that triggered the
+            # compile: attribute the compile to that timeline
+            from . import tracing as _tr
+            ctx = _tr.active()
+            if ctx is not None:
+                now = monotonic()
+                _tr.record_span("executor.compile", ctx, now - secs, now,
+                                {"seconds": round(secs, 4)})
+                hist.observe(secs, trace_id=ctx.trace_id)
+                return
+        except Exception:
+            pass
+        hist.observe(secs)
 
 
 _listener_dead = False      # jax.monitoring unavailable: stop retrying
@@ -535,7 +588,7 @@ def dispatch_end(name, token):
     _hitmiss[_compile_count > token[1]].inc()
 
 
-def record_kvstore(op, dt, nbytes):
+def record_kvstore(op, dt, nbytes, trace_id=None):
     trip = _kv_cache.get(op)
     if trip is None:
         trip = (counter("kvstore/ops_total", "KVStore calls",
@@ -547,9 +600,28 @@ def record_kvstore(op, dt, nbytes):
         _kv_cache[op] = trip
     trip[0].inc()
     if dt is not None:
-        trip[1].observe(dt)
+        trip[1].observe(dt, trace_id=trace_id)
     if nbytes:
         trip[2].inc(int(nbytes))
+
+
+def exemplars():
+    """Worst-recent trace exemplars of every latency histogram:
+    {"name{labels}": {"seconds", "trace_id", "age_s"}}. Rendered by the
+    /traces endpoint so a scraped p99 links to a concrete timeline (the
+    0.0.4 text format has no exemplar syntax, so they ride here)."""
+    out = {}
+    for fam in REGISTRY.families():
+        if fam.kind != "histogram":
+            continue
+        for labelvalues, child in fam.series():
+            ex = child.exemplar()
+            if ex is None:
+                continue
+            key = fam.name + fam._label_suffix(labelvalues)
+            out[key] = {"seconds": round(ex[0], 6), "trace_id": ex[1],
+                        "age_s": round(ex[2], 1)}
+    return out
 
 
 def record_hbm(device, bytes_in_use, peak_bytes=None):
@@ -599,17 +671,23 @@ def serve(port=0, addr="127.0.0.1", registry=None):
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            path = self.path.split("?")[0]
+            path, _, query = self.path.partition("?")
+            code = 200
             if path == "/metrics":
                 body = reg.render_prometheus().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/healthz":
                 body = b"ok\n"
                 ctype = "text/plain; charset=utf-8"
+            elif path == "/traces":
+                from . import tracing as _tr
+                code, payload = _tr.traces_endpoint(query)
+                body = json.dumps(payload).encode() + b"\n"
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
-            self.send_response(200)
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -755,6 +833,30 @@ def diagnostics(as_dict=False):
     info["profiler_running"] = profiler.is_running()
     info["telemetry_enabled"] = _enabled
     info["telemetry"] = snapshot()
+    try:
+        # support-ticket snapshot: where did recent slow/errored
+        # requests or steps spend their time, and is the serving path
+        # alive right now
+        from . import tracing as _tr
+        info["tracing_enabled"] = _tr.enabled()
+        info["recent_slow_traces"] = [
+            {"trace_id": t["trace_id"], "root": t["root"],
+             "duration_ms": t["duration_ms"], "error": t["error"],
+             "phases": t["phases"]}
+            for t in _tr.slow_traces(limit=5)]
+        ex = exemplars()
+        if ex:
+            info["latency_exemplars"] = ex
+    except Exception:
+        pass
+    eng_mod = sys.modules.get("mxnet_tpu.serve.engine")
+    if eng_mod is not None:
+        try:
+            status = eng_mod.engines_status()
+            if status:
+                info["serve_engines"] = status
+        except Exception:
+            pass
     try:
         from .config import VARS, get
         # bug reports get pasted into public issues: never include live

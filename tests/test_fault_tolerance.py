@@ -553,6 +553,82 @@ def test_kv_server_dedups_replayed_push():
         server.stop()
 
 
+def test_kv_server_replay_span_cached_no_metric_recount():
+    """Regression (ISSUE 5 bugfix): an RPC replay served from the
+    at-most-once seq-cache must NOT double-count observability — the
+    server's handler-latency histogram is not re-recorded, the replay's
+    span is marked cached=true, and the original execution's spans are
+    re-shipped with unchanged ids (so a client graft deduplicates
+    them)."""
+    from mxnet_tpu.kvstore_server import (KVStoreServer, recv_msg,
+                                          send_msg)
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    tctx = {"trace_id": "t" * 32, "span_id": "c" * 16, "sampled": True}
+
+    def handle_count():
+        fam = tm.REGISTRY._families.get("kvstore/server_handle_seconds")
+        if fam is None:
+            return 0
+        return sum(c.count for lv, c in fam.series() if lv == ("PUSH",))
+
+    s = socket.socket()
+    try:
+        s.connect(("127.0.0.1", server.port))
+        send_msg(s, ("HELLO", None, 0))
+        assert recv_msg(s)[0] == "OK"
+        send_msg(s, ("INIT", "w", np.zeros((2,), np.float32), 1, tctx))
+        assert recv_msg(s)[0] == "OK"
+        n0 = handle_count()
+        send_msg(s, ("PUSH", "w", np.full((2,), 3.0, np.float32), 2,
+                     tctx))
+        first = recv_msg(s)
+        assert first[0] == "OK"
+        assert len(first) > 2 and first[2], "no server spans shipped"
+        tok1, now1, spans1 = first[2]
+        assert isinstance(now1, float) and isinstance(tok1, str)
+        real = [sp for sp in spans1 if sp["name"] == "kv.server"]
+        assert len(real) == 1
+        assert not real[0]["attrs"].get("cached")
+        assert handle_count() == n0 + 1
+
+        # replay the SAME seq: cached response, cached span, and the
+        # handler-latency histogram must NOT move
+        send_msg(s, ("PUSH", "w", np.full((2,), 99.0, np.float32), 2,
+                     tctx))
+        second = recv_msg(s)
+        assert second[0] == "OK"
+        assert handle_count() == n0 + 1, \
+            "seq-cache replay re-recorded handler latency"
+        _tok, _now, spans2 = second[2]
+        cached = [sp for sp in spans2
+                  if sp["name"] == "kv.server"
+                  and sp["attrs"].get("cached")]
+        assert len(cached) == 1
+        assert cached[0]["attrs"]["op"] == "PUSH"
+        # the original execution span is re-shipped with the SAME id:
+        # grafting both responses cannot double-count it
+        originals = [sp for sp in spans2
+                     if sp["name"] == "kv.server"
+                     and not sp["attrs"].get("cached")]
+        assert len(originals) == 1
+        assert originals[0]["span_id"] == real[0]["span_id"]
+        from mxnet_tpu import tracing as tr
+        buf = tr._TraceBuf()
+        buf.extend(spans1)
+        buf.extend(spans2)
+        ids = [sp["span_id"] for sp in buf.spans]
+        assert len(ids) == len(set(ids))
+        # value still applied exactly once
+        send_msg(s, ("PULL", "w", None))
+        resp = recv_msg(s)
+        assert resp[0] == "OK"
+        np.testing.assert_allclose(resp[1], np.full((2,), 3.0))
+    finally:
+        s.close()
+        server.stop()
+
+
 # ---------------------------------------------------------------------------
 # fault harness itself
 # ---------------------------------------------------------------------------

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Static check: metric and span names vs docs/observability.md.
+
+Every metric family registered with a string literal
+(``telemetry.counter/gauge/histogram("name", ...)``) and every span
+name opened with a literal (``tracing.start_span/child_span/
+record_span("name", ...)``) anywhere under ``mxnet_tpu/`` must appear
+in docs/observability.md — and every name listed in that doc's metric
+and span tables must still exist in the code. Fails listing the
+missing names on either side, so the observability surface and its
+documentation cannot silently drift (the same contract fault.POINTS
+enforces for injection points).
+
+Run directly (CI) or via tests/test_tracing.py::test_metrics_docs_in_sync.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "mxnet_tpu")
+DOC = os.path.join(ROOT, "docs", "observability.md")
+
+_METRIC_CALLS = {"counter", "gauge", "histogram"}
+_SPAN_CALLS = {"start_span", "child_span", "record_span"}
+_METRIC_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+$")
+_SPAN_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+
+
+def _call_name(node):
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def collect_code_names():
+    """(metric_names, span_names) registered via string literals under
+    mxnet_tpu/."""
+    metrics, spans = set(), set()
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    raise SystemExit("cannot parse %s: %s" % (path, e))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                arg0 = node.args[0]
+                if not (isinstance(arg0, ast.Constant)
+                        and isinstance(arg0.value, str)):
+                    continue
+                name = _call_name(node)
+                if name in _METRIC_CALLS and _METRIC_RE.match(arg0.value):
+                    metrics.add(arg0.value)
+                elif name in _SPAN_CALLS and _SPAN_RE.match(arg0.value):
+                    spans.add(arg0.value)
+    return metrics, spans
+
+
+def collect_doc_names():
+    """(metric_names, span_names) from the first cell of every table
+    row in docs/observability.md. One cell may list several backticked
+    names."""
+    metrics, spans = set(), set()
+    with open(DOC, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 2:
+                continue
+            for tok in re.findall(r"`([^`]+)`", cells[1]):
+                tok = tok.strip()
+                if tok.startswith("mxnet_tpu."):
+                    continue             # module path, not a span name
+                if _METRIC_RE.match(tok):
+                    metrics.add(tok)
+                elif _SPAN_RE.match(tok):
+                    spans.add(tok)
+    return metrics, spans
+
+
+def check():
+    """Returns a dict of the four possible drift directions; all empty
+    means code and docs agree."""
+    code_m, code_s = collect_code_names()
+    doc_m, doc_s = collect_doc_names()
+    return {
+        "metrics_undocumented": sorted(code_m - doc_m),
+        "metrics_stale_in_docs": sorted(doc_m - code_m),
+        "spans_undocumented": sorted(code_s - doc_s),
+        "spans_stale_in_docs": sorted(doc_s - code_s),
+    }
+
+
+def main():
+    drift = check()
+    ok = True
+    for kind, names in sorted(drift.items()):
+        if names:
+            ok = False
+            print("%s (%d):" % (kind, len(names)))
+            for n in names:
+                print("  - %s" % n)
+    if not ok:
+        print("\ndocs/observability.md and the registered metric/span "
+              "name literals under mxnet_tpu/ are out of sync "
+              "(undocumented = add a table row; stale = the doc names "
+              "something the code no longer registers).")
+        return 1
+    code_m, code_s = collect_code_names()
+    print("ok: %d metrics and %d spans in sync with "
+          "docs/observability.md" % (len(code_m), len(code_s)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
