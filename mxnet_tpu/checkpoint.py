@@ -230,7 +230,11 @@ def list_checkpoints(prefix):
 ResumeState = namedtuple(
     "ResumeState",
     ["epoch", "nbatch", "symbol", "arg_params", "aux_params",
-     "states_fname", "rng", "prefix"])
+     "states_fname", "rng", "prefix", "io_cursor"])
+# io_cursor: the resumable shard cursor a seekable data iterator
+# (NDArrayIter / DataPipeline) wrote into the manifest — fit's
+# resume=True seeks the iterator there instead of replaying the epoch.
+ResumeState.__new__.__defaults__ = (None,)
 
 
 def load_latest_valid(prefix, ctx=None):
@@ -294,7 +298,8 @@ def load_latest_valid(prefix, ctx=None):
                 symbol=symbol, arg_params=arg_params,
                 aux_params=aux_params,
                 states_fname=states if has_states else None,
-                rng=man.get("rng") if man else None, prefix=prefix)
+                rng=man.get("rng") if man else None, prefix=prefix,
+                io_cursor=man.get("io_cursor") if man else None)
         except (CheckpointCorruptError, MXNetError, OSError) as e:
             fell_back = True
             errors.append("epoch %d: %s" % (epoch, e))

@@ -165,15 +165,37 @@ VARS = {
                            "(kinds: raise/transient/delay/crash; see "
                            "mxnet_tpu/fault.py). Test-only — never set "
                            "in production."),
+    "MXNET_IO_WORKERS": (int, 0,
+                         "Decode worker processes for io.DataPipeline. "
+                         "0 = inline decode on the staging thread "
+                         "(bitwise-identical stream, no parallelism); "
+                         "-1 = host cores minus one. Production TPU VMs "
+                         "want this near the host core count."),
+    "MXNET_IO_PREFETCH": (int, 2,
+                          "Depth of the DataPipeline device staging "
+                          "buffer: how many decoded batches are "
+                          "device_put ahead of the consumer so H2D "
+                          "overlaps the previous step's compute. Also "
+                          "bounds in-flight decode (workers + prefetch) "
+                          "— the pipeline's backpressure."),
+    "MXNET_IO_WORKER_RESTARTS": (int, 4,
+                                 "Restart budget for crashed "
+                                 "DataPipeline decode workers "
+                                 "(io/worker_restarts_total counts "
+                                 "them). In-flight batches are "
+                                 "re-decoded on restart; past the "
+                                 "budget the pipeline raises instead "
+                                 "of looping a crashing worker."),
     "MXNET_DATALOADER_START_METHOD": (str, "fork",
                                       "Process start method for "
-                                      "DataLoader workers (fork/spawn/"
-                                      "forkserver). fork shares the "
-                                      "dataset copy-on-write but "
-                                      "inherits JAX's threads; use "
-                                      "spawn/forkserver if forked "
-                                      "workers crash (script then needs "
-                                      "the standard __main__ guard)."),
+                                      "DataLoader AND io.DataPipeline "
+                                      "workers (fork/spawn/forkserver). "
+                                      "fork shares the dataset/source "
+                                      "copy-on-write but inherits JAX's "
+                                      "threads; use spawn/forkserver if "
+                                      "forked workers crash (script "
+                                      "then needs the standard __main__ "
+                                      "guard)."),
 }
 
 

@@ -41,6 +41,8 @@ kv.server           entry of a kvstore-server request handler
 engine.step         start of each training step in ``BaseModule.fit``
                     (hits count across epochs)
 serve.worker        top of each serve-worker loop iteration
+io.worker           top of each input-pipeline decode task (counted
+                    per process: forked workers inherit the arming)
 ==================  ======================================================
 """
 from __future__ import annotations
@@ -81,6 +83,8 @@ POINTS = {
     "engine.step": "start of a training step in BaseModule.fit "
                    "(hit count spans epochs)",
     "serve.worker": "top of each serve-worker loop iteration",
+    "io.worker": "top of each input-pipeline decode task (DataPipeline "
+                 "worker process, or the staging thread when workers=0)",
 }
 
 _lock = threading.Lock()

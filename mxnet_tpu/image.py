@@ -482,6 +482,8 @@ class ImageIter(object):
             CreateAugmenter(data_shape, **kwargs)
         self._native = None
         self._stream_pos = 0                  # RNG key for native augs
+        self._seed = int(seed)
+        self._shuffle_epoch = -1
         if preprocess_threads and aug_list is None and dtype == "float32" \
                 and all(k in _NATIVE_AUG_KEYS or not kwargs[k]
                         for k in kwargs if k != "inter_method") \
@@ -522,16 +524,16 @@ class ImageIter(object):
             self.seq = sorted(self.imglist)
             self.path_root = path_root
         if self._num_parts > 1:
-            # distributed sharding: each worker reads a contiguous slice of
-            # the key sequence (reference: iter_image_recordio_2.cc
-            # param.num_parts/part_index chunk split)
+            # distributed sharding under the shared partition contract
+            # (io.shard_bounds: disjoint, exhaustive, bounds-checked;
+            # reference: iter_image_recordio_2.cc num_parts/part_index)
             if self.seq is None:
                 raise ValueError(
                     "num_parts>1 needs an indexed .rec (an .idx next to the "
                     ".rec) or an image list to shard")
-            n = len(self.seq)
-            lo = n * self._part_index // self._num_parts
-            hi = n * (self._part_index + 1) // self._num_parts
+            from .io import shard_bounds
+            lo, hi = shard_bounds(len(self.seq), self._num_parts,
+                                  self._part_index)
             self.seq = self.seq[lo:hi]
         self.provide_data = [DataDesc(
             "data", (batch_size,) + self.data_shape, dtype)]
@@ -544,7 +546,14 @@ class ImageIter(object):
     def reset(self):
         self.cursor = 0
         if self.shuffle and self.seq is not None:
-            _pyrandom.shuffle(self.seq)
+            # epoch shuffles come from a PRIVATE (seed, epoch)-keyed
+            # stream, not the global RNG: each shard permutes its own
+            # fixed slice reproducibly, and user random.seed() streams
+            # never interleave with input shuffling
+            from .io import mix_seed
+            self._shuffle_epoch += 1
+            _pyrandom.Random(mix_seed(self._seed, self._shuffle_epoch)
+                             ).shuffle(self.seq)
         if self.imgrec is not None and self.seq is None:
             self.imgrec.reset()
 

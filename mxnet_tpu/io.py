@@ -1,20 +1,36 @@
-"""Data iterators.
+"""Data iterators and the async input pipeline.
 
 Reference: python/mxnet/io.py (DataDesc/DataBatch/DataIter at :60-180,
 NDArrayIter :182, ResizeIter :578, PrefetchingIter :658, CSVIter via the
-C++ registry src/io/iter_csv.cc).
+C++ registry src/io/iter_csv.cc) plus the C++ multi-worker decode path
+src/io/iter_image_recordio_2.cc (num_parts/part_index sharding, OMP
+parallel ParseChunk, PrefetcherParam double buffering).
 
 TPU-native design: batches are prepared on host in NumPy (shuffle/slice/
-pad are bandwidth-trivial) and shipped to device per batch — the same
-host-side staging the reference's PrefetcherIter does, but relying on
-PjRt's async host-to-device copies instead of a dedicated prefetch
-thread. ``PrefetchingIter`` adds explicit thread-based read-ahead for
-iterators whose ``next()`` is expensive (decode-heavy pipelines).
+pad are bandwidth-trivial) and shipped to device per batch.
+``PrefetchingIter`` keeps the reference's one-deep thread double buffer;
+``DataPipeline`` is the production path — a process pool decodes
+batches in parallel (``MXNET_IO_WORKERS``), results reassemble in order
+so the batch stream is bitwise-identical for any worker count, and a
+k-deep staging buffer (``MXNET_IO_PREFETCH``) ``jax.device_put``s
+upcoming batches so H2D overlaps the previous step's compute.
+
+Sharding is a first-class iterator contract: ``num_parts`` /
+``part_index`` produce disjoint, exhaustive partitions (every record in
+exactly one part; tails land in the trailing parts), fixed at
+construction exactly like the reference C++ loader. Per-epoch shuffles
+permute WITHIN each part, drawn from a private RNG keyed by
+``(seed, epoch)`` — deterministic on every host, never touching global
+RNG state.
 """
 from __future__ import annotations
 
+import os
+import queue as _queue
+import random as _pyrandom
 import threading
-from collections import OrderedDict, namedtuple
+import time
+from collections import OrderedDict, deque, namedtuple
 
 import numpy as np
 
@@ -24,7 +40,45 @@ from . import telemetry as _tm
 from . import tracing as _tr
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
-           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "DataPipeline", "ArrayBatchSource", "RecordBatchSource",
+           "shard_bounds", "mix_seed"]
+
+
+def shard_bounds(n, num_parts, part_index):
+    """The half-open slice ``[lo, hi)`` of ``part_index`` when ``n``
+    samples split into ``num_parts`` shards. The partition contract the
+    whole input layer shares (reference: iter_image_recordio_2.cc
+    num_parts/part_index chunk split): parts are DISJOINT and
+    EXHAUSTIVE — every index lands in exactly one part — and sizes
+    differ by at most one (``n % num_parts`` trailing parts get the
+    extra sample)."""
+    num_parts = int(num_parts)
+    part_index = int(part_index)
+    if num_parts < 1:
+        raise MXNetError("num_parts must be >= 1, got %d" % num_parts)
+    if not 0 <= part_index < num_parts:
+        raise MXNetError("part_index %d out of range for num_parts %d"
+                         % (part_index, num_parts))
+    lo = n * part_index // num_parts
+    hi = n * (part_index + 1) // num_parts
+    return lo, hi
+
+
+_MASK64 = (1 << 64) - 1
+
+
+def mix_seed(*parts):
+    """Deterministically mix integers into one 63-bit seed (splitmix64
+    finalizer). Used to key per-epoch permutations and per-batch
+    augmentation RNG: stable across processes and PYTHONHASHSEED, so a
+    worker pool and the inline path draw identical streams."""
+    h = 0x9E3779B97F4A7C15
+    for p in parts:
+        h = (h ^ (int(p) & _MASK64)) * 0xBF58476D1CE4E5B9 & _MASK64
+        h = (h ^ (h >> 27)) * 0x94D049BB133111EB & _MASK64
+        h ^= h >> 31
+    return h & ((1 << 63) - 1)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -154,17 +208,46 @@ class NDArrayIter(DataIter):
     reference: ``pad`` (wrap the final short batch with leading samples,
     reporting ``pad``), ``discard``, and ``roll_over`` (carry the remainder
     to the next epoch).
+
+    Beyond the reference: ``seed`` makes epoch shuffles deterministic —
+    each epoch's permutation is drawn from a private RNG keyed by
+    ``(seed, epoch)``, never from the global NumPy RNG, so user
+    ``np.random.seed`` streams don't interleave with input shuffling and
+    a resumed run replays the exact permutation of the interrupted one
+    (:meth:`checkpoint_state` / :meth:`restore_state`).
+    ``num_parts``/``part_index`` shard the arrays into disjoint,
+    exhaustive partitions (see :func:`shard_bounds`).
     """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None, num_parts=1,
+                 part_index=0):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
 
+        if num_parts > 1:
+            lo, hi = shard_bounds(self.data[0][1].shape[0], num_parts,
+                                  part_index)
+            self.data = [(k, v[lo:hi]) for k, v in self.data]
+            self.label = [(k, v[lo:hi]) for k, v in self.label]
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+
         self.idx = np.arange(self.data[0][1].shape[0])
         self.shuffle = shuffle
+        # epoch permutations come from a PRIVATE stream: unseeded
+        # construction draws ONE anchor from the global RNG (so legacy
+        # np.random.seed reproducibility holds) and everything after is
+        # keyed by (anchor, epoch) — stateless per epoch, which is what
+        # makes the cursor seekable
+        if seed is None and shuffle:
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self._seed = seed
+        self._epoch = -1
+        self._base_data = self.data
+        self._base_label = self.label
         self.last_batch_handle = last_batch_handle
         self.num_data = self.idx.shape[0]
 
@@ -191,6 +274,7 @@ class NDArrayIter(DataIter):
 
     def hard_reset(self):
         """Ignore roll-over; restart from sample 0."""
+        self._epoch += 1
         if self.shuffle:
             self._shuffle_data()
         self.cursor = -self.batch_size
@@ -198,6 +282,7 @@ class NDArrayIter(DataIter):
         self._cache_label = None
 
     def reset(self):
+        self._epoch += 1
         if self.shuffle:
             self._shuffle_data()
         # roll_over: keep the tail of the previous epoch at the front
@@ -296,9 +381,68 @@ class NDArrayIter(DataIter):
         return None
 
     def _shuffle_data(self):
-        perm = np.random.permutation(self.data[0][1].shape[0])
-        self.data = [(k, v[perm]) for k, v in self.data]
-        self.label = [(k, v[perm]) for k, v in self.label]
+        # permute the ORIGINAL arrays with the (seed, epoch)-keyed
+        # stream: any epoch's view is reconstructible without replaying
+        # the epochs before it (the seek in restore_state)
+        perm = np.random.RandomState(
+            mix_seed(self._seed, self._epoch) % (2 ** 32)).permutation(
+            self._base_data[0][1].shape[0])
+        self.data = [(k, v[perm]) for k, v in self._base_data]
+        self.label = [(k, v[perm]) for k, v in self._base_label]
+
+    def checkpoint_state(self, epoch=None, nbatch=None):
+        """Resumable cursor for the checkpoint manifest: everything a
+        fresh process needs to continue this stream at (epoch, batch)
+        without replaying — the shuffle anchor plus the position.
+        ``roll_over`` carries cross-epoch state that a seek cannot
+        reconstruct, so it returns None (fit falls back to replay)."""
+        if self.last_batch_handle == "roll_over":
+            return None
+        return {"kind": "NDArrayIter",
+                "epoch": int(self._epoch if epoch is None else epoch),
+                "batch": int(nbatch or 0),
+                "seed": self._seed,
+                "shuffle": bool(self.shuffle),
+                "batch_size": int(self.batch_size),
+                "num_data": int(self.num_data),
+                "num_parts": self.num_parts,
+                "part_index": self.part_index}
+
+    def restore_state(self, cursor):
+        """Seek to a :meth:`checkpoint_state` position: applies that
+        epoch's permutation and points the cursor at batch ``batch`` —
+        no batches are drawn or decoded on the way. The seed is ADOPTED
+        (it is part of the position); every other field identifies the
+        stream and must match, so a cursor from a differently-configured
+        iterator raises (fit then falls back to replay) instead of
+        silently seeking to the wrong samples."""
+        if self.last_batch_handle == "roll_over":
+            raise MXNetError("NDArrayIter(last_batch_handle='roll_over') "
+                             "cannot seek: the carried tail is not in "
+                             "the cursor")
+        if cursor.get("kind") not in (None, "NDArrayIter"):
+            raise MXNetError("io cursor kind %r is not an NDArrayIter "
+                             "cursor" % cursor.get("kind"))
+        mine = {"shuffle": bool(self.shuffle),
+                "batch_size": int(self.batch_size),
+                "num_data": int(self.num_data),
+                "num_parts": int(self.num_parts),
+                "part_index": int(self.part_index)}
+        for key, val in mine.items():
+            if cursor.get(key) is not None and cursor[key] != val:
+                raise MXNetError(
+                    "io cursor was taken over a stream with %s=%r but "
+                    "this iterator has %r — not the same stream"
+                    % (key, cursor[key], val))
+        if cursor.get("seed") is not None:
+            self._seed = cursor["seed"]
+        self._epoch = int(cursor["epoch"])
+        if self.shuffle:
+            self._shuffle_data()
+        self.cursor = int(cursor.get("batch", 0)) * self.batch_size \
+            - self.batch_size
+        self._cache_data = None
+        self._cache_label = None
 
 
 class ResizeIter(DataIter):
@@ -328,7 +472,16 @@ class ResizeIter(DataIter):
             self.current_batch = self.data_iter.next()
         except StopIteration:
             self.data_iter.reset()
-            self.current_batch = self.data_iter.next()
+            try:
+                self.current_batch = self.data_iter.next()
+            except StopIteration:
+                # an iterator that is empty even after reset() can never
+                # fill `size` batches — a clear error beats the bare
+                # StopIteration escaping mid-epoch
+                raise MXNetError(
+                    "ResizeIter: wrapped %s yielded no batches after "
+                    "reset(); cannot resize an empty iterator to %d "
+                    "batches" % (type(self.data_iter).__name__, self.size))
         self.cur += 1
         return True
 
@@ -374,11 +527,36 @@ class PrefetchingIter(DataIter):
         self.data_taken = [threading.Event() for _ in range(self.n_iter)]
         for e in self.data_taken:
             e.set()
-        self.started = True
+        self.started = False
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
         self._tm_epoch_t0 = None
         self._tm_epoch_samples = 0
+        self.prefetch_threads = []
+        self._start_threads()
+
+    def _start_threads(self):
+        """(Re)spawn the per-iterator prefetch threads. Event state is
+        preserved across a close(): a batch fetched before close stays
+        in ``next_batch`` with its ready flag set, so a restarted
+        consumer continues exactly where it stopped."""
+        if self.started:
+            return
+        # a close() whose join timed out can leave a worker finishing
+        # its fetch; wait it out — two workers interleaving next() on
+        # one underlying iterator would corrupt the stream
+        for t in self.prefetch_threads:
+            t.join()
+        # restore the parked-batch invariant: close() wakes waiting
+        # workers by force-setting data_taken, and an exiting worker
+        # consumes nothing. A parked batch (ready set) must keep
+        # data_taken clear, or the fresh worker would pass its wait()
+        # immediately and overwrite the batch before the consumer
+        # reads it.
+        for ready, taken in zip(self.data_ready, self.data_taken):
+            if ready.is_set():
+                taken.clear()
+        self.started = True
 
         def prefetch_func(self, i):
             while True:
@@ -394,6 +572,11 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
+                if not self.started:
+                    # a close() that arrived mid-fetch had its wake-up
+                    # signal erased by the clear() above — exit here
+                    # instead of blocking in wait() past the join
+                    break
 
         self.prefetch_threads = [
             threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
@@ -421,12 +604,36 @@ class PrefetchingIter(DataIter):
         batch.label = put(batch.label)
         return batch
 
-    def __del__(self):
+    def close(self):
+        """Stop the prefetch threads deterministically (the reference
+        relied on ``__del__`` firing — on TPU VMs a leaked decode
+        thread keeps the process alive past SIGTERM). Idempotent, and
+        NOT terminal: ``reset()`` or the next ``iter_next()`` respawns
+        the workers, so a closed iterator handed to a second ``fit``
+        just works."""
+        if not self.started:
+            return
         self.started = False
         for e in self.data_taken:
             e.set()
         for t in self.prefetch_threads:
-            t.join(timeout=1.0)
+            t.join(timeout=5.0)
+        # handles stay: _start_threads joins any straggler that was
+        # still mid-fetch when the timed join gave up, then repairs the
+        # event state, before spawning replacements
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -447,14 +654,16 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        if self.started:
+            for e in self.data_ready:
+                e.wait()
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
             e.set()
+        self._start_threads()
         if _tm._enabled:
             # epoch throughput: samples served since the previous reset
             now = _tm.monotonic()
@@ -468,6 +677,7 @@ class PrefetchingIter(DataIter):
             self._tm_epoch_samples = 0
 
     def iter_next(self):
+        self._start_threads()       # no-op unless close()d
         t0 = None
         if _tm._enabled:
             # ready events double as the prefetch queue: depth = batches
@@ -546,10 +756,15 @@ class PrefetchingIter(DataIter):
 class CSVIter(DataIter):
     """Iterate over CSV files (reference: src/io/iter_csv.cc; the C++
     iterator streams chunks — here the file is memory-mapped once via
-    numpy, which covers the same scale for host-side CSVs)."""
+    numpy, which covers the same scale for host-side CSVs).
+
+    ``num_parts``/``part_index`` shard the rows under the shared
+    partition contract (:func:`shard_bounds`), composing with per-host
+    data parallelism like the RecordIO iterators."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, dtype="float32", **_kw):
+                 batch_size=1, round_batch=True, dtype="float32",
+                 shuffle=False, seed=None, num_parts=1, part_index=0, **_kw):
         data = np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
@@ -559,7 +774,8 @@ class CSVIter(DataIter):
         self._inner = NDArrayIter(
             data, label, batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard",
-            label_name="label")
+            label_name="label", shuffle=shuffle, seed=seed,
+            num_parts=num_parts, part_index=part_index)
         super().__init__(batch_size)
 
     @property
@@ -575,6 +791,12 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+    def checkpoint_state(self, epoch=None, nbatch=None):
+        return self._inner.checkpoint_state(epoch, nbatch)
+
+    def restore_state(self, cursor):
+        self._inner.restore_state(cursor)
 
 
 class LibSVMIter(DataIter):
@@ -747,3 +969,927 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
         # host isn't forced to pay the double-buffer thread
         it = PrefetchingIter(it)
     return it
+
+
+# ---------------------------------------------------------------------------
+# async multi-worker input pipeline (reference: the C++ prefetcher +
+# OMP decode pool of src/io/iter_image_recordio_2.cc, rebuilt as a
+# process pool feeding a k-deep device staging buffer)
+# ---------------------------------------------------------------------------
+
+def _pipeline_mp_context():
+    """Multiprocessing context for pipeline workers. Shares the
+    ``MXNET_DATALOADER_START_METHOD`` knob with the gluon DataLoader:
+    fork shares the source copy-on-write; spawn/forkserver pickle it
+    (every shipped source keeps ``__getstate__`` handle-free)."""
+    import multiprocessing
+    from . import config as _config
+    method = _config.get("MXNET_DATALOADER_START_METHOD")
+    valid = multiprocessing.get_all_start_methods()
+    if method not in valid:
+        if "MXNET_DATALOADER_START_METHOD" in os.environ:
+            raise MXNetError(
+                "MXNET_DATALOADER_START_METHOD=%r is not a start method "
+                "on this platform (valid: %s)" % (method, ", ".join(valid)))
+        method = valid[0]
+    return multiprocessing.get_context(method)
+
+
+def _pipeline_worker_loop(source, in_q, out_q, shm_prefix):
+    """Pipeline worker body (module-level so both fork and spawn can
+    target it): pull ``(epoch, index)`` tasks, materialize the batch
+    via ``source.get_batch`` — a pure function of (epoch, index), so
+    ANY worker produces identical bytes — and ship the arrays through
+    POSIX shared memory. Segment names are deterministic
+    (``prefix-epoch-index-leaf``) so the parent can reclaim what a
+    CRASHED worker staged but never reported. The ``io.worker`` fault
+    point fires before each decode; a ``crash`` armed there is how
+    tests prove the restart path."""
+    from multiprocessing import shared_memory, resource_tracker
+    from . import fault as _fault
+    try:
+        # one decode lane per worker: cv2's internal thread pool times
+        # N worker processes is a thread storm that scales at ~1x —
+        # process-level parallelism is the scaling axis here
+        import cv2
+        cv2.setNumThreads(0)
+    except Exception:
+        pass
+    while True:
+        task = in_q.get()
+        if task is None:
+            break
+        epoch, index = task
+        metas = []
+        try:
+            _fault.inject("io.worker")
+            t0 = time.monotonic()
+            data, label, pad = source.get_batch(epoch, index)
+            dt = time.monotonic() - t0
+            n_data = len(data)
+            for li, arr in enumerate(list(data) + list(label)):
+                arr = np.ascontiguousarray(arr)
+                name = "%s-%d-%d-%d" % (shm_prefix, epoch, index, li)
+                try:
+                    shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=max(1, arr.nbytes))
+                except FileExistsError:
+                    # stale segment from a crashed attempt at this very
+                    # batch (the pool never decodes one task twice
+                    # concurrently, so this is safe to reclaim)
+                    try:
+                        old = shared_memory.SharedMemory(name=name)
+                        old.close()
+                        old.unlink()
+                    except FileNotFoundError:
+                        pass
+                    shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=max(1, arr.nbytes))
+                np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+                metas.append((shm.name, arr.shape, str(arr.dtype)))
+                # the CONSUMER unlinks; unregister so this process's
+                # resource tracker doesn't double-free at exit
+                try:
+                    resource_tracker.unregister(shm._name, "shared_memory")
+                except Exception:
+                    pass
+                shm.close()
+            out_q.put((epoch, index, (metas, n_data, pad, dt), None))
+        except Exception as e:
+            # segments staged before the failure would otherwise leak
+            # in /dev/shm — exactly when memory is already tight
+            for name, _shape, _dtype in metas:
+                try:
+                    seg = shared_memory.SharedMemory(name=name)
+                    seg.close()
+                    seg.unlink()
+                except Exception:
+                    pass
+            out_q.put((epoch, index, None, repr(e)))
+
+
+def _shm_load(payload):
+    """Map a worker's shm segments back into numpy (copy, then unlink:
+    the consumer is the only party that frees transport memory)."""
+    from multiprocessing import shared_memory
+    metas, n_data, pad, dt = payload
+    arrs = []
+    for name, shape, dtype in metas:
+        shm = shared_memory.SharedMemory(name=name)
+        arrs.append(np.ndarray(shape, np.dtype(dtype),
+                               buffer=shm.buf).copy())
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    return arrs[:n_data], arrs[n_data:], pad, dt
+
+
+def _shm_unlink(payload):
+    """Release the segments of a batch that will never be consumed."""
+    if not payload:
+        return
+    from multiprocessing import shared_memory
+    for name, _shape, _dtype in payload[0]:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+
+class _BatchSourceBase(object):
+    """Scaffolding every pipeline source shares — the shard/validate
+    step, seeded per-epoch permutations, and the cursor fingerprint —
+    kept in ONE place so the sources can't drift apart on the
+    determinism contract. Subclasses call :meth:`_init_source` from
+    ``__init__`` and use the returned ``(lo, hi)`` to take their
+    shard's slice."""
+
+    def _init_source(self, total, batch_size, shuffle, seed,
+                     last_batch_handle, num_parts, part_index):
+        self.batch_size = int(batch_size)
+        lo, hi = shard_bounds(total, num_parts, part_index)
+        self.num_data = int(hi - lo)
+        if last_batch_handle not in ("pad", "discard"):
+            raise MXNetError(
+                "%s supports last_batch_handle 'pad' or 'discard', got %r"
+                % (type(self).__name__, last_batch_handle))
+        if self.num_data < self.batch_size:
+            raise MXNetError(
+                "batch_size %d exceeds shard size %d (part %d/%d)"
+                % (self.batch_size, self.num_data, part_index, num_parts))
+        self.last_batch_handle = last_batch_handle
+        self.shuffle = bool(shuffle)
+        self.seed = 0 if seed is None else int(seed)
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        self._perm_cache = (None, None)
+        return lo, hi
+
+    def set_seed(self, seed):
+        self.seed = int(seed)
+        self._perm_cache = (None, None)
+
+    def num_batches(self, epoch=0):
+        if self.last_batch_handle == "discard":
+            return self.num_data // self.batch_size
+        return -(-self.num_data // self.batch_size)
+
+    def _perm(self, epoch):
+        if self._perm_cache[0] != epoch:
+            perm = np.random.RandomState(
+                mix_seed(self.seed, epoch) % (2 ** 32)).permutation(
+                self.num_data)
+            self._perm_cache = (epoch, perm)
+        return self._perm_cache[1]
+
+    def cursor_fingerprint(self):
+        """Identity of this stream for the resumable cursor: restore
+        refuses to seek a cursor taken over a different stream."""
+        return {"source": type(self).__name__, "seed": self.seed,
+                "shuffle": self.shuffle, "num_data": self.num_data,
+                "batch_size": self.batch_size,
+                "num_parts": self.num_parts,
+                "part_index": self.part_index}
+
+
+class ArrayBatchSource(_BatchSourceBase):
+    """Picklable batch source over in-memory arrays for
+    :class:`DataPipeline`.
+
+    The pipeline source contract: ``get_batch(epoch, index)`` is a PURE
+    function of its arguments plus construction parameters — what makes
+    the multi-worker stream bitwise-identical to the inline one and the
+    shard cursor seekable in O(1). Epoch shuffles draw from
+    ``mix_seed(seed, epoch)`` (never global RNG state);
+    ``num_parts``/``part_index`` shard per :func:`shard_bounds`;
+    ``augment_fn(data_list, rng) -> data_list`` (a picklable,
+    module-level function) runs with an RNG keyed by
+    ``(seed, epoch, index)``.
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 seed=0, last_batch_handle="pad", num_parts=1, part_index=0,
+                 data_name="data", label_name="softmax_label",
+                 augment_fn=None):
+        data = _init_data(data, allow_empty=False, default_name=data_name)
+        label = _init_data(label, allow_empty=True, default_name=label_name)
+        lo, hi = self._init_source(data[0][1].shape[0], batch_size,
+                                   shuffle, seed, last_batch_handle,
+                                   num_parts, part_index)
+        self._data = [(k, v[lo:hi]) for k, v in data]
+        self._label = [(k, v[lo:hi]) for k, v in label]
+        self.augment_fn = augment_fn
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._label]
+
+    def _take(self, epoch, index):
+        n = self.num_batches(epoch)
+        if not 0 <= index < n:
+            raise MXNetError("batch index %d out of range [0, %d)"
+                             % (index, n))
+        lo = index * self.batch_size
+        hi = min(lo + self.batch_size, self.num_data)
+        pad = self.batch_size - (hi - lo)
+        idx = np.arange(lo, hi)
+        if pad:
+            # wrap the short tail with leading samples (NDArrayIter
+            # 'pad' semantics; 'discard' never reaches here)
+            idx = np.concatenate([idx, np.arange(pad)])
+        if self.shuffle:
+            idx = self._perm(epoch)[idx]
+        return idx, pad
+
+    def get_batch(self, epoch, index):
+        idx, pad = self._take(epoch, index)
+        data = [v[idx] for _k, v in self._data]
+        label = [v[idx] for _k, v in self._label]
+        if self.augment_fn is not None:
+            rng = np.random.RandomState(
+                mix_seed(self.seed, epoch, index, 0xA4) % (2 ** 32))
+            data = self.augment_fn(data, rng)
+        return data, label, pad
+
+
+class RecordBatchSource(_BatchSourceBase):
+    """Picklable sharded RecordIO image source for :class:`DataPipeline`:
+    packed ``(IRHeader, jpeg)`` records from an INDEXED ``.rec`` are
+    decoded + augmented on whichever worker draws the batch.
+
+    Only paths cross the pickle boundary (the ``MXRecordIO.__getstate__``
+    contract); the reader and augmenter list open lazily per process.
+    Augmentation RNG (stdlib + numpy global, which the image augmenters
+    draw from) is seeded per batch by ``mix_seed(seed, epoch, index)``
+    and restored afterwards, so crops/flips are bitwise-identical for
+    any worker count and never perturb the caller's RNG streams.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False, seed=0,
+                 num_parts=1, part_index=0, last_batch_handle="pad",
+                 aug_kwargs=None):
+        self.path_imgrec = path_imgrec
+        self.path_imgidx = path_imgidx or \
+            os.path.splitext(path_imgrec)[0] + ".idx"
+        if not os.path.exists(self.path_imgidx):
+            raise MXNetError(
+                "RecordBatchSource needs an indexed .rec: no %r "
+                "(tools/rec2idx.py builds one)" % self.path_imgidx)
+        keys = []
+        with open(self.path_imgidx) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) == 2:
+                    keys.append(int(parts[0]))
+        lo, hi = self._init_source(len(keys), batch_size, shuffle, seed,
+                                   last_batch_handle, num_parts, part_index)
+        self.keys = keys[lo:hi]
+        self.data_shape = tuple(data_shape)
+        self.label_width = int(label_width)
+        self.aug_kwargs = dict(aug_kwargs or {})
+        self._rec = None
+        self._augs = None
+
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        st["_rec"] = None           # readers don't cross processes
+        st["_augs"] = None
+        st["_perm_cache"] = (None, None)
+        return st
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape,
+                         np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size, self.label_width) \
+            if self.label_width > 1 else (self.batch_size,)
+        return [DataDesc("softmax_label", shape, np.float32)]
+
+    def get_batch(self, epoch, index):
+        from . import recordio
+        from . import image as _img
+        n = self.num_batches(epoch)
+        if not 0 <= index < n:
+            raise MXNetError("batch index %d out of range [0, %d)"
+                             % (index, n))
+        if self._rec is None:
+            self._rec = recordio.MXIndexedRecordIO(
+                self.path_imgidx, self.path_imgrec, "r")
+        if self._augs is None:
+            self._augs = _img.CreateAugmenter(self.data_shape,
+                                              **self.aug_kwargs)
+        lo = index * self.batch_size
+        hi = min(lo + self.batch_size, len(self.keys))
+        pad = self.batch_size - (hi - lo)
+        rows = list(range(lo, hi)) + list(range(pad))
+        if self.shuffle:
+            perm = self._perm(epoch)
+            rows = [int(perm[r]) for r in rows]
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.zeros((self.batch_size, self.label_width), np.float32)
+        # the image augmenters draw from the stdlib + numpy GLOBAL RNGs:
+        # key both by stream position, restore both after — identical
+        # crops/flips for any worker count, zero caller-visible drift
+        py_state = _pyrandom.getstate()
+        np_state = np.random.get_state()
+        _pyrandom.seed(mix_seed(self.seed, epoch, index, 0x5EC))
+        np.random.seed(mix_seed(self.seed, epoch, index, 0x5ED) % (2 ** 32))
+        try:
+            for j, r in enumerate(rows):
+                header, s = recordio.unpack(self._rec.read_idx(self.keys[r]))
+                img = _img.imdecode(s, 1 if c == 3 else 0, to_ndarray=False)
+                for aug in self._augs:
+                    img = aug(img)
+                arr = np.asarray(img)
+                if arr.ndim == 3:
+                    arr = arr.transpose(2, 0, 1)
+                data[j] = arr
+                lab = np.asarray(header.label, np.float32).ravel()
+                label[j, :min(lab.size, self.label_width)] = \
+                    lab[:self.label_width]
+        finally:
+            _pyrandom.setstate(py_state)
+            np.random.set_state(np_state)
+        lbl = label[:, 0] if self.label_width == 1 else label
+        return [data], [lbl], pad
+
+
+class _EndOfEpoch(object):
+    __slots__ = ()
+
+
+_END = _EndOfEpoch()
+
+
+class DataPipeline(DataIter):
+    """Async multi-worker input pipeline with overlapped host→device
+    staging — the production feed path for fused train steps.
+
+    Three stages, each overlapping the next:
+
+    1. **Decode** — ``num_workers`` processes (default
+       ``MXNET_IO_WORKERS``) materialize batches from a picklable
+       *batch source* (:class:`ArrayBatchSource`,
+       :class:`RecordBatchSource`, or anything with the same
+       ``provide_data``/``provide_label``/``num_batches``/``get_batch``
+       surface). ``get_batch(epoch, index)`` is pure, so results
+       reassemble **in order** and the stream is bitwise-identical for
+       any worker count (0 = inline decode on the staging thread).
+    2. **Stage** — a host thread converts each batch to device arrays
+       (``jax.device_put``) into a ``prefetch``-deep buffer (default
+       ``MXNET_IO_PREFETCH``), so H2D for batch N+k overlaps the
+       previous step's compute. ``io.h2d`` spans and the
+       ``io/pipeline_queue_depth`` gauge make the overlap visible.
+    3. **Consume** — ``next()`` pops the buffer; the wait (if any) is
+       the pipeline's un-hidden cost, recorded as ``io.batch_wait``
+       under the step's ``train.data_wait`` span.
+
+    Backpressure is structural: at most ``num_workers + prefetch``
+    batches are in flight and at most ``prefetch`` staged, so host
+    memory stays flat no matter how far the source could run ahead.
+
+    A worker that **crashes** (preemption, native fault, an armed
+    ``io.worker`` injection) is restarted in place — bounded by
+    ``MXNET_IO_WORKER_RESTARTS`` — and its in-flight batches are
+    re-decoded; order-keyed reassembly dedupes, so the consumer sees no
+    lost and no duplicated batch.
+
+    The cursor (:meth:`checkpoint_state` / :meth:`restore_state`)
+    serializes (epoch, batch index, seed, shard identity) into the
+    checkpoint manifest; restore **seeks** — nothing is decoded on the
+    way — and the post-resume stream is bitwise-identical to the
+    uninterrupted one.
+
+    ``close()`` (also via ``with``) stops the stager thread and worker
+    pool deterministically; it is idempotent and NOT terminal — the
+    position is kept and the next use restarts lazily.
+    """
+
+    def __init__(self, source, num_workers=None, prefetch=None,
+                 device_stage=True, ctx=None, restart_budget=None):
+        super().__init__(int(source.batch_size))
+        from . import config as _config
+        self._source = source
+        nw = _config.get("MXNET_IO_WORKERS") if num_workers is None \
+            else num_workers
+        nw = int(nw)
+        if nw < 0:
+            # auto: leave one core for the staging thread + train loop
+            nw = max(1, (os.cpu_count() or 1) - 1)
+        self._num_workers = nw
+        self._depth = max(1, int(_config.get("MXNET_IO_PREFETCH")
+                                 if prefetch is None else prefetch))
+        self._restart_budget = int(
+            _config.get("MXNET_IO_WORKER_RESTARTS")
+            if restart_budget is None else restart_budget)
+        self._device_stage = device_stage
+        self._stage_ctx = ctx
+        self._epoch = 0
+        self._next_index = 0      # next batch index the consumer gets
+        self._end_seen = False
+        self._cond = threading.Condition()
+        self._staged = deque()
+        self._stop = False
+        self._error = None
+        self._stager = None
+        self._workers = []
+        self._mp_ctx = None
+        self._in_q = None
+        self._out_q = None
+        self._trace_ctx = None
+        self._current_batch = None
+        # deterministic shm namespace: lets the parent reclaim segments
+        # a crashed worker staged but never reported
+        self._shm_prefix = "mxio-%d-%x" % (os.getpid(), id(self) & 0xFFFFFF)
+
+    # -- provides ----------------------------------------------------------
+    @property
+    def provide_data(self):
+        return self._source.provide_data
+
+    @property
+    def provide_label(self):
+        return self._source.provide_label
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_running(self):
+        if self._end_seen:
+            return
+        if self._error is not None:
+            return          # deliver the pending error before restarting
+        if self._stager is not None and self._stager.is_alive():
+            return
+        self._stop = False
+        if self._num_workers > 0 and not self._workers:
+            self._mp_ctx = _pipeline_mp_context()
+            self._in_q = self._mp_ctx.Queue()
+            self._out_q = self._mp_ctx.Queue()
+            self._workers = [self._spawn_worker()
+                             for _ in range(self._num_workers)]
+        self._stager = threading.Thread(target=self._stager_main,
+                                        name="mxnet-io-stager", daemon=True)
+        self._stager.start()
+
+    def _spawn_worker(self):
+        w = self._mp_ctx.Process(
+            target=_pipeline_worker_loop,
+            args=(self._source, self._in_q, self._out_q,
+                  self._shm_prefix), daemon=True)
+        w.start()
+        return w
+
+    def _halt_segment(self):
+        """Stop the stager thread; recycle the pool if the halt was
+        mid-stream (in-flight tasks would leak into the next segment)."""
+        st = self._stager
+        self._stager = None
+        if st is not None and st.is_alive():
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            st.join(timeout=10.0)
+        if st is not None and not self._end_seen:
+            self._teardown_pool()
+        with self._cond:
+            self._staged.clear()
+            self._stop = False
+            if _tm._enabled:
+                _tm.gauge("io/pipeline_queue_depth",
+                          "Decoded batches staged on device ahead of the "
+                          "consumer").set(0)
+
+    def _teardown_pool(self):
+        workers, self._workers = self._workers, []
+        in_q, out_q = self._in_q, self._out_q
+        self._in_q = None
+        self._out_q = None
+        if not workers:
+            return
+        for _ in workers:
+            try:
+                in_q.put_nowait(None)
+            except Exception:
+                pass
+        # drain while workers wind down AND after: a result landing
+        # mid-shutdown still holds shm segments only the consumer frees
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                any(w.is_alive() for w in workers):
+            try:
+                _e, _i, payload, _err = out_q.get(timeout=0.2)
+                _shm_unlink(payload)
+            except _queue.Empty:
+                pass
+        for w in workers:
+            w.join(timeout=1.0)
+            if w.is_alive():
+                w.terminate()
+        while True:
+            try:
+                _e, _i, payload, _err = out_q.get(timeout=0.1)
+                _shm_unlink(payload)
+            except _queue.Empty:
+                break
+
+    def _kill_pool(self):
+        """Hard-stop the pool after a worker crash: terminate everyone
+        and drop the (possibly lock-poisoned) queues wholesale."""
+        workers, self._workers = self._workers, []
+        for w in workers:
+            if w.is_alive():
+                w.terminate()
+        for w in workers:
+            w.join(timeout=2.0)
+            if w.is_alive():
+                w.kill()
+        for q in (self._in_q, self._out_q):
+            if q is None:
+                continue
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+        self._in_q = None
+        self._out_q = None
+
+    def _reclaim_segments(self, epoch, seqs):
+        """Unlink segments of batches that died with their worker."""
+        from multiprocessing import shared_memory
+        n_leaves = len(self._source.provide_data) + \
+            len(self._source.provide_label)
+        for seq in seqs:
+            for li in range(n_leaves):
+                try:
+                    seg = shared_memory.SharedMemory(
+                        name="%s-%d-%d-%d" % (self._shm_prefix, epoch,
+                                              seq, li))
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+                except Exception:
+                    pass
+
+    def close(self):
+        """Stop the stager thread and worker processes deterministically
+        (a leaked decode process outlives SIGTERM on a TPU VM).
+        Idempotent and NOT terminal: the (epoch, batch) position is
+        kept and the next use restarts lazily, so a closed pipeline
+        handed to a second ``fit`` just works."""
+        self._halt_segment()
+        self._teardown_pool()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- producer side (stager thread) -------------------------------------
+    def _stager_main(self):
+        try:
+            if self._num_workers == 0:
+                self._run_inline()
+            else:
+                self._run_pool()
+        except BaseException as e:
+            with self._cond:
+                if self._error is None:
+                    self._error = e
+                self._staged.append(_END)
+                self._cond.notify_all()
+
+    def _push(self, item):
+        """Bounded staging buffer: block while ``prefetch`` batches are
+        already staged — the backpressure that keeps host/device memory
+        flat. Returns False when the pipeline is stopping."""
+        with self._cond:
+            while len(self._staged) >= self._depth and not self._stop \
+                    and item is not _END:
+                self._cond.wait(0.1)
+            if self._stop:
+                return False
+            self._staged.append(item)
+            if _tm._enabled:
+                _tm.gauge("io/pipeline_queue_depth",
+                          "Decoded batches staged on device ahead of the "
+                          "consumer").set(
+                    sum(1 for b in self._staged if b is not _END))
+            self._cond.notify_all()
+            return True
+
+    def _stage(self, data, label, pad, t0=None):
+        """numpy batch -> device-resident DataBatch, from the stager
+        thread: the H2D copy overlaps the consumer's compute. ``t0``
+        backdates the staging window to include the shm map+copy of the
+        pool transport, so io/h2d_seconds is the FULL staging cost the
+        pipeline hides."""
+        if t0 is None:
+            t0 = _tm.monotonic()
+        darr = [array(a) for a in data]
+        larr = [array(a) for a in label]
+        if self._device_stage:
+            import jax
+            from .context import current_context
+            ctx = self._stage_ctx or current_context()
+            dev = ctx.jax_device() if hasattr(ctx, "jax_device") else ctx
+            for nd in darr + larr:
+                nd._set_data(jax.device_put(nd._data, dev))
+        t1 = _tm.monotonic()
+        tctx = self._trace_ctx
+        if tctx is not None:
+            _tr.record_span("io.h2d", tctx, t0, t1)
+        if _tm._enabled:
+            _tm.histogram("io/h2d_seconds",
+                          "Host->device staging per batch (pipeline "
+                          "thread; overlaps the previous step's compute)"
+                          ).observe(t1 - t0)
+        return DataBatch(darr, larr, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _run_inline(self):
+        """workers=0: decode on the staging thread. Same get_batch
+        stream as the pool path — the bitwise-equality reference."""
+        from . import fault as _fault
+        epoch = self._epoch
+        n = self._source.num_batches(epoch)
+        for index in range(self._next_index, n):
+            if self._stop:
+                return
+            _fault.inject("io.worker")
+            t0 = _tm.monotonic()
+            data, label, pad = self._source.get_batch(epoch, index)
+            t1 = _tm.monotonic()
+            tctx = self._trace_ctx
+            if tctx is not None:
+                _tr.record_span("io.decode", tctx, t0, t1)
+            if _tm._enabled:
+                _tm.histogram("io/decode_seconds",
+                              "Batch decode/augment time (worker process "
+                              "or inline)").observe(t1 - t0)
+            if not self._push(self._stage(data, label, pad)):
+                return
+        self._push(_END)
+
+    def _run_pool(self):
+        epoch = self._epoch
+        n = self._source.num_batches(epoch)
+        next_sched = self._next_index
+        next_recv = self._next_index
+        window = self._num_workers + self._depth
+        pending = set()          # scheduled, not yet received
+        buffered = {}            # received out of order
+        restarts_left = self._restart_budget
+        try:
+            while next_recv < n and not self._stop:
+                while next_sched < n and len(pending) < window:
+                    self._in_q.put((epoch, next_sched))
+                    pending.add(next_sched)
+                    next_sched += 1
+                if next_recv in buffered:
+                    t_load = _tm.monotonic()
+                    data, label, pad, dt = _shm_load(buffered.pop(next_recv))
+                    if _tm._enabled:
+                        _tm.histogram("io/decode_seconds",
+                                      "Batch decode/augment time (worker "
+                                      "process or inline)").observe(dt)
+                    if not self._push(self._stage(data, label, pad,
+                                                  t0=t_load)):
+                        return
+                    next_recv += 1
+                    continue
+                try:
+                    r_epoch, index, payload, err = \
+                        self._out_q.get(timeout=0.5)
+                except _queue.Empty:
+                    dead = [w for w in self._workers if not w.is_alive()]
+                    if not dead:
+                        continue
+                    if restarts_left < len(dead):
+                        # same salvage-then-reclaim as the restart path,
+                        # minus the respawn: a dead worker's staged-but-
+                        # unreported segments are unregistered from its
+                        # resource tracker, so nothing else ever frees
+                        # them from /dev/shm
+                        salvaged = []
+                        while True:
+                            try:
+                                salvaged.append(
+                                    self._out_q.get(timeout=0.1))
+                            except _queue.Empty:
+                                break
+                        self._kill_pool()
+                        for _se, _si, payload, _serr in salvaged:
+                            _shm_unlink(payload)
+                        self._reclaim_segments(epoch, pending)
+                        raise MXNetError(
+                            "io pipeline worker crashed and the restart "
+                            "budget (MXNET_IO_WORKER_RESTARTS=%d) is "
+                            "exhausted" % self._restart_budget)
+                    restarts_left -= len(dead)
+                    if _tm._enabled:
+                        _tm.counter("io/worker_restarts_total",
+                                    "Crashed input-pipeline workers "
+                                    "restarted in place").inc(len(dead))
+                    # a worker that died mid-queue-write leaves the
+                    # SHARED pipe lock held forever, wedging every
+                    # surviving worker — recycle the whole pool (fresh
+                    # queues, fresh processes) instead of patching
+                    # around the corpse. Landed results are salvaged
+                    # first; everything scheduled-but-unreceived is
+                    # re-decoded, and a task that thereby runs twice is
+                    # dropped on receive (get_batch is pure) — no lost
+                    # batch, no duplicated batch.
+                    salvaged = []
+                    while True:
+                        try:
+                            salvaged.append(self._out_q.get(timeout=0.1))
+                        except _queue.Empty:
+                            break
+                    self._kill_pool()
+                    # reclaim segments a dead/terminated worker staged
+                    # but never reported (names are deterministic);
+                    # salvaged results keep theirs — they still deliver
+                    salvaged_seqs = {s[1] for s in salvaged
+                                     if s[0] == epoch}
+                    self._reclaim_segments(
+                        epoch, pending - salvaged_seqs)
+                    self._in_q = self._mp_ctx.Queue()
+                    self._out_q = self._mp_ctx.Queue()
+                    for item in salvaged:
+                        self._out_q.put(item)
+                    self._workers = [self._spawn_worker()
+                                     for _ in range(self._num_workers)]
+                    # salvaged seqs deliver from their re-put results;
+                    # everything else is decoded again — each exactly
+                    # once, so no lost and no duplicated batch
+                    for seq in sorted(pending - salvaged_seqs):
+                        self._in_q.put((epoch, seq))
+                    continue
+                pending.discard(index)
+                if r_epoch != epoch or index < next_recv \
+                        or index in buffered:
+                    _shm_unlink(payload)   # duplicate after a restart
+                    continue
+                if err is not None:
+                    raise MXNetError(
+                        "io pipeline worker failed on batch %d: %s"
+                        % (index, err))
+                buffered[index] = payload
+            if not self._stop:
+                self._push(_END)
+        finally:
+            for payload in buffered.values():
+                _shm_unlink(payload)
+
+    # -- consumer side -----------------------------------------------------
+    def iter_next(self):
+        self._trace_ctx = _tr.active()
+        self._ensure_running()
+        t0 = _tm.monotonic() \
+            if (_tm._enabled or self._trace_ctx is not None) else None
+        with self._cond:
+            while not self._staged:
+                if self._error is not None:
+                    break
+                if self._end_seen:
+                    return False
+                self._cond.wait(0.5)
+                if self._stager is not None \
+                        and not self._stager.is_alive() \
+                        and not self._staged:
+                    raise MXNetError("io pipeline stager thread died "
+                                     "without delivering the epoch end")
+            item = self._staged.popleft() if self._staged else _END
+            if _tm._enabled:
+                _tm.gauge("io/pipeline_queue_depth",
+                          "Decoded batches staged on device ahead of the "
+                          "consumer").set(
+                    sum(1 for b in self._staged if b is not _END))
+            self._cond.notify_all()
+        if t0 is not None:
+            t1 = _tm.monotonic()
+            if _tm._enabled:
+                _tm.histogram(
+                    "io/batch_wait_seconds",
+                    "Time the consumer blocked waiting for the "
+                    "prefetcher").observe(
+                    t1 - t0, trace_id=self._trace_ctx.trace_id
+                    if self._trace_ctx else None)
+            if self._trace_ctx is not None:
+                _tr.record_span("io.batch_wait", self._trace_ctx, t0, t1)
+        if item is _END:
+            self._current_batch = None
+            if self._error is not None:
+                # raise WITHOUT marking the epoch done: the position is
+                # intact, so the next call retries from the failed batch
+                err, self._error = self._error, None
+                self._stager = None
+                if isinstance(err, MXNetError):
+                    raise err
+                raise MXNetError("io pipeline failed: %r" % (err,))
+            self._end_seen = True
+            return False
+        self._next_index += 1
+        self._current_batch = item
+        if _tm._enabled:
+            _tm.counter("io/batches_total",
+                        "Batches served by prefetching iterators").inc()
+            _tm.counter("io/samples_total", "Samples served by "
+                        "prefetching iterators").inc(
+                self.batch_size - (item.pad or 0))
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self._current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self._current_batch.data
+
+    def getlabel(self):
+        return self._current_batch.label
+
+    def getpad(self):
+        return self._current_batch.pad
+
+    def getindex(self):
+        return self._current_batch.index
+
+    def reset(self):
+        """Advance to the next epoch (NDArrayIter semantics: reset is a
+        fresh pass under the next epoch's shuffle). A mid-epoch reset
+        recycles the worker pool; the normal end-of-epoch reset reuses
+        it."""
+        self._halt_segment()
+        self._epoch += 1
+        self._next_index = 0
+        self._end_seen = False
+        self._error = None
+
+    # -- resumable cursor --------------------------------------------------
+    def checkpoint_state(self, epoch=None, nbatch=None):
+        """Resumable shard cursor for the checkpoint manifest:
+        (epoch, batch index, source identity incl. seed + shard).
+        Restoring seeks directly — nothing is decoded on the way."""
+        st = {"kind": "DataPipeline",
+              "epoch": int(self._epoch if epoch is None else epoch),
+              "batch": int(self._next_index if nbatch is None else nbatch)}
+        fp = getattr(self._source, "cursor_fingerprint", None)
+        if fp is not None:
+            st["source"] = fp()
+        return st
+
+    def restore_state(self, cursor):
+        """Seek to a :meth:`checkpoint_state` position: the next
+        delivered batch is exactly (epoch, batch) and the stream from
+        there is bitwise-identical to an uninterrupted run."""
+        if cursor.get("kind") not in (None, "DataPipeline"):
+            raise MXNetError("io cursor kind %r is not a DataPipeline "
+                             "cursor" % cursor.get("kind"))
+        saved = dict(cursor.get("source") or {})
+        fp = getattr(self._source, "cursor_fingerprint", None)
+        mine = fp() if fp is not None else {}
+        # the seed is ADOPTED (it is part of the position); everything
+        # else identifies the stream and must match
+        seed = saved.pop("seed", None)
+        mine.pop("seed", None)
+        for key, val in saved.items():
+            if key in mine and mine[key] != val:
+                raise MXNetError(
+                    "io cursor was taken over a stream with %s=%r but "
+                    "this pipeline has %r — not the same stream"
+                    % (key, val, mine[key]))
+        self._halt_segment()
+        self._teardown_pool()
+        if seed is not None and hasattr(self._source, "set_seed"):
+            self._source.set_seed(seed)
+        self._epoch = int(cursor["epoch"])
+        self._next_index = int(cursor.get("batch", 0))
+        self._end_seen = False
+        self._error = None

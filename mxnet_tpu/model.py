@@ -113,7 +113,8 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True, nbatch=0, states_fname=None):
+                    remove_amp_cast=True, nbatch=0, states_fname=None,
+                    io_cursor=None):
     """Checkpoint to ``prefix-symbol.json`` + ``prefix-%04d.params``
     (reference: model.py:383), crash-consistently: every file is staged
     to a temp, fsynced, and renamed, and a ``.manifest.json`` sidecar
@@ -137,7 +138,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     nd_save(param_name, save_dict)
     write_manifest(prefix, epoch,
                    {"params": param_name, "symbol": sym_file,
-                    "states": states_fname}, nbatch=nbatch)
+                    "states": states_fname}, nbatch=nbatch,
+                   extra={"io_cursor": io_cursor} if io_cursor else None)
     record_checkpoint_save(param_name, t0)
 
 

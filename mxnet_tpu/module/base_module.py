@@ -204,6 +204,7 @@ class BaseModule(object):
 
         resume_state = None
         skip_nbatch = 0
+        io_seeked = False
         if resume:
             if checkpoint_prefix is None:
                 raise MXNetError(
@@ -217,10 +218,27 @@ class BaseModule(object):
                 allow_missing = False
                 begin_epoch = resume_state.epoch
                 skip_nbatch = resume_state.nbatch
+                # seek the data iterator via the manifest's shard cursor
+                # when it supports it: O(1), nothing decoded on the way,
+                # and the shuffle seed travels with the cursor so the
+                # post-resume batch stream is bitwise-identical to the
+                # uninterrupted run. Iterators without a cursor (or a
+                # cursor from a different stream) fall back to replay.
+                cur = resume_state.io_cursor
+                if cur and hasattr(train_data, "restore_state"):
+                    try:
+                        train_data.restore_state(cur)
+                        io_seeked = True
+                    except MXNetError as e:
+                        self.logger.warning(
+                            "io cursor in %s-%04d does not fit this "
+                            "iterator (%s); replaying the epoch instead",
+                            checkpoint_prefix, resume_state.epoch, e)
                 self.logger.info(
                     "resuming from checkpoint %s-%04d (epoch %d, "
-                    "batch %d)", checkpoint_prefix, resume_state.epoch,
-                    resume_state.epoch, resume_state.nbatch)
+                    "batch %d%s)", checkpoint_prefix, resume_state.epoch,
+                    resume_state.epoch, resume_state.nbatch,
+                    ", iterator seeked" if io_seeked else "")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -287,17 +305,24 @@ class BaseModule(object):
                 nbatch = 0
                 data_iter = iter(train_data)
                 if skip_nbatch:
-                    # mid-epoch resume: draw and discard the batches the
-                    # interrupted run already trained on, so the
-                    # iterator position and batch numbering line up with
-                    # the uninterrupted run
-                    for _ in range(skip_nbatch):
-                        try:
-                            next(data_iter)
-                        except StopIteration:
-                            break
-                        nbatch += 1
+                    if io_seeked:
+                        # the iterator is already at the cursor; only
+                        # the batch numbering needs to line up
+                        nbatch = skip_nbatch
+                    else:
+                        # mid-epoch resume without a seekable cursor:
+                        # draw and discard the batches the interrupted
+                        # run already trained on, so the iterator
+                        # position and batch numbering line up with the
+                        # uninterrupted run
+                        for _ in range(skip_nbatch):
+                            try:
+                                next(data_iter)
+                            except StopIteration:
+                                break
+                            nbatch += 1
                     skip_nbatch = 0
+                io_seeked = False
                 end_of_batch = False
                 eval_name_vals = eval_metric.get_name_value()
                 try:
@@ -357,11 +382,11 @@ class BaseModule(object):
                         if end_of_batch:
                             self._save_fit_checkpoint(
                                 checkpoint_prefix, epoch + 1, 0,
-                                save_optimizer_states)
+                                save_optimizer_states, train_data)
                         else:
                             self._save_fit_checkpoint(
                                 checkpoint_prefix, epoch, nbatch,
-                                save_optimizer_states)
+                                save_optimizer_states, train_data)
                         if preempt["watchdog"] is not None:
                             preempt["watchdog"].cancel()
                         self.logger.info(
@@ -385,7 +410,8 @@ class BaseModule(object):
                 if checkpoint_prefix is not None and \
                         (epoch + 1) % checkpoint_period == 0:
                     self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
-                                              0, save_optimizer_states)
+                                              0, save_optimizer_states,
+                                              train_data)
 
                 if eval_data is not None:
                     res = self.score(eval_data, validation_metric,
@@ -401,18 +427,41 @@ class BaseModule(object):
                 signal.signal(signal.SIGTERM, prev_handler)
             if preempt["watchdog"] is not None:
                 preempt["watchdog"].cancel()
+            # deterministic teardown of prefetch threads / decode
+            # workers (close() is restartable, so handing the same
+            # iterator to a second fit still works)
+            for it in (train_data, eval_data):
+                closer = getattr(it, "close", None)
+                if callable(closer):
+                    try:
+                        closer()
+                    except Exception:
+                        self.logger.warning(
+                            "data iterator close() failed", exc_info=True)
 
     def _save_fit_checkpoint(self, prefix, epoch, nbatch,
-                             save_optimizer_states):
+                             save_optimizer_states, train_data=None):
         """One crash-consistent fit checkpoint: params + optimizer state
-        + manifest (epoch/batch position, RNG state). Numbered by
+        + manifest (epoch/batch position, RNG state, and — when the
+        iterator supports it — the resumable shard cursor). Numbered by
         completed epochs; a mid-epoch save reuses the epoch number with
         ``nbatch`` > 0 and supersedes that epoch's boundary save."""
+        io_cursor = None
+        cursor_fn = getattr(train_data, "checkpoint_state", None)
+        if callable(cursor_fn):
+            try:
+                io_cursor = cursor_fn(epoch, nbatch)
+            except Exception:
+                self.logger.warning(
+                    "data iterator checkpoint_state() failed; checkpoint "
+                    "carries no io cursor (resume will replay)",
+                    exc_info=True)
         with _tr.start_span("train.checkpoint",
                             attrs={"epoch": epoch, "nbatch": nbatch}):
             saver = getattr(self, "save_checkpoint", None)
             if saver is not None:
-                saver(prefix, epoch, save_optimizer_states, nbatch=nbatch)
+                saver(prefix, epoch, save_optimizer_states, nbatch=nbatch,
+                      io_cursor=io_cursor)
                 return
             # modules without a save_checkpoint of their own (Sequential,
             # Python): params + manifest through the model-level writer
@@ -424,7 +473,8 @@ class BaseModule(object):
                 states = "%s-%04d.states" % (prefix, epoch)
                 self.save_optimizer_states(states)
             _model_save(prefix, epoch, self._symbol, arg_p, aux_p,
-                        nbatch=nbatch, states_fname=states)
+                        nbatch=nbatch, states_fname=states,
+                        io_cursor=io_cursor)
 
     # -- properties --------------------------------------------------------
     @property

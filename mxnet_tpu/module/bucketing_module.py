@@ -246,7 +246,7 @@ class BucketingModule(BaseModule):
             mod.install_monitor(mon)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
-                        nbatch=0):
+                        nbatch=0, io_cursor=None):
         """Save the default bucket's symbol + shared params
         (crash-consistently, with a manifest — see
         :meth:`Module.save_checkpoint`)."""
@@ -257,4 +257,4 @@ class BucketingModule(BaseModule):
             arg_params, aux_params = self.get_params()
             default_mod.set_params(arg_params, aux_params, allow_missing=True)
         default_mod.save_checkpoint(prefix, epoch, save_optimizer_states,
-                                    nbatch=nbatch)
+                                    nbatch=nbatch, io_cursor=io_cursor)

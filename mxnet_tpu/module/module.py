@@ -92,7 +92,7 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
-                        nbatch=0):
+                        nbatch=0, io_cursor=None):
         """Save symbol json + params (+ optimizer states)
         (reference: module.py save_checkpoint → model.py:383).
 
@@ -117,7 +117,8 @@ class Module(BaseModule):
             logging.info("Saved optimizer state to \"%s\"", state_name)
         write_manifest(prefix, epoch,
                        {"params": param_name, "symbol": sym_file,
-                        "states": state_name}, nbatch=nbatch)
+                        "states": state_name}, nbatch=nbatch,
+                       extra={"io_cursor": io_cursor} if io_cursor else None)
         record_checkpoint_save(param_name, t0)
 
     # -- properties --------------------------------------------------------

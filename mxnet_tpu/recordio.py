@@ -223,6 +223,15 @@ class MXIndexedRecordIO(MXRecordIO):
         self.fidx = None
         super(MXIndexedRecordIO, self).__setstate__(d)
 
+    def shard_keys(self, num_parts, part_index):
+        """The keys of shard ``part_index`` of ``num_parts`` under the
+        input layer's partition contract (``io.shard_bounds``): disjoint,
+        exhaustive, sizes differing by at most one — the per-host split
+        every sharded iterator and pipeline source shares."""
+        from .io import shard_bounds
+        lo, hi = shard_bounds(len(self.keys), num_parts, part_index)
+        return self.keys[lo:hi]
+
     def read_idx(self, idx):
         self.seek(self.idx[idx])
         return self.read()

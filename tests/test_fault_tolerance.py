@@ -724,3 +724,145 @@ def test_serve_all_workers_dead_degrades_healthz():
     finally:
         fault.disarm()
         eng.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# PR 6: resumable shard cursor + async pipeline through fit
+# ---------------------------------------------------------------------------
+
+def _make_shuffled_iter(seed):
+    rng = np.random.RandomState(7)
+    X = rng.randn(N_SAMPLES, FEATURE).astype(np.float32)
+    y = rng.randint(0, CLASSES, (N_SAMPLES,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True,
+                             seed=seed)
+
+
+def test_fit_resume_seeks_shuffled_iterator_cursor(tmp_path, monkeypatch):
+    """Mid-epoch preemption with a SHUFFLED iterator: the manifest's io
+    cursor carries (epoch, batch, seed), resume seeks instead of
+    replaying, and — the distinguishing power of the cursor — an
+    iterator reconstructed with a DIFFERENT seed still reproduces the
+    interrupted stream bitwise, because the cursor's seed wins."""
+    monkeypatch.setenv("MXNET_CKPT_GRACE_S", "20")
+
+    def run(mod, it, losses=None, **kw):
+        cb = None
+        if losses is not None:
+            def cb(param):
+                losses.append((param.epoch, param.nbatch,
+                               param.eval_metric.get_name_value()[0][1]))
+        mx.random.seed(0)
+        mod.fit(it, num_epoch=3, optimizer="sgd",
+                optimizer_params=OPT_PARAMS,
+                initializer=mx.init.Uniform(0.1),
+                batch_end_callback=cb, **kw)
+
+    base_losses = []
+    m0 = _make_module()
+    run(m0, _make_shuffled_iter(5), losses=base_losses)
+    base = _params_of(m0)
+
+    prefix = str(tmp_path / "run")
+    hits = {"n": 0}
+
+    def _terminator(param):
+        hits["n"] += 1
+        if hits["n"] == 7:              # mid epoch 1 (5 batches/epoch)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    m1 = _make_module()
+    mx.random.seed(0)
+    m1.fit(_make_shuffled_iter(5), num_epoch=3, optimizer="sgd",
+           optimizer_params=OPT_PARAMS, initializer=mx.init.Uniform(0.1),
+           batch_end_callback=_terminator, checkpoint_prefix=prefix)
+    man = json.load(open(ckpt.manifest_path(prefix, 1)))
+    assert man["nbatch"] == 2
+    assert man["io_cursor"]["epoch"] == 1
+    assert man["io_cursor"]["batch"] == 2
+    assert man["io_cursor"]["seed"] == 5
+
+    res_losses = []
+    m2 = _make_module()
+    # DIFFERENT construction seed: replay would diverge; the seek must
+    # adopt the checkpointed seed
+    run(m2, _make_shuffled_iter(424242), losses=res_losses,
+        checkpoint_prefix=prefix, resume=True)
+    res = _params_of(m2)
+    for k in base:
+        assert np.array_equal(base[k], res[k]), \
+            "param %s diverged after seeked resume" % k
+    assert [(e, b) for e, b, _ in res_losses] == \
+        [(e, b) for e, b, _ in base_losses if (e, b) > (1, 1)]
+    assert [x for x in res_losses if x[0] >= 2] == \
+        [x for x in base_losses if x[0] >= 2]
+
+
+def test_fit_datapipeline_zero_recompiles_and_cursor_resume(tmp_path):
+    """fit fed by io.DataPipeline: (a) zero new XLA compiles per epoch
+    after the first epoch's warmup (the pipeline keeps shapes constant
+    — telemetry-asserted), (b) an interrupt + resume through the
+    manifest's DataPipeline cursor lands on the uninterrupted
+    trajectory bitwise even when the resumed pipeline is built with a
+    different seed."""
+    from mxnet_tpu.io import ArrayBatchSource, DataPipeline
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(N_SAMPLES, FEATURE).astype(np.float32)
+    y = rng.randint(0, CLASSES, (N_SAMPLES,)).astype(np.float32)
+
+    def make_pipe(seed):
+        return DataPipeline(
+            ArrayBatchSource(X, y, batch_size=BATCH, shuffle=True,
+                             seed=seed), num_workers=0)
+
+    def run(mod, pipe, losses=None, **kw):
+        cb = None
+        if losses is not None:
+            def cb(param):
+                losses.append((param.epoch, param.nbatch,
+                               param.eval_metric.get_name_value()[0][1]))
+        mx.random.seed(0)
+        mod.fit(pipe, num_epoch=3, optimizer="sgd",
+                optimizer_params=OPT_PARAMS,
+                initializer=mx.init.Uniform(0.1),
+                batch_end_callback=cb, **kw)
+
+    compiles = []
+    base_losses = []
+    m0 = _make_module()
+    pipe = make_pipe(5)
+    mx.random.seed(0)
+    m0.fit(pipe, num_epoch=3, optimizer="sgd", optimizer_params=OPT_PARAMS,
+           initializer=mx.init.Uniform(0.1),
+           batch_end_callback=lambda p: base_losses.append(
+               (p.epoch, p.nbatch, p.eval_metric.get_name_value()[0][1])),
+           epoch_end_callback=lambda *_a: compiles.append(
+               tm.compile_count()))
+    base = _params_of(m0)
+    # every compile happened in epoch 0; epochs 1 and 2 added none
+    assert compiles[1] == compiles[0]
+    assert compiles[2] == compiles[0]
+    # fit teardown closed the pipeline deterministically
+    assert pipe._stager is None or not pipe._stager.is_alive()
+
+    prefix = str(tmp_path / "run")
+    m1 = _make_module()
+    fault.arm("engine.step", step=9, kind="raise")   # mid epoch 1
+    with pytest.raises(FaultInjected):
+        run(m1, make_pipe(5), checkpoint_prefix=prefix)
+    fault.disarm()
+    man = json.load(open(ckpt.manifest_path(prefix, 1)))
+    assert man["io_cursor"]["kind"] == "DataPipeline"
+    assert man["io_cursor"]["source"]["seed"] == 5
+
+    res_losses = []
+    m2 = _make_module()
+    run(m2, make_pipe(31337), losses=res_losses,
+        checkpoint_prefix=prefix, resume=True)
+    res = _params_of(m2)
+    for k in base:
+        assert np.array_equal(base[k], res[k]), \
+            "param %s diverged after pipeline-cursor resume" % k
+    tail = [x for x in base_losses if x[0] >= 1]
+    assert res_losses == tail

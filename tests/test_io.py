@@ -1,4 +1,6 @@
 """Data iterator tests (reference: tests/python/unittest/test_io.py)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -111,3 +113,304 @@ def test_ndarrayiter_roll_over_multi_epoch():
             total += 4
         it.reset()
         assert total >= 8
+
+
+# ---------------------------------------------------------------------------
+# PR 6: sharding contract, seeded shuffles, async pipeline, resumable cursor
+# ---------------------------------------------------------------------------
+
+import mxnet_tpu.checkpoint as ckpt
+from mxnet_tpu import fault
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture(autouse=True)
+def _clean_io_faults():
+    fault.disarm()
+    yield
+    fault.disarm()
+
+
+def test_shard_bounds_partition_contract():
+    """Parts are disjoint, exhaustive, and balanced to within one
+    sample, for every (n, num_parts) shape including tails."""
+    for n in (0, 1, 7, 40, 41, 99):
+        for parts in (1, 2, 3, 7, 11):
+            seen = []
+            sizes = []
+            for p in range(parts):
+                lo, hi = io.shard_bounds(n, parts, p)
+                seen.extend(range(lo, hi))
+                sizes.append(hi - lo)
+            assert seen == list(range(n)), (n, parts)
+            assert max(sizes) - min(sizes) <= 1, (n, parts)
+    with pytest.raises(MXNetError):
+        io.shard_bounds(10, 3, 3)
+    with pytest.raises(MXNetError):
+        io.shard_bounds(10, 0, 0)
+
+
+def test_indexed_recordio_shard_keys_partition(tmp_path):
+    """MXIndexedRecordIO.shard_keys follows the shared partition
+    contract: concatenating the shards reproduces the key sequence
+    (disjoint + exhaustive + ordered), sizes balanced to within one —
+    including non-contiguous keys."""
+    from mxnet_tpu import recordio
+    rec, idx = str(tmp_path / "a.rec"), str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(11):
+        w.write_idx(i * 3, recordio.pack(
+            recordio.IRHeader(0, float(i), i * 3, 0), b"x%d" % i))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    parts = [r.shard_keys(4, p) for p in range(4)]
+    assert [k for part in parts for k in part] == list(r.keys)
+    assert max(len(p) for p in parts) - min(len(p) for p in parts) <= 1
+    # every sharded key is readable
+    h, _ = recordio.unpack(r.read_idx(parts[3][0]))
+    assert h.id == parts[3][0]
+    r.close()
+
+
+def test_ndarrayiter_sharding_disjoint_exhaustive():
+    data = np.arange(23).astype(np.float32).reshape(23, 1)
+    got = []
+    for p in range(3):
+        it = io.NDArrayIter(data, batch_size=2, num_parts=3, part_index=p,
+                            last_batch_handle="discard")
+        for b in it:
+            got.extend(b.data[0].asnumpy()[:, 0].tolist())
+    # discard drops at most batch_size-1 per part; everything kept is
+    # unique and the parts cover distinct ranges
+    assert len(got) == len(set(got))
+    assert len(got) >= 23 - 3 * 1
+
+
+def test_ndarrayiter_seed_private_and_deterministic():
+    """Seeded epoch shuffles replay exactly and never consume the
+    global NumPy stream."""
+    data = np.arange(40).astype(np.float32).reshape(40, 1)
+
+    def stream(seed, epochs=3):
+        it = io.NDArrayIter(data, batch_size=8, shuffle=True, seed=seed)
+        out = []
+        for _ in range(epochs):
+            out.append(np.concatenate(
+                [b.data[0].asnumpy()[:, 0] for b in it]))
+            it.reset()
+        return out
+
+    np.random.seed(123)
+    before = np.random.random_sample(4)
+    np.random.seed(123)
+    a = stream(5)
+    after = np.random.random_sample(4)
+    np.testing.assert_array_equal(before, after)   # global RNG untouched
+    b = stream(5)
+    for ea, eb in zip(a, b):
+        np.testing.assert_array_equal(ea, eb)
+    # different epochs permute differently
+    assert not np.array_equal(a[0], a[1])
+
+
+def test_ndarrayiter_cursor_seek_bitwise():
+    """restore_state seeks a fresh iterator (even one built with a
+    DIFFERENT seed) to the cursor and replays the interrupted stream
+    bit-for-bit — the seed travels with the cursor."""
+    data = np.arange(40).astype(np.float32).reshape(40, 1)
+    it = io.NDArrayIter(data, batch_size=8, shuffle=True, seed=5)
+    full = []
+    for epoch in range(3):
+        full.append([b.data[0].asnumpy().copy() for b in it])
+        it.reset()
+
+    it2 = io.NDArrayIter(data, batch_size=8, shuffle=True, seed=999)
+    it2.restore_state({"kind": "NDArrayIter", "epoch": 1, "batch": 2,
+                       "seed": 5, "shuffle": True, "num_data": 40})
+    rest = [b.data[0].asnumpy().copy() for b in it2]
+    ref = full[1][2:]
+    assert len(rest) == len(ref)
+    for a, b in zip(ref, rest):
+        np.testing.assert_array_equal(a, b)
+    # a cursor from a different stream is refused: wrong size, wrong
+    # batching, wrong shuffling, or a cursor of another iterator kind
+    with pytest.raises(MXNetError):
+        it2.restore_state({"epoch": 0, "batch": 0, "num_data": 39})
+    with pytest.raises(MXNetError):
+        it2.restore_state({"epoch": 0, "batch": 0, "batch_size": 4})
+    with pytest.raises(MXNetError):
+        it2.restore_state({"epoch": 0, "batch": 0, "shuffle": False})
+    with pytest.raises(MXNetError):
+        it2.restore_state({"kind": "DataPipeline", "epoch": 0,
+                           "batch": 0})
+    # roll_over carries cross-epoch state: no cursor, seek refused
+    it3 = io.NDArrayIter(data, batch_size=8,
+                         last_batch_handle="roll_over")
+    assert it3.checkpoint_state(0, 0) is None
+    with pytest.raises(MXNetError):
+        it3.restore_state({"epoch": 0, "batch": 0})
+
+
+def test_resize_iter_empty_after_reset_raises_clearly():
+    class _EmptyIter(io.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.provide_data = [io.DataDesc("data", (2, 2))]
+            self.provide_label = []
+
+        def iter_next(self):
+            return False
+
+        def next(self):
+            raise StopIteration
+
+    it = io.ResizeIter(_EmptyIter(), 3)
+    with pytest.raises(MXNetError, match="no batches after"):
+        list(it)
+
+
+def test_prefetching_iter_close_is_restartable():
+    data = np.arange(60).reshape(20, 3).astype(np.float32)
+    base = io.NDArrayIter(data, batch_size=4)
+    with io.PrefetchingIter(base) as it:
+        first = next(it)
+        np.testing.assert_allclose(first.data[0].asnumpy(), data[:4])
+        it.close()                      # idempotent with __exit__
+        assert not it.started
+        # a closed iterator respawns its threads on the next use
+        second = next(it)
+        np.testing.assert_allclose(second.data[0].asnumpy(), data[4:8])
+    assert not it.started
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def _double_augment(data_list, rng):
+    """Module-level so pipeline workers can pickle it; uses the
+    (seed, epoch, index)-keyed rng for a deterministic jitter."""
+    noise = rng.normal(size=data_list[0].shape).astype(np.float32)
+    return [data_list[0] * 2.0 + noise] + list(data_list[1:])
+
+
+def _pipe_stream(workers, seed=7, epochs=2, augment=None, shuffle=True,
+                 **kw):
+    data = np.arange(200, dtype=np.float32).reshape(50, 4)
+    label = np.arange(50, dtype=np.float32)
+    src = io.ArrayBatchSource(data, label, batch_size=8, shuffle=shuffle,
+                              seed=seed, augment_fn=augment, **kw)
+    out = []
+    with io.DataPipeline(src, num_workers=workers, prefetch=2) as p:
+        for _ in range(epochs):
+            for b in p:
+                out.append((b.data[0].asnumpy().copy(),
+                            b.label[0].asnumpy().copy(), b.pad))
+            p.reset()
+    return out
+
+
+def test_pipeline_multiworker_bitwise_equality():
+    """THE pipeline determinism claim: the multi-worker stream —
+    including seeded shuffles and per-batch augmentation RNG — is
+    bitwise-identical to the inline (workers=0) stream."""
+    inline = _pipe_stream(0, augment=_double_augment)
+    pooled = _pipe_stream(2, augment=_double_augment)
+    assert len(inline) == len(pooled) == 14
+    for (d0, l0, p0), (d2, l2, p2) in zip(inline, pooled):
+        assert p0 == p2
+        np.testing.assert_array_equal(d0, d2)
+        np.testing.assert_array_equal(l0, l2)
+
+
+def test_pipeline_shards_cover_stream():
+    parts = [_pipe_stream(0, shuffle=False, epochs=1, num_parts=3,
+                          part_index=p, last_batch_handle="discard",
+                          seed=0) for p in range(3)]
+    seen = [x for part in parts for (_d, l, _p) in part for x in l]
+    assert len(seen) == len(set(seen))           # disjoint
+    assert len(seen) >= 50 - 3 * 7               # exhaustive minus tails
+
+
+def test_pipeline_cursor_kill_resume_bitwise():
+    """Kill-at-batch-N drill at the iterator level: a fresh pipeline
+    (different seed) seeked to the cursor reproduces the uninterrupted
+    stream exactly, across the epoch boundary."""
+    full = _pipe_stream(0, seed=7, epochs=2)
+
+    data = np.arange(200, dtype=np.float32).reshape(50, 4)
+    label = np.arange(50, dtype=np.float32)
+    src = io.ArrayBatchSource(data, label, batch_size=8, shuffle=True,
+                              seed=7)
+    p1 = io.DataPipeline(src, num_workers=0)
+    for _ in range(3):
+        p1.next()
+    cur = p1.checkpoint_state(0, 3)
+    p1.close()                                    # "the process dies"
+
+    src2 = io.ArrayBatchSource(data, label, batch_size=8, shuffle=True,
+                               seed=31337)
+    p2 = io.DataPipeline(src2, num_workers=2)
+    p2.restore_state(cur)
+    rest = []
+    for _ in range(2):
+        for b in p2:
+            rest.append((b.data[0].asnumpy().copy(),
+                         b.label[0].asnumpy().copy(), b.pad))
+        p2.reset()
+    p2.close()
+    ref = full[3:]
+    assert len(rest) == len(ref)
+    for (da, la, pa), (db, lb, pb) in zip(ref, rest):
+        assert pa == pb
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
+    # stream-identity check: cursor over different data size refused
+    src3 = io.ArrayBatchSource(data[:40], label[:40], batch_size=8)
+    p3 = io.DataPipeline(src3, num_workers=0)
+    with pytest.raises(MXNetError):
+        p3.restore_state(cur)
+    p3.close()
+
+
+@pytest.mark.slow
+def test_pipeline_worker_crash_restarts_without_loss():
+    """An io.worker crash (SIGKILL-grade os._exit in the decode
+    process) restarts the pool in place; the consumer sees no lost, no
+    duplicated, and no reordered batch."""
+    from mxnet_tpu import telemetry as tm
+    inline = _pipe_stream(0, seed=3, epochs=1)
+
+    def val(): 
+        fam = tm.REGISTRY._families.get("io/worker_restarts_total")
+        return fam.value if fam is not None else 0
+
+    before = val()
+    fault.arm("io.worker", step=3, kind="crash")
+    try:
+        crashed = _pipe_stream(2, seed=3, epochs=1)
+    finally:
+        fault.disarm()
+    assert len(crashed) == len(inline) == 7
+    for (d0, l0, p0), (d2, l2, p2) in zip(inline, crashed):
+        assert p0 == p2
+        np.testing.assert_array_equal(d0, d2)
+        np.testing.assert_array_equal(l0, l2)
+    assert val() > before
+
+
+def test_pipeline_worker_restart_budget_enforced():
+    data = np.arange(200, dtype=np.float32).reshape(50, 4)
+    src = io.ArrayBatchSource(data, batch_size=8)
+    fault.arm("io.worker", step=1, kind="crash", count=99)
+    p = io.DataPipeline(src, num_workers=1, restart_budget=1)
+    try:
+        with pytest.raises(MXNetError, match="restart budget"):
+            list(p)
+        # giving up reclaims what the dead workers staged: nothing of
+        # this pipeline's shm namespace survives in /dev/shm
+        leaked = [f for f in os.listdir("/dev/shm")
+                  if f.startswith(p._shm_prefix)] \
+            if os.path.isdir("/dev/shm") else []
+        assert leaked == []
+    finally:
+        fault.disarm()
+        p.close()
