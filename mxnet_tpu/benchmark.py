@@ -922,6 +922,121 @@ def train_resume(steps=27, period=8, batch=64):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def dist_failover(rounds=3):
+    """Self-healing distributed-training numbers: (1) **server
+    restart → first ack** — a snapshotting sync PS is stopped and a
+    ``restore=True`` twin started on the same port while a live client
+    keeps pushing; banked as the time from starting the restore to the
+    client's first acked (retried) push, plus the full outage window
+    (stop → ack). (2) **worker rejoin → first contribution** — after
+    the rank is declared dead, a fresh client re-registers it
+    (membership epoch bump) and lands its first accepted push. Host
+    metrics: the PS tier is DCN/CPU-side by design."""
+    import shutil
+    import socket as _socket
+    import tempfile
+    import mxnet_tpu as mx
+    from .kvstore_server import KVStoreServer, send_msg, recv_msg
+
+    tmpdir = tempfile.mkdtemp(prefix="mx_dist_failover_")
+    snap = os.path.join(tmpdir, "kv.snap")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {"MXNET_TPU_PS_URI": "127.0.0.1",
+           "MXNET_TPU_PS_PORT": str(port),
+           "MXNET_TPU_RANK": "0", "MXNET_TPU_NUM_WORKERS": "1",
+           "MXNET_KV_BACKOFF_MS": "5", "MXNET_KV_RETRIES": "40",
+           "MXNET_KV_DEAD_S": "30"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    servers = []
+
+    def _start(restore):
+        deadline = time.time() + 30
+        while True:
+            try:
+                srv = KVStoreServer(port=port, num_workers=1,
+                                    sync_mode=True, snapshot_path=snap,
+                                    restore=restore, dead_timeout_s=0.5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        srv.start_background()
+        servers.append(srv)
+        return srv
+
+    kv = None
+    try:
+        _start(False)
+        kv = mx.kv.create("dist_sync")
+        grad = mx.nd.ones((256, 256))
+        kv.init("w", mx.nd.zeros((256, 256)))
+        kv.push("w", grad)
+        restart_ms, outage_ms = [], []
+        for _ in range(rounds):
+            kv._ps_call("STOP")
+            t_stop = time.time()
+            _start(True)
+            t_up = time.time()
+            kv.push("w", grad)          # rides the failover on retries
+            t_ack = time.time()
+            restart_ms.append((t_ack - t_up) * 1e3)
+            outage_ms.append((t_ack - t_stop) * 1e3)
+
+        rejoin_ms = []
+        for _ in range(rounds):
+            kv.close()                  # rank 0 leaves (heartbeat stops)
+            time.sleep(0.7)             # outlive the 0.5s liveness bound
+            probe = _socket.socket()
+            probe.connect(("127.0.0.1", port))
+            send_msg(probe, ("DEAD_NODES", None, None))
+            dead = recv_msg(probe)[1]
+            probe.close()
+            assert dead == [0], dead
+            t0 = time.time()
+            kv = mx.kv.create("dist_sync")      # HELLO: rejoin
+            kv.init("w", mx.nd.zeros((256, 256)))
+            kv.push("w", grad)                  # first contribution
+            rejoin_ms.append((time.time() - t0) * 1e3)
+
+        restart_s = sum(restart_ms) / len(restart_ms) / 1e3
+        extra = {
+            "restart_to_first_ack_ms": round(
+                sum(restart_ms) / len(restart_ms), 2),
+            "outage_to_first_ack_ms": round(
+                sum(outage_ms) / len(outage_ms), 2),
+            "rejoin_to_first_contribution_ms": round(
+                sum(rejoin_ms) / len(rejoin_ms), 2),
+            "rounds": rounds,
+            "key_mb": round(grad.asnumpy().nbytes / 1e6, 3),
+        }
+        return 1.0 / restart_s, extra
+    finally:
+        # best-effort teardown even on a mid-run failure: a leaked
+        # server thread (bound port) or client heartbeat would pollute
+        # every later bench job in this process
+        if kv is not None:
+            try:
+                if not kv._closed:
+                    kv._ps_call("STOP")
+            except Exception:
+                pass
+            kv.close()
+        for srv in servers:
+            srv.stop()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def train_mlp(batch=64, iters=50, steps_per_call=32):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run.
@@ -1389,6 +1504,14 @@ def _job_mlp_train_fused():
                    "img/s (batch 64, fp32, fused module step)", x)
 
 
+def _job_dist_failover():
+    v, x = dist_failover()
+    return persist("dist_failover_recovery_per_sec", v,
+                   "recoveries/s (PS snapshot restore -> first acked "
+                   "push; restart/outage/rejoin latencies in extras)",
+                   x, host_metric=True)
+
+
 def _job_inception_train():
     v, x = train_inception(32, "float32")
     return persist("inception-v3_train_img_per_sec", v,
@@ -1472,6 +1595,7 @@ def _make_infer_job(model, dtype, batch=32):
 JOBS = {
     "trace_overhead": _job_trace_overhead,
     "train_resume": _job_train_resume,
+    "dist_failover": _job_dist_failover,
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
@@ -1502,6 +1626,7 @@ JOB_PRIORITY = [
     "mlp_train_fused",
     "trace_overhead",
     "train_resume",
+    "dist_failover",
     "predictor_serve",
     "data_pipeline",
     "data_pipeline_native",

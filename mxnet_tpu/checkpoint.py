@@ -483,3 +483,76 @@ class TrainingSupervisor(object):
         kwargs.setdefault("save_optimizer_states", self._save_states)
         kwargs.setdefault("resume", True)
         return self._module.fit(train_data, **kwargs)
+
+    # exit codes that mean "the platform killed the process", not "the
+    # training script is broken": raw signal deaths (Popen reports them
+    # as -signum) and the 128+signum shell convention for SIGKILL
+    # (preemption / OOM-killer) and SIGTERM (preemption notice)
+    _PREEMPT_RCS = frozenset((137, 143))
+
+    @staticmethod
+    def is_preemption_rc(rc):
+        """Whether exit code ``rc`` is a preemption-grade death (signal
+        kill) rather than a genuine failure (an uncaught exception's
+        nonzero exit)."""
+        return rc < 0 or rc in TrainingSupervisor._PREEMPT_RCS
+
+    @staticmethod
+    def supervise(cmd, max_failures=None, relaunch_delay_s=1.0,
+                  env=None, cwd=None, logger=None):
+        """Re-run ``cmd`` (the re-run-same-command pattern: the script
+        inside uses ``fit(resume=True)`` / a ``--restore`` server) until
+        it exits cleanly, triaging exits instead of treating every
+        crash the same:
+
+        * rc 0 — done; returns 0.
+        * **preemption-grade** (negative rc = signal death, or 137/143
+          = SIGKILL/SIGTERM) — the platform killed the process; always
+          relaunch, this is the *normal* failure mode on preemptible
+          TPU VMs and must never exhaust a failure budget.
+        * any other nonzero rc — a genuine failure (an uncaught
+          exception): relaunching replays the same bug, so stop after
+          ``max_failures`` consecutive failures (default
+          ``MXNET_SUPERVISOR_MAX_FAILURES``) and return the last rc.
+
+        A successful-looking relaunch (preemption or clean progress)
+        resets the consecutive-failure count. Relaunches count in
+        ``supervisor/relaunches_total{reason}``.
+        """
+        import logging
+        import subprocess
+        import time as _time
+        from . import telemetry as _tm
+        from .config import get as _cfg
+        log = logger or logging
+        if max_failures is None:
+            max_failures = int(_cfg("MXNET_SUPERVISOR_MAX_FAILURES"))
+        failures = 0
+        while True:
+            rc = subprocess.call(cmd, env=env, cwd=cwd)
+            if rc == 0:
+                return 0
+            if TrainingSupervisor.is_preemption_rc(rc):
+                reason = "preempt"
+                failures = 0
+                log.info("supervised command died preemption-grade "
+                         "(rc %d, signal kill); relaunching", rc)
+            else:
+                reason = "failure"
+                failures += 1
+                if failures >= max_failures:
+                    log.error(
+                        "supervised command failed %d consecutive "
+                        "time(s) with genuine (non-signal) exits, last "
+                        "rc %d; giving up (MXNET_SUPERVISOR_MAX_"
+                        "FAILURES=%d)", failures, rc, max_failures)
+                    return rc
+                log.warning("supervised command failed (rc %d, %d/%d "
+                            "failures); relaunching", rc, failures,
+                            max_failures)
+            if _tm._enabled:
+                _tm.counter("supervisor/relaunches_total",
+                            "Supervised training command relaunches",
+                            ("reason",)).labels(reason).inc()
+            if relaunch_delay_s > 0:
+                _time.sleep(relaunch_delay_s)

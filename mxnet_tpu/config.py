@@ -124,6 +124,39 @@ VARS = {
                             "Base kvstore retry backoff; attempt n "
                             "sleeps ~base*2^(n-1) with full jitter, "
                             "capped by the remaining op deadline."),
+    "MXNET_KV_DEAD_S": (float, 60.0,
+                        "Liveness timeout for PS-mode workers: a rank "
+                        "with no traffic (RPCs or heartbeats) for this "
+                        "many seconds is declared dead. dist_sync rounds "
+                        "and barriers then FAIL FAST with an MXNetError "
+                        "naming the dead rank(s) instead of hanging; "
+                        "dist_async membership just shrinks until the "
+                        "rank rejoins. Clients heartbeat at a third of "
+                        "this interval."),
+    "MXNET_KV_SNAPSHOT_PATH": (str, "",
+                               "KVStore server state snapshot file "
+                               "(store, barrier generation, RPC dedup "
+                               "commit records, membership epochs, "
+                               "server-side optimizer state). Empty "
+                               "disables snapshots; set it to make the "
+                               "server restartable with --restore after "
+                               "a SIGKILL."),
+    "MXNET_KV_SNAPSHOT_S": (float, 10.0,
+                            "Async-mode snapshot throttle: at most one "
+                            "server snapshot per this many seconds "
+                            "(updates applied since the last snapshot "
+                            "are the documented failover loss window). "
+                            "Sync mode ignores it — every committed "
+                            "round snapshots before acking, so a "
+                            "restored sync run is bitwise-identical."),
+    "MXNET_SUPERVISOR_MAX_FAILURES": (int, 3,
+                                      "TrainingSupervisor.supervise "
+                                      "stop-bound for GENUINE failures "
+                                      "(nonzero exit from an uncaught "
+                                      "exception). Preemption-grade "
+                                      "deaths (signal kills, rc 137/"
+                                      "143) relaunch without burning "
+                                      "this budget."),
     "MXNET_TRACING": (bool, True,
                       "End-to-end span tracing (tracing.py): request/"
                       "step timelines propagated across serve, "

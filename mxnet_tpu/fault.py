@@ -15,6 +15,10 @@ deterministically on the Nth hit of their point and can
 * ``raise``      — raise :class:`FaultInjected` (a ``MXNetError``),
 * ``transient``  — raise :class:`TransientKVError` (retryable by the
   kvstore transport),
+* ``partition``  — raise :class:`PartitionError`: a network partition.
+  Distinct from ``transient``: the peer sees the *connection drop with
+  no response* (the kvstore server closes the socket without replying;
+  the client side looks like a vanished server), not an error payload,
 * ``delay``      — sleep ``delay_ms`` (default 10 ms) and continue,
 * ``crash``      — ``os._exit(137)``: a SIGKILL-grade hard crash, no
   ``atexit``, no ``finally`` blocks — exactly what preemption does.
@@ -38,6 +42,12 @@ ckpt.pre_rename     after the temp file is durable, before ``os.replace``
 kv.push             entry of a kvstore push (before any mutation)
 kv.pull             entry of a kvstore pull
 kv.server           entry of a kvstore-server request handler
+kv.server.snapshot  inside the kvstore server's state snapshot, after
+                    the mutation it commits was applied in memory but
+                    before the snapshot file is written (the failover
+                    window a crash here exercises)
+kv.client.reconnect kvstore client (re-)dial to the parameter server,
+                    before the socket connect
 engine.step         start of each training step in ``BaseModule.fit``
                     (hits count across epochs)
 serve.worker        top of each serve-worker loop iteration
@@ -53,8 +63,8 @@ import time
 
 from .base import MXNetError
 
-__all__ = ["FaultInjected", "TransientKVError", "POINTS", "arm", "disarm",
-           "arming", "inject", "hits", "armed", "reset"]
+__all__ = ["FaultInjected", "TransientKVError", "PartitionError", "POINTS",
+           "arm", "disarm", "arming", "inject", "hits", "armed", "reset"]
 
 
 class FaultInjected(MXNetError):
@@ -67,7 +77,16 @@ class TransientKVError(MXNetError):
     worth another attempt; anything else propagates immediately."""
 
 
-KINDS = ("raise", "transient", "delay", "crash")
+class PartitionError(MXNetError, ConnectionError):
+    """An injected network partition: the connection is DROPPED with no
+    response, unlike ``transient`` which delivers a retryable error
+    payload. Subclasses :class:`ConnectionError` so the kvstore client
+    retry loop treats it exactly like a real peer disappearance; the
+    kvstore server's connection loop translates it into closing the
+    client's socket without replying."""
+
+
+KINDS = ("raise", "transient", "partition", "delay", "crash")
 
 # point -> short doc; inject() on an unregistered point is an error so
 # the table in docs/fault_tolerance.md can never silently drift from
@@ -80,6 +99,11 @@ POINTS = {
     "kv.push": "kvstore push entry, before any store mutation",
     "kv.pull": "kvstore pull entry",
     "kv.server": "kvstore server request handler entry",
+    "kv.server.snapshot": "kvstore server state snapshot: committed "
+                          "mutation applied in memory, snapshot file "
+                          "not yet written",
+    "kv.client.reconnect": "kvstore client (re-)dial to the parameter "
+                           "server, before the socket connect",
     "engine.step": "start of a training step in BaseModule.fit "
                    "(hit count spans epochs)",
     "serve.worker": "top of each serve-worker loop iteration",
@@ -197,6 +221,9 @@ def inject(point):
     if kind == "transient":
         raise TransientKVError(
             "injected transient fault at %r (hit %d)" % (point, hit))
+    if kind == "partition":
+        raise PartitionError(
+            "injected network partition at %r (hit %d)" % (point, hit))
     raise FaultInjected("injected fault at %r (hit %d)" % (point, hit))
 
 

@@ -102,7 +102,15 @@ class KVStore(object):
         self._sock = None
         self._sock_lock = None
         self._ps_host = None
-        self._seq = 0
+        self._closed = False
+        # mutating-RPC sequence numbers start from a random per-client
+        # base: the server's at-most-once cache (and its snapshot-
+        # restored commit records) matches on seq equality per rank, so
+        # a RESTARTED worker process — a fresh client whose counter
+        # would otherwise also start at 1 — must never collide with its
+        # predecessor's committed seqs and have its first mutating RPC
+        # swallowed as a duplicate
+        self._seq = int.from_bytes(os.urandom(6), "big") << 16
         if kv_type.startswith("dist") and os.environ.get("MXNET_TPU_PS_URI"):
             self._connect_ps()
 
@@ -114,23 +122,32 @@ class KVStore(object):
         intra-pod path stays on XLA allreduce."""
         import os
         import threading
+        from .config import get as _cfg
         self._ps_host = os.environ["MXNET_TPU_PS_URI"]
         self._ps_port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
         self._env_rank = int(os.environ.get("MXNET_TPU_RANK", "0"))
         self._env_nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
         self._ps_token = os.environ.get("MXNET_TPU_PS_TOKEN", "")
+        self._dead_s = float(_cfg("MXNET_KV_DEAD_S"))
+        self._server_inc = None      # last observed server incarnation
+        self._member_epoch = 1       # this rank's membership epoch
         self._sock_lock = threading.Lock()
         with self._sock_lock:
             self._dial()
+        self._start_heartbeat()
 
     def _dial(self):
         """(Re-)establish the PS connection: socket (with the
         ``MXNET_KV_TIMEOUT_MS`` deadline so a dead server can never hang
-        an op), auth, and rank-registration HELLO. Caller holds
-        ``_sock_lock``."""
+        an op), auth, and rank-registration HELLO. The HELLO response
+        names the server's incarnation — a change means the server
+        restarted (failover): this rank is re-registered here and the
+        retry loop replays any in-flight RPC under its original
+        sequence number. Caller holds ``_sock_lock``."""
         import socket
         from .config import get as _cfg
         from .kvstore_server import send_msg, recv_msg
+        _fault.inject("kv.client.reconnect")
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -145,20 +162,129 @@ class KVStore(object):
             sock.connect((self._ps_host, self._ps_port))
             if self._ps_token:
                 send_msg(sock, ("AUTH", None, self._ps_token))
-                status, payload = recv_msg(sock)
+                status, payload = recv_msg(sock)[:2]
                 if status != "OK":
                     raise MXNetError(
                         "kvstore server auth failed: %s" % payload)
-            # register this rank for liveness tracking
+            # register this rank for liveness tracking / membership
             send_msg(sock, ("HELLO", None, self._env_rank))
-            status, payload = recv_msg(sock)
+            resp = recv_msg(sock)
+            status, payload = resp[0], resp[1]
             if status != "OK":
                 raise MXNetError(
                     "kvstore server rejected HELLO: %s" % payload)
+            if isinstance(payload, dict):
+                self._member_epoch = int(payload.get("member_epoch", 1))
+                self._note_incarnation(payload.get("incarnation"))
+            elif len(resp) > 2:
+                self._note_incarnation(resp[2])
         except BaseException:
             sock.close()
             raise
         self._sock = sock
+
+    def _note_incarnation(self, inc):
+        """Track the server incarnation carried in every response; a
+        change mid-session is a completed failover — counted and
+        logged, with the at-most-once seq numbers guaranteeing the
+        replayed in-flight RPCs apply exactly once."""
+        if inc is None:
+            return
+        if self._server_inc is None:
+            self._server_inc = inc
+        elif inc != self._server_inc:
+            old, self._server_inc = self._server_inc, inc
+            if _tm._enabled:
+                _tm.counter(
+                    "kvstore/server_failovers_total",
+                    "KVStore server restarts observed by this client "
+                    "(incarnation changes)").inc()
+            import logging
+            logging.warning(
+                "kvstore server restarted (incarnation %s -> %s); rank "
+                "%d re-registered, in-flight RPCs replay under their "
+                "original sequence numbers", old, inc, self._env_rank)
+
+    def _start_heartbeat(self):
+        """Background liveness beacon: HELLO every ``MXNET_KV_DEAD_S/3``
+        seconds on a DEDICATED connection, so a rank parked in a long
+        sync round (or a long local compile) on the main socket never
+        reads as dead. Dies with the process — which is exactly the
+        signal the server's liveness timeout exists to catch."""
+        import threading
+        self._hb_stop = threading.Event()
+        interval = max(0.2, self._dead_s / 3.0)
+
+        def _beat():
+            from .kvstore_server import send_msg, recv_msg
+            sock = None
+            while not self._hb_stop.wait(interval):
+                try:
+                    if sock is None:
+                        sock = self._hb_dial()
+                    send_msg(sock, ("HELLO", None, self._env_rank))
+                    recv_msg(sock)
+                except Exception:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    sock = None   # redial on the next beat
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=_beat, daemon=True,
+                             name="mx-kv-heartbeat-%d" % self._env_rank)
+        t.start()
+        self._hb_thread = t
+
+    def _hb_dial(self):
+        import socket
+        from .kvstore_server import send_msg, recv_msg
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(max(1.0, self._dead_s / 3.0))
+        sock.connect((self._ps_host, self._ps_port))
+        if self._ps_token:
+            send_msg(sock, ("AUTH", None, self._ps_token))
+            if recv_msg(sock)[0] != "OK":
+                sock.close()
+                raise MXNetError("heartbeat auth failed")
+        return sock
+
+    def close(self):
+        """Tear down the PS transport (heartbeat thread + socket) and
+        make the store TERMINAL: further PS ops raise instead of
+        silently redialing — a resurrected connection would run without
+        its liveness heartbeat and read as a dead rank mid-round. Safe
+        to call twice; a no-op for local/device stores."""
+        if self._ps_host is not None:
+            # only a PS-backed store becomes terminal; local/device
+            # stores have no transport to tear down
+            self._closed = True
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
+        if self._sock is not None:
+            with self._sock_lock:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+
+    @property
+    def member_epoch(self):
+        """This rank's membership epoch at the server (PS mode): 1 on
+        first registration, +1 per re-admission after being declared
+        dead — >1 identifies a REJOINING worker, which should pull the
+        cluster's current weights instead of pushing its own
+        initializer output (model._initialize_kvstore does)."""
+        return getattr(self, "_member_epoch", 1)
 
     def _ps_call(self, op, key=None, value=None):
         """One PS RPC under the retry policy. Mutating ops carry a
@@ -173,8 +299,20 @@ class KVStore(object):
             "ps_" + op.lower(),
             lambda: self._ps_call_once(op, key, value, seq))
 
+    def _check_open(self, op):
+        """A closed PS store is TERMINAL: with its socket gone the op
+        routing would silently fall back to LOCAL-store semantics (and
+        a resurrected connection would run without its liveness
+        heartbeat), so every op refuses instead."""
+        if self._closed:
+            raise MXNetError(
+                "kvstore %s on a closed store: close() tore down the "
+                "PS transport (heartbeat included); create a new "
+                "KVStore to rejoin" % op)
+
     def _ps_call_once(self, op, key, value, seq):
         from .kvstore_server import send_msg, recv_msg
+        self._check_open(op.lower())
         # the active span context (the kv.attempt span) rides in the
         # RPC payload, so server-side handling — and the seq-cache
         # replay shield — surfaces under the client's trace
@@ -187,12 +325,16 @@ class KVStore(object):
             send_msg(self._sock, msg)
             resp = recv_msg(self._sock)
         status, payload = resp[0], resp[1]
-        if len(resp) > 2 and resp[2]:
+        if len(resp) > 2:
+            # every response names the server incarnation: restart
+            # detection even when the TCP connection survived
+            self._note_incarnation(resp[2])
+        if len(resp) > 3 and resp[3]:
             # (proc_token, server_now, spans) recorded for this RPC;
             # graft() deduplicates on span id (a cache-replayed response
             # cannot double-count them) and rebases an out-of-process
             # server's perf_counter epoch onto ours via the clock pair
-            token, server_now, spans = resp[2]
+            token, server_now, spans = resp[3]
             _tr.graft(spans,
                       clock=(token, server_now, _tm.monotonic()))
         if status == "RETRY":
@@ -308,6 +450,7 @@ class KVStore(object):
         The PS INIT RPC runs under the transport retry policy and
         precedes the local store mutation, so a retried init never trips
         the double-init check."""
+        self._check_open("init")
         with _tr.child_span("kv.init"):
             keys, vals = _ctype_key_value(key, value)
             for k, vlist in zip(keys, vals):
@@ -339,6 +482,7 @@ class KVStore(object):
         return ret
 
     def _push_impl(self, key, value, priority=0):
+        self._check_open("push")
         _fault.inject("kv.push")
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
@@ -408,6 +552,7 @@ class KVStore(object):
         return ret
 
     def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
+        self._check_open("pull")
         _fault.inject("kv.pull")
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
@@ -436,6 +581,7 @@ class KVStore(object):
         """Pull only the rows in ``row_ids`` (reference: kvstore.py
         row_sparse_pull; sparse embedding workflows). Dense rows are
         gathered host-side until row_sparse storage lands."""
+        self._check_open("row_sparse_pull")
         assert out is not None and row_ids is not None
         keys, outs = _ctype_key_value(key, out)
         rids, _ = _ctype_key_value(row_ids, row_ids)
@@ -516,6 +662,7 @@ class KVStore(object):
         runs the same update locally, which is semantically identical for
         sync mode)."""
         from .optimizer import get_updater
+        self._check_open("set_optimizer")
         self._optimizer = optimizer
         if self._sock is not None:
             # ship the optimizer to the server, which then runs updates
@@ -536,6 +683,7 @@ class KVStore(object):
         hop: worker→server in PS mode, per-contribution quantization in
         local/allreduce mode."""
         from .gradient_compression import create_compressor
+        self._check_open("set_gradient_compression")
         self._compression_params = dict(compression_params)
         self._compressor = create_compressor(self._compression_params)
         if self._sock is not None:
@@ -544,9 +692,14 @@ class KVStore(object):
     # -- sync --------------------------------------------------------------
     def barrier(self):
         """Global barrier (reference: kvstore.py _barrier → ps
-        Postoffice::Barrier)."""
+        Postoffice::Barrier). In PS mode a dead rank fails the barrier
+        fast with an :class:`MXNetError` naming the rank(s) — never a
+        hang; the ``kv.barrier_wait`` span times how long this rank
+        sat at the rendezvous (straggler forensics)."""
+        self._check_open("barrier")
         if self._sock is not None:
-            self._ps_call("BARRIER")
+            with _tr.child_span("kv.barrier_wait"):
+                self._ps_call("BARRIER")
             self._barrier_count += 1
             return
         import jax
@@ -556,11 +709,14 @@ class KVStore(object):
                 "kvstore_barrier_%d" % self._barrier_count)
         self._barrier_count += 1
 
-    def num_dead_node(self, node_id=0, timeout=60):
-        """Count of workers presumed dead: no traffic for ``timeout``
-        seconds (reference: include/mxnet/kvstore.h:353 ps-lite
-        heartbeat liveness). 0 outside PS mode — XLA-collective workers
-        fail as a unit, there is no partial-death state to query."""
+    def num_dead_node(self, node_id=0, timeout=None):
+        """Count of workers presumed dead: no traffic (RPCs or
+        heartbeats) for ``timeout`` seconds, default the cluster's
+        ``MXNET_KV_DEAD_S`` (reference: include/mxnet/kvstore.h:353
+        ps-lite heartbeat liveness). 0 outside PS mode —
+        XLA-collective workers fail as a unit, there is no
+        partial-death state to query."""
+        self._check_open("num_dead_node")
         if self._sock is None:
             return 0
         return len(self._ps_call("DEAD_NODES", None, timeout))

@@ -8,9 +8,9 @@ TPU-native split of responsibilities: *synchronous* data-parallel
 gradient exchange rides XLA allreduce over ICI (see kvstore.py /
 parallel.trainer) — no server round-trip. What still needs a host-side
 parameter server is the DCN tier: asynchronous updates, sparse
-embedding pulls, and cross-pod coordination. This server provides that
-tier as a threaded TCP service speaking a length-prefixed pickle
-protocol:
+embedding pulls, elastic membership, and cross-pod coordination. This
+server provides that tier as a threaded TCP service speaking a
+length-prefixed pickle protocol:
 
   INIT / PUSH / PULL / BARRIER / SET_OPTIMIZER / SET_COMPRESSION / STOP
 
@@ -21,6 +21,25 @@ installed — the reference's DataHandleDefault behavior used by its
 dist tests). Async mode (``dist_async``): every push updates
 immediately — stragglers never block (kvstore.cc:55-57 semantics).
 
+Self-healing (the ps-lite node-recovery analog, kvstore.h:353):
+
+* **Failover** — with a snapshot path configured the server
+  crash-consistently snapshots its state (store, barrier generation,
+  per-rank RPC-dedup commit records, membership epochs, server-side
+  optimizer state) through ``checkpoint.atomic_writer``. In sync mode
+  every committed round snapshots BEFORE any worker is acked, so an
+  acked update is never lost and an unacked one is resent and
+  deduplicated — a restarted ``--restore`` server resumes bitwise.
+  Async mode throttles snapshots to ``MXNET_KV_SNAPSHOT_S`` (the
+  documented failover staleness window). Every response carries the
+  server's **incarnation id**; clients detect a restart, re-register,
+  and replay in-flight RPCs under their original sequence numbers.
+* **Elastic membership** — rank liveness from RPC traffic plus client
+  heartbeats (``MXNET_KV_DEAD_S``). A dead rank fails sync rounds and
+  barriers FAST with an error naming the rank(s) instead of hanging;
+  in async mode workers may leave and rejoin (HELLO re-registers,
+  bumping the rank's membership epoch) without blocking anyone.
+
 Roles resolve from env like the reference's DMLC_ROLE:
 ``MXNET_TPU_ROLE`` in {server, worker, scheduler},
 ``MXNET_TPU_PS_URI``/``MXNET_TPU_PS_PORT``, ``MXNET_TPU_NUM_WORKERS``,
@@ -28,23 +47,29 @@ Roles resolve from env like the reference's DMLC_ROLE:
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import select
 import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
 from . import fault as _fault
 from . import telemetry as _tm
 from . import tracing as _tr
-from .fault import FaultInjected, TransientKVError
+from .base import MXNetError
+from .fault import FaultInjected, PartitionError, TransientKVError
 
 __all__ = ["KVStoreServer", "send_msg", "recv_msg", "serve_forever"]
 
 _LEN = struct.Struct("!Q")
+_SNAP_MAGIC = b"MXKVSNAP"
+_SNAP_FORMAT = 1
 
 # ops that mutate server state; their RPCs carry a client-assigned
 # sequence number and are deduplicated per rank (at-most-once apply
@@ -73,16 +98,34 @@ def recv_msg(sock):
     return pickle.loads(_recv_exact(sock, n))
 
 
+def _conn_dead(conn):
+    """True when ``conn``'s peer is gone. Only valid while the peer is
+    awaiting OUR response (strict request/response protocol): a
+    readable socket mid-handle means EOF or RST, never a real
+    message."""
+    try:
+        readable, _, _ = select.select([conn], [], [], 0)
+        if not readable:
+            return False
+        return conn.recv(1, socket.MSG_PEEK) == b""
+    except (OSError, ValueError):
+        return True
+
+
 class KVStoreServer(object):
     """Threaded PS: one handler thread per worker connection."""
 
     def __init__(self, port=0, num_workers=1, sync_mode=True,
-                 bind_addr=None, token=None):
+                 bind_addr=None, token=None, snapshot_path=None,
+                 snapshot_every_s=None, restore=False,
+                 dead_timeout_s=None):
+        from .config import get as _cfg
         self._store = {}
-        self._pending = {}          # key -> {"sum": arr, "count": int}
+        self._pending = {}          # key -> {"sum", "count", "contribs"}
         self._versions = {}
         self._updater = None
         self._compressor = None
+        self._compression_params = None
         self._num_workers = num_workers
         self._sync = sync_mode
         # The wire format is pickle: auth is a mandatory shared token for
@@ -98,20 +141,316 @@ class KVStoreServer(object):
         self._lock = threading.Lock()
         self._round_done = threading.Condition(self._lock)
         # per-rank RPC dedup: rank -> {"seq", "done", "resp"} for the
-        # most recent mutating RPC (see _client_loop)
+        # most recent mutating RPC (see _client_loop). Bounded at one
+        # entry per rank: a newer seq evicts the acked predecessor.
         self._seq_cond = threading.Condition()
         self._rank_rpc = {}
         self._barrier_waiting = 0
         self._barrier_gen = 0
-        import time as _t
-        self._start_time = _t.monotonic()
+        self._barrier_contribs = []  # [(rank, seq, t_arrival)] this gen
+        self._start_time = time.monotonic()
         self._last_seen = {}        # rank -> monotonic seconds
+        self._member_epoch = {}     # rank -> registration count
+        self._dead_declared = set()  # ranks currently declared dead
+        self._handling = {}         # rank -> in-flight handler count
+        self._applied_seq = {}      # rank -> last committed mutating seq
+        # failover identity: a fresh server draws a random incarnation,
+        # a restored one continues the snapshot's + 1 — every response
+        # carries it so clients can tell "same server" from "restarted"
+        self.incarnation = int.from_bytes(os.urandom(4), "big")
+        self._snapshot_path = snapshot_path if snapshot_path is not None \
+            else (_cfg("MXNET_KV_SNAPSHOT_PATH") or None)
+        self._snapshot_every_s = float(
+            _cfg("MXNET_KV_SNAPSHOT_S") if snapshot_every_s is None
+            else snapshot_every_s)
+        self._dead_s = float(_cfg("MXNET_KV_DEAD_S") if dead_timeout_s
+                             is None else dead_timeout_s)
+        self._wait_tick = min(1.0, max(0.05, self._dead_s / 4.0))
+        self._last_snapshot = time.monotonic()
+        if restore:
+            self._restore_snapshot()
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind_addr, port))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
+
+    # -- snapshot / restore (failover) -------------------------------------
+    def _commit_locked(self, rank, seq):
+        """Record that ``rank``'s mutating RPC ``seq`` is applied. The
+        map travels in every snapshot and reseeds the dedup cache on
+        restore, so a resent RPC whose first copy committed before the
+        crash replays its ack instead of re-applying."""
+        if rank is not None and seq is not None:
+            self._applied_seq[rank] = seq
+
+    def _commit_round_locked(self, contribs, straggler=True):
+        """Commit every contributor of a completed sync round / barrier
+        and expose the round's straggler profile: gauge = how long the
+        round had to wait for each rank after the first arrival."""
+        if not contribs:
+            return
+        t_first = min(c[2] for c in contribs)
+        for c in contribs:
+            rank, seq, t = c[0], c[1], c[2]
+            self._commit_locked(rank, seq)
+            if straggler and rank is not None and _tm._enabled:
+                _tm.gauge("kvstore/straggler_seconds",
+                          "Seconds after the round's first push that "
+                          "this rank's contribution arrived (the round "
+                          "completes at the max over ranks)",
+                          ("rank",)).labels(str(rank)).set(t - t_first)
+
+    def _snapshot_locked(self, force=False):
+        """Crash-consistent state snapshot via the atomic
+        write-temp→fsync→rename path. Called with ``self._lock`` held,
+        in the SAME critical section as the mutation it commits and
+        BEFORE any worker is acked — in sync mode that makes the
+        snapshot the round's commit record (acked ⇒ snapshotted,
+        unsnapshotted ⇒ unacked ⇒ the client resends). Async mode
+        throttles to one snapshot per ``MXNET_KV_SNAPSHOT_S`` unless
+        forced. A snapshot that fails to write degrades (logged +
+        counted), it never fails the RPC whose apply already
+        happened.
+
+        Cost model: each snapshot pickles the WHOLE store under the
+        server lock, so sync-mode failover writes O(total state) per
+        committed round per key — and key INITs snapshot too (an acked
+        INIT lost on failover would KeyError every later push of the
+        key; an init burst of N keys costs N snapshots of the growing
+        store, one-time). That is correctness-first by design
+        and sized for this server's role — the DCN *coordination* tier
+        (async/elastic updates, sparse rows, barriers; ROADMAP keeps
+        bulk sync gradient traffic on XLA collectives). Do not enable
+        sync snapshots for a store holding bulk model weights; an
+        incremental commit log is the upgrade path if that need
+        appears."""
+        if not self._snapshot_path:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < self._snapshot_every_s:
+            return False
+        t0 = time.perf_counter()
+        try:
+            _fault.inject("kv.server.snapshot")
+            state = {
+                "format": _SNAP_FORMAT,
+                "incarnation": self.incarnation,
+                "sync": self._sync,
+                "num_workers": self._num_workers,
+                "store": {k: np.asarray(v)
+                          for k, v in self._store.items()},
+                "versions": dict(self._versions),
+                "barrier_gen": self._barrier_gen,
+                "applied_seq": dict(self._applied_seq),
+                "member_epoch": dict(self._member_epoch),
+                "updater_states": (
+                    self._updater.get_states(dump_optimizer=True)
+                    if self._updater is not None else None),
+                "compression_params": self._compression_params,
+            }
+            payload = pickle.dumps(state,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            from .checkpoint import atomic_writer
+            with atomic_writer(self._snapshot_path) as f:
+                f.write(_SNAP_MAGIC)
+                f.write(_LEN.pack(len(payload)))
+                f.write(struct.pack("!I", zlib.crc32(payload)
+                                    & 0xFFFFFFFF))
+                f.write(payload)
+        except Exception:
+            logging.exception(
+                "kvstore server snapshot to %r failed; state kept in "
+                "memory, failover falls back to the previous snapshot",
+                self._snapshot_path)
+            if _tm._enabled:
+                _tm.counter("kvstore/snapshot_failures_total",
+                            "Server state snapshots that failed to "
+                            "write").inc()
+            return False
+        self._last_snapshot = now
+        if _tm._enabled:
+            _tm.counter("kvstore/snapshots_total",
+                        "Server state snapshots written").inc()
+            _tm.histogram("kvstore/snapshot_seconds",
+                          "Wall time of one server state snapshot "
+                          "(serialize + atomic write)").observe(
+                time.perf_counter() - t0)
+        return True
+
+    def _restore_snapshot(self):
+        """Resume from the snapshot at ``self._snapshot_path``. A
+        missing file starts fresh with a warning (first launch of a
+        supervised server); a torn or corrupt file raises an
+        ``MXNetError`` naming exactly what failed — silently starting
+        empty would discard state the operator believes is saved."""
+        path = self._snapshot_path
+        if not path or not os.path.exists(path):
+            logging.warning(
+                "kvstore server restore requested but no snapshot at "
+                "%r; starting fresh", path)
+            return False
+        with open(path, "rb") as f:
+            blob = f.read()
+        head = len(_SNAP_MAGIC) + _LEN.size + 4
+        if len(blob) < head or not blob.startswith(_SNAP_MAGIC):
+            raise MXNetError(
+                "kvstore snapshot %r is not a snapshot file "
+                "(bad magic)" % path)
+        off = len(_SNAP_MAGIC)
+        (n,) = _LEN.unpack(blob[off:off + _LEN.size])
+        off += _LEN.size
+        (crc,) = struct.unpack("!I", blob[off:off + 4])
+        off += 4
+        payload = blob[off:off + n]
+        if len(payload) != n:
+            raise MXNetError(
+                "kvstore snapshot %r is truncated: %d of %d payload "
+                "bytes" % (path, len(payload), n))
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise MXNetError(
+                "kvstore snapshot %r fails its checksum" % path)
+        try:
+            state = pickle.loads(payload)
+        except Exception as e:
+            raise MXNetError(
+                "kvstore snapshot %r payload does not deserialize "
+                "(%s)" % (path, e)) from e
+        # the snapshot's cluster shape must match this launch: restoring
+        # sync commit records / barrier generations into a different
+        # mode or world size would produce confusing hangs and dedup
+        # misfires instead of this clear error (delete the snapshot to
+        # start the resized cluster fresh)
+        if bool(state.get("sync", self._sync)) != self._sync:
+            raise MXNetError(
+                "kvstore snapshot %r was taken in %s mode but the "
+                "server was started in %s mode"
+                % (path, "sync" if state.get("sync") else "async",
+                   "sync" if self._sync else "async"))
+        snap_nw = int(state.get("num_workers", self._num_workers))
+        if snap_nw != self._num_workers:
+            raise MXNetError(
+                "kvstore snapshot %r was taken with num_workers=%d but "
+                "the server was started with num_workers=%d"
+                % (path, snap_nw, self._num_workers))
+        self._store = {k: np.array(v)
+                       for k, v in state["store"].items()}
+        self._versions = dict(state["versions"])
+        self._barrier_gen = int(state["barrier_gen"])
+        self._applied_seq = dict(state["applied_seq"])
+        self._member_epoch = dict(state.get("member_epoch", {}))
+        # reseed the dedup cache from the commit records: a worker
+        # resending the RPC it never got an ack for either finds its
+        # seq here (first copy committed — replay the ack) or not
+        # (first copy died uncommitted — re-execute)
+        with self._seq_cond:
+            for rank, seq in self._applied_seq.items():
+                self._rank_rpc[rank] = {"seq": seq, "done": True,
+                                        "resp": ("OK", None),
+                                        "spans": None}
+        if state.get("updater_states"):
+            from .optimizer import get_updater
+            blob_states = state["updater_states"]
+            # get_states(dump_optimizer=True) payloads are
+            # (states, optimizer); rebuild the updater around the
+            # pickled optimizer, then restore its slot states
+            _states, opt = pickle.loads(blob_states)
+            upd = get_updater(opt)
+            upd.set_states(blob_states)
+            self._updater = upd
+        if state.get("compression_params"):
+            from .gradient_compression import create_compressor
+            self._compression_params = dict(state["compression_params"])
+            self._compressor = create_compressor(self._compression_params)
+        self.incarnation = (int(state["incarnation"]) + 1) & 0xFFFFFFFF
+        logging.info(
+            "kvstore server restored from %r: %d keys, barrier "
+            "generation %d, incarnation %d", path, len(self._store),
+            self._barrier_gen, self.incarnation)
+        return True
+
+    # -- membership / liveness ---------------------------------------------
+    def _mark_dead_locked(self, rank):
+        """Declare ``rank`` dead immediately (its connection EOF'd
+        mid-round): age its heartbeat past the timeout so every waiter
+        fails fast instead of waiting out ``MXNET_KV_DEAD_S``."""
+        if rank is None:
+            return
+        self._last_seen[rank] = time.monotonic() - self._dead_s - 1.0
+        self._dead_declared.add(rank)
+
+    def _dead_ranks_locked(self, timeout=None):
+        """Ranks with no traffic for ``timeout`` seconds. A rank with an
+        RPC currently being handled is alive by definition (sync
+        pushes/barriers park inside the handler for the whole round);
+        a parked rank whose socket died is unmasked by its own handler
+        thread via :func:`_conn_dead`. Never-connected ranks get a
+        grace period from server start."""
+        timeout = self._dead_s if timeout is None else timeout
+        now = time.monotonic()
+        dead = []
+        for r in range(self._num_workers):
+            if self._handling.get(r, 0) > 0:
+                continue
+            silent = now - self._last_seen.get(r, self._start_time)
+            if silent > timeout:
+                dead.append(r)
+                # membership DECLARATION keys on the cluster timeout,
+                # never the query's: an observational DEAD_NODES probe
+                # with a short timeout must not manufacture deaths (and
+                # therefore fake rejoins) for healthy ranks between
+                # heartbeats
+                if silent > self._dead_s:
+                    self._dead_declared.add(r)
+        return dead
+
+    def _wait_round_locked(self, done_fn, rank, conn, what, on_fail=None):
+        """Park inside a sync round/barrier until ``done_fn()`` or the
+        round becomes impossible. Returns None on success or an
+        ``("ERR", msg)`` response naming the dead rank(s); raises
+        ``ConnectionError`` when OUR worker's socket died mid-wait (no
+        response is deliverable — the rank is marked dead on the spot
+        so peers fail fast too). ``on_fail`` undoes this waiter's
+        contribution before either exit."""
+        t0 = time.monotonic()
+        while not done_fn() and not self._stop.is_set():
+            self._round_done.wait(timeout=self._wait_tick)
+            if done_fn() or self._stop.is_set():
+                break
+            if conn is not None and _conn_dead(conn):
+                if on_fail is not None:
+                    on_fail()
+                self._mark_dead_locked(rank)
+                self._round_done.notify_all()
+                raise ConnectionError(
+                    "rank %s connection died while parked in %s"
+                    % (rank, what))
+            dead = self._dead_ranks_locked()
+            dead = [r for r in dead if r != rank]
+            if dead:
+                if on_fail is not None:
+                    on_fail()
+                self._round_done.notify_all()
+                return ("ERR",
+                        "%s cannot complete: rank(s) %s declared dead "
+                        "(no heartbeat for %.1fs; liveness timeout "
+                        "MXNET_KV_DEAD_S=%.1f, waited %.1fs)"
+                        % (what, dead, self._dead_s, self._dead_s,
+                           time.monotonic() - t0))
+        if self._stop.is_set() and not done_fn():
+            # server stopping with this round incomplete: the update was
+            # NOT applied and is NOT in the shutdown snapshot — a
+            # success ack here would silently lose it (commit-before-ack
+            # violation). RETRY makes the client resend, under the same
+            # seq, to the --restore successor.
+            if on_fail is not None:
+                on_fail()
+            return ("RETRY",
+                    "%s aborted: server stopping before the round "
+                    "completed (update not applied; resend reaches the "
+                    "restarted server)" % what)
+        return None
 
     # -- request handlers --------------------------------------------------
     def _decompress(self, value):
@@ -120,17 +459,23 @@ class KVStoreServer(object):
             return self._compressor.decompress(payload, shape)
         return value
 
-    def _handle(self, op, key=None, value=None):
+    def _handle(self, op, key=None, value=None, rank=None, seq=None,
+                conn=None):
         _fault.inject("kv.server")
         if op == "INIT":
             with self._lock:
                 # rank-0 init wins; later INITs for the key are ignored
                 # (reference: kvstore_dist.h rank-0 init + broadcast).
                 # dtype is preserved: fp16/bf16 weights stay what the
-                # worker declared.
+                # worker declared. A rejoining worker's INIT is a
+                # no-op: the server-side (current) weights win.
                 if key not in self._store:
                     self._store[key] = np.array(value)
                     self._versions[key] = 0
+                    self._commit_locked(rank, seq)
+                    self._snapshot_locked(force=True)
+                else:
+                    self._commit_locked(rank, seq)
             return ("OK", None)
         if op == "PUSH":
             grad = self._decompress(value)
@@ -138,22 +483,36 @@ class KVStoreServer(object):
                 if self._sync:
                     slot = self._pending.setdefault(
                         key, {"sum": np.zeros_like(self._store[key]),
-                              "count": 0})
+                              "count": 0, "contribs": []})
                     slot["sum"] = slot["sum"] + grad
                     slot["count"] += 1
+                    slot["contribs"].append((rank, seq,
+                                             time.monotonic()))
                     if slot["count"] == self._num_workers:
                         self._apply(key, slot["sum"])
                         del self._pending[key]
                         self._versions[key] += 1
+                        self._commit_round_locked(slot["contribs"])
+                        # the round's commit record: written before any
+                        # contributor is acked, so a SIGKILL can never
+                        # lose an acked update nor double an unacked one
+                        self._snapshot_locked(force=True)
                         self._round_done.notify_all()
                     else:
                         v = self._versions[key]
-                        while self._versions[key] == v and \
-                                not self._stop.is_set():
-                            self._round_done.wait(timeout=30.0)
+                        err = self._wait_round_locked(
+                            lambda: self._versions[key] != v,
+                            rank=rank, conn=conn,
+                            what="sync push round for key %r (version "
+                                 "%d)" % (key, v),
+                            on_fail=lambda: self._pending.pop(key, None))
+                        if err is not None:
+                            return err
                 else:
                     self._apply(key, grad)
                     self._versions[key] += 1
+                    self._commit_locked(rank, seq)
+                    self._snapshot_locked()
             return ("OK", None)
         if op == "PULL":
             with self._lock:
@@ -165,44 +524,99 @@ class KVStoreServer(object):
         if op == "BARRIER":
             with self._lock:
                 gen = self._barrier_gen
+                # a parked participant whose socket died must not count
+                # toward the rendezvous: completing a barrier with a
+                # ghost would hand the generation to a rank that can
+                # never proceed past it. Sweep before counting.
+                ghosts = [c for c in self._barrier_contribs
+                          if c[3] is not None and _conn_dead(c[3])]
+                for c in ghosts:
+                    self._barrier_contribs.remove(c)
+                    self._barrier_waiting -= 1
+                    self._mark_dead_locked(c[0])
+                if ghosts:
+                    self._round_done.notify_all()
+                entry = [rank, seq, time.monotonic(), conn]
                 self._barrier_waiting += 1
+                self._barrier_contribs.append(entry)
                 if self._barrier_waiting == self._num_workers:
                     self._barrier_waiting = 0
+                    self._commit_round_locked(self._barrier_contribs,
+                                              straggler=False)
+                    self._barrier_contribs = []
                     self._barrier_gen += 1
+                    self._snapshot_locked(force=True)
                     self._round_done.notify_all()
                 else:
-                    while self._barrier_gen == gen and \
-                            not self._stop.is_set():
-                        self._round_done.wait(timeout=30.0)
+                    def _withdraw():
+                        # idempotent: a peer's ghost-sweep may have
+                        # withdrawn this entry already
+                        if entry in self._barrier_contribs:
+                            self._barrier_contribs.remove(entry)
+                            self._barrier_waiting -= 1
+                    err = self._wait_round_locked(
+                        lambda: self._barrier_gen != gen,
+                        rank=rank, conn=conn,
+                        what="barrier (generation %d)" % gen,
+                        on_fail=_withdraw)
+                    if err is not None:
+                        return err
             return ("OK", None)
         if op == "SET_OPTIMIZER":
             from .optimizer import get_updater
             opt = pickle.loads(value)
             with self._lock:
                 self._updater = get_updater(opt)
+                self._commit_locked(rank, seq)
+                self._snapshot_locked(force=True)
             return ("OK", None)
         if op == "SET_COMPRESSION":
             from .gradient_compression import create_compressor
             with self._lock:
+                self._compression_params = dict(value)
                 self._compressor = create_compressor(value)
+                self._commit_locked(rank, seq)
+                self._snapshot_locked(force=True)
             return ("OK", None)
         if op == "HELLO":
             # rank registration + heartbeat (reference: ps-lite node
-            # liveness behind kvstore.h:353 get_num_dead_node)
-            import time as _t
+            # liveness behind kvstore.h:353 get_num_dead_node). A HELLO
+            # from a rank currently declared dead is a REJOIN: its
+            # membership epoch bumps and the cluster re-admits it.
+            r = int(value)
             with self._lock:
-                self._last_seen[int(value)] = _t.monotonic()
-            return ("OK", None)
+                # a re-registration after silence past the liveness
+                # bound is a rejoin even if nothing ever OBSERVED the
+                # death (pure-async clusters have no sync waiter or
+                # DEAD_NODES probe to populate _dead_declared): a live
+                # client's heartbeats run at a third of the bound, so
+                # this much silence means the process was gone
+                rejoined = r in self._dead_declared or (
+                    r in self._member_epoch
+                    and time.monotonic()
+                    - self._last_seen.get(r, self._start_time)
+                    > self._dead_s)
+                if r not in self._member_epoch:
+                    self._member_epoch[r] = 1
+                elif rejoined:
+                    self._member_epoch[r] += 1
+                    if _tm._enabled:
+                        _tm.counter(
+                            "kvstore/worker_rejoins_total",
+                            "Ranks re-admitted after being declared "
+                            "dead", ("rank",)).labels(str(r)).inc()
+                self._dead_declared.discard(r)
+                self._last_seen[r] = time.monotonic()
+                return ("OK", {"incarnation": self.incarnation,
+                               "barrier_gen": self._barrier_gen,
+                               "member_epoch": self._member_epoch[r],
+                               "num_workers": self._num_workers,
+                               "mode": "sync" if self._sync
+                               else "async"})
         if op == "DEAD_NODES":
-            import time as _t
-            timeout = 60.0 if value is None else float(value)
-            now = _t.monotonic()
+            timeout = self._dead_s if value is None else float(value)
             with self._lock:
-                # never-connected ranks get a grace period measured from
-                # server start instead of counting dead instantly
-                dead = [r for r in range(self._num_workers)
-                        if now - self._last_seen.get(r, self._start_time)
-                        > timeout]
+                dead = self._dead_ranks_locked(timeout)
             return ("OK", dead)
         if op == "PROFILER":
             # remote profiler control from workers (reference:
@@ -220,6 +634,8 @@ class KVStoreServer(object):
                 return ("ERR", "unknown profiler command %r" % key)
             return ("OK", None)
         if op == "STOP":
+            with self._lock:
+                self._snapshot_locked(force=True)
             self._stop.set()
             with self._lock:
                 self._round_done.notify_all()
@@ -239,6 +655,7 @@ class KVStoreServer(object):
 
     # -- socket loop -------------------------------------------------------
     def _client_loop(self, conn):
+        rank = None
         try:
             if self._token:
                 # first message must be the shared token (AUTH, None, tok)
@@ -247,7 +664,6 @@ class KVStoreServer(object):
                     send_msg(conn, ("ERR", "auth failed"))
                     return
                 send_msg(conn, ("OK", None))
-            rank = None
             while not self._stop.is_set():
                 msg = recv_msg(conn)
                 # wire compat: (op[, key[, value[, seq[, tctx]]]]) all
@@ -312,12 +728,18 @@ class KVStoreServer(object):
                         # (proc_token, server_now, spans): the token +
                         # clock reading let the client rebase a foreign
                         # perf_counter epoch, and ONLY a foreign one
-                        send_msg(conn,
-                                 dedup + ((_tr._PROC_TOKEN,
-                                           time.perf_counter(), spans),)
-                                 if spans else dedup)
+                        out = dedup + (self.incarnation,)
+                        if spans:
+                            out += ((_tr._PROC_TOKEN,
+                                     time.perf_counter(), spans),)
+                        send_msg(conn, out)
                         continue
                 t_h0 = time.perf_counter()
+                if rank is not None:
+                    with self._lock:
+                        self._handling[rank] = \
+                            self._handling.get(rank, 0) + 1
+                resp = None
                 try:
                     from . import profiler as _prof
 
@@ -328,8 +750,11 @@ class KVStoreServer(object):
                             # registers its handlers with the process
                             # profiler)
                             with _prof.scope("kvstore_" + op, "kvstore"):
-                                return self._handle(op, key, value)
-                        return self._handle(op, key, value)
+                                return self._handle(op, key, value,
+                                                    rank=rank, seq=seq,
+                                                    conn=conn)
+                        return self._handle(op, key, value, rank=rank,
+                                            seq=seq, conn=conn)
 
                     if tr_ctx is not None:
                         with _tr.start_span("kv.server", ctx=tr_ctx,
@@ -337,6 +762,14 @@ class KVStoreServer(object):
                             resp = _execute()
                     else:
                         resp = _execute()
+                except PartitionError:
+                    # injected partition: drop the connection with NO
+                    # response — the worker sees a vanished server
+                    resp = None
+                except ConnectionError:
+                    # our worker's socket died mid-handle (unmasked by
+                    # the round wait): nothing to respond to
+                    resp = None
                 except (TransientKVError, FaultInjected) as e:
                     # transient: tell the worker to retry (its transport
                     # layer backs off and resends with the same seq)
@@ -347,6 +780,31 @@ class KVStoreServer(object):
                     # server errors back through ps-lite responses)
                     import traceback
                     resp = ("ERR", traceback.format_exc())
+                finally:
+                    if rank is not None:
+                        with self._lock:
+                            n = self._handling.get(rank, 1) - 1
+                            if n <= 0:
+                                self._handling.pop(rank, None)
+                            else:
+                                self._handling[rank] = n
+                    if ent is not None:
+                        # resolve the dedup entry on EVERY exit path: a
+                        # not-done entry left behind would park the
+                        # rank's resent RPC forever
+                        with self._seq_cond:
+                            ent["done"] = True
+                            ent["resp"] = resp if resp is not None else \
+                                ("ERR", "connection lost mid-rpc")
+                            ent["spans"] = list(sink)
+                            if (resp is None or resp[0] != "OK") and \
+                                    self._rank_rpc.get(rank) is ent:
+                                # failed attempts must re-execute on
+                                # retry, not replay the failure
+                                del self._rank_rpc[rank]
+                            self._seq_cond.notify_all()
+                if resp is None:
+                    raise ConnectionError("dropping client connection")
                 if _tm._enabled:
                     # real executions only — the dedup path above never
                     # reaches here, so a replayed RPC cannot
@@ -358,21 +816,11 @@ class KVStoreServer(object):
                         ("op",)).labels(op).observe(
                         time.perf_counter() - t_h0,
                         trace_id=tr_ctx.trace_id if tr_ctx else None)
-                if ent is not None:
-                    with self._seq_cond:
-                        ent["done"] = True
-                        ent["resp"] = resp
-                        ent["spans"] = list(sink)
-                        if resp[0] != "OK" and \
-                                self._rank_rpc.get(rank) is ent:
-                            # failed attempts must re-execute on retry,
-                            # not replay the failure from the cache
-                            del self._rank_rpc[rank]
-                        self._seq_cond.notify_all()
-                send_msg(conn,
-                         resp + ((_tr._PROC_TOKEN,
-                                  time.perf_counter(), sink),)
-                         if sink else resp)
+                out = resp + (self.incarnation,)
+                if sink:
+                    out += ((_tr._PROC_TOKEN, time.perf_counter(),
+                             sink),)
+                send_msg(conn, out)
                 if op == "STOP":
                     break
         except (ConnectionError, OSError):
@@ -405,17 +853,38 @@ class KVStoreServer(object):
         self._stop.set()
 
 
-def serve_forever():
+def serve_forever(argv=None):
     """Entry point for a server-role process (reference:
-    kvstore_server.py _init_kvstore_server_module)."""
-    port = int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
+    kvstore_server.py _init_kvstore_server_module). ``--restore``
+    resumes from the snapshot at ``--snapshot``/
+    ``MXNET_KV_SNAPSHOT_PATH`` — the supervisor pattern is to launch
+    with ``--restore`` from the start: a first run warns and starts
+    fresh, every relaunch after a crash picks up the committed state."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="mxnet_tpu.kvstore_server")
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen port (default MXNET_TPU_PS_PORT)")
+    ap.add_argument("--snapshot", default=None,
+                    help="state snapshot file "
+                         "(default MXNET_KV_SNAPSHOT_PATH)")
+    ap.add_argument("--restore", action="store_true",
+                    help="resume from the snapshot if one exists")
+    # argv=None = programmatic caller (kv_run_server): env-only config,
+    # never this process's unrelated sys.argv
+    args = ap.parse_args(argv if argv is not None else [])
+    port = args.port if args.port is not None else \
+        int(os.environ.get("MXNET_TPU_PS_PORT", "9090"))
     nw = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
     sync = os.environ.get("MXNET_TPU_PS_MODE", "sync") == "sync"
-    server = KVStoreServer(port=port, num_workers=nw, sync_mode=sync)
-    print("kvstore server listening on %d (workers=%d sync=%s)"
-          % (server.port, nw, sync), flush=True)
+    server = KVStoreServer(port=port, num_workers=nw, sync_mode=sync,
+                           snapshot_path=args.snapshot,
+                           restore=args.restore)
+    print("kvstore server listening on %d (workers=%d sync=%s "
+          "incarnation=%d)" % (server.port, nw, sync,
+                               server.incarnation), flush=True)
     server.serve_forever()
 
 
 if __name__ == "__main__":
-    serve_forever()
+    import sys
+    serve_forever(sys.argv[1:])

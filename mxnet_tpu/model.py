@@ -73,11 +73,25 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """Rank-0 init + broadcast of initial weights (reference: model.py:99)."""
+    """Rank-0 init + broadcast of initial weights (reference: model.py:99).
+
+    Elastic rejoin: a worker re-admitted after being declared dead
+    (``kvstore.member_epoch > 1``) must NOT train from its own freshly
+    initialized params — its INITs are ignored server-side (the
+    cluster's current weights win) and the pull below adopts them, even
+    on configurations that otherwise update locally."""
+    rejoined = getattr(kvstore, "member_epoch", 1) > 1
+    if rejoined:
+        import logging
+        logging.info(
+            "kvstore rank %d rejoined the cluster (membership epoch "
+            "%d): pulling current weights instead of keeping this "
+            "process's initializer output", kvstore.rank,
+            kvstore.member_epoch)
     for idx, param_on_devs in enumerate(param_arrays):
         name = param_names[idx]
         kvstore.init(name, arg_params[name])
-        if update_on_kvstore:
+        if update_on_kvstore or rejoined:
             kvstore.pull(name, param_on_devs, priority=-idx)
 
 

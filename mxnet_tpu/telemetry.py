@@ -753,6 +753,12 @@ def snapshot():
            "ckpt_corrupt": _val("checkpoint/corrupt_total"),
            "kv_retries": _val("kvstore/retries_total"),
            "kv_giveups": _val("kvstore/giveups_total"),
+           # self-healing cluster accounting: server failovers ridden
+           # by clients, PS state snapshots (the failover commit
+           # record), and ranks re-admitted after being declared dead
+           "kv_server_failovers": _val("kvstore/server_failovers_total"),
+           "kv_snapshots": _val("kvstore/snapshots_total"),
+           "kv_worker_rejoins": _val("kvstore/worker_rejoins_total"),
            "serve_worker_restarts": _val("serving/worker_restarts_total"),
            "faults_injected": _val("fault/injected_total")}
     fam = REGISTRY._families.get("serving/batch_rows")

@@ -545,7 +545,7 @@ def test_kv_server_dedups_replayed_push():
         send_msg(s, ("PUSH", "w", np.full((2,), 99.0, np.float32), 2))
         assert recv_msg(s)[0] == "OK"
         send_msg(s, ("PULL", "w", None))
-        status, value = recv_msg(s)
+        status, value = recv_msg(s)[:2]
         assert status == "OK"
         np.testing.assert_allclose(value, np.full((2,), 3.0))
     finally:
@@ -584,8 +584,9 @@ def test_kv_server_replay_span_cached_no_metric_recount():
                      tctx))
         first = recv_msg(s)
         assert first[0] == "OK"
-        assert len(first) > 2 and first[2], "no server spans shipped"
-        tok1, now1, spans1 = first[2]
+        # responses are (status, payload, incarnation[, spans])
+        assert len(first) > 3 and first[3], "no server spans shipped"
+        tok1, now1, spans1 = first[3]
         assert isinstance(now1, float) and isinstance(tok1, str)
         real = [sp for sp in spans1 if sp["name"] == "kv.server"]
         assert len(real) == 1
@@ -600,7 +601,7 @@ def test_kv_server_replay_span_cached_no_metric_recount():
         assert second[0] == "OK"
         assert handle_count() == n0 + 1, \
             "seq-cache replay re-recorded handler latency"
-        _tok, _now, spans2 = second[2]
+        _tok, _now, spans2 = second[3]
         cached = [sp for sp in spans2
                   if sp["name"] == "kv.server"
                   and sp["attrs"].get("cached")]
@@ -866,3 +867,92 @@ def test_fit_datapipeline_zero_recompiles_and_cursor_resume(tmp_path):
             "param %s diverged after pipeline-cursor resume" % k
     tail = [x for x in base_losses if x[0] >= 1]
     assert res_losses == tail
+
+
+# ---------------------------------------------------------------------------
+# TrainingSupervisor.supervise: preemption vs genuine-failure triage
+# ---------------------------------------------------------------------------
+
+def _counting_script(tmp_path, body):
+    """A script that appends one line to runs.txt per invocation, then
+    runs ``body`` (which sees RUN = 1-based invocation count)."""
+    script = tmp_path / "job.py"
+    runs = tmp_path / "runs.txt"
+    script.write_text(
+        "import os, sys\n"
+        "runs = %r\n"
+        "with open(runs, 'a') as f:\n"
+        "    f.write('x')\n"
+        "RUN = len(open(runs).read())\n" % str(runs) + body)
+    return str(script), runs
+
+
+def _run_count(runs):
+    return len(runs.read_text()) if runs.exists() else 0
+
+
+def test_supervise_preemption_relaunches_without_burning_budget(tmp_path):
+    """rc 137 (SIGKILL-grade) and a raw signal death are preemptions:
+    the supervisor relaunches them every time, even with the failure
+    budget at 1 — then returns 0 once the job completes."""
+    script, runs = _counting_script(
+        tmp_path,
+        "import signal\n"
+        "if RUN == 1:\n"
+        "    os._exit(137)\n"          # preemption-style hard exit
+        "if RUN == 2:\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"  # negative rc
+        "sys.exit(0)\n")
+    rc = ckpt.TrainingSupervisor.supervise(
+        [sys.executable, script], max_failures=1, relaunch_delay_s=0)
+    assert rc == 0
+    assert _run_count(runs) == 3
+
+
+def test_supervise_genuine_failure_stops_after_budget(tmp_path):
+    """A nonzero rc from an uncaught exception replays the same bug:
+    stop after max_failures consecutive failures and hand back the rc."""
+    script, runs = _counting_script(
+        tmp_path, "raise RuntimeError('broken training script')\n")
+    rc = ckpt.TrainingSupervisor.supervise(
+        [sys.executable, script], max_failures=3, relaunch_delay_s=0)
+    assert rc == 1
+    assert _run_count(runs) == 3
+
+
+def test_supervise_preemption_resets_failure_count(tmp_path):
+    """failure, preemption, failure, success: the preemption resets the
+    consecutive-failure counter, so max_failures=2 does NOT stop at the
+    second failure."""
+    script, runs = _counting_script(
+        tmp_path,
+        "if RUN == 1:\n"
+        "    sys.exit(7)\n"
+        "if RUN == 2:\n"
+        "    os._exit(143)\n"          # SIGTERM-style preemption
+        "if RUN == 3:\n"
+        "    sys.exit(7)\n"
+        "sys.exit(0)\n")
+    rc = ckpt.TrainingSupervisor.supervise(
+        [sys.executable, script], max_failures=2, relaunch_delay_s=0)
+    assert rc == 0
+    assert _run_count(runs) == 4
+
+
+def test_supervise_clean_exit_runs_once(tmp_path):
+    script, runs = _counting_script(tmp_path, "sys.exit(0)\n")
+    rc = ckpt.TrainingSupervisor.supervise(
+        [sys.executable, script], max_failures=1, relaunch_delay_s=0)
+    assert rc == 0
+    assert _run_count(runs) == 1
+
+
+def test_is_preemption_rc_triage():
+    sup = ckpt.TrainingSupervisor
+    assert sup.is_preemption_rc(137)       # 128+SIGKILL
+    assert sup.is_preemption_rc(143)       # 128+SIGTERM
+    assert sup.is_preemption_rc(-9)        # Popen signal death
+    assert sup.is_preemption_rc(-15)
+    assert not sup.is_preemption_rc(1)     # uncaught exception
+    assert not sup.is_preemption_rc(2)
+    assert not sup.is_preemption_rc(3)

@@ -1,0 +1,890 @@
+"""Cluster chaos suite: self-healing distributed training.
+
+Proves the elastic/failover claims of docs/fault_tolerance.md the same
+way PR 4 proved the single-process ones — under *injected* faults:
+
+* fast smokes (tier-1): in-process server failover via snapshot +
+  ``restore=True`` with a live client riding through it, dead-rank
+  fast-fail for sync rounds and barriers, the ``partition`` fault kind,
+  async leave/rejoin membership, straggler telemetry, and the
+  barrier/liveness unit contracts (no subprocesses);
+* ``slow`` multiprocess chaos: SIGKILL of the server subprocess
+  mid-push with a supervised ``--restore`` relaunch (sync run proven
+  BITWISE-identical to an unfaulted one), a worker SIGKILLed while
+  parked in a barrier (surviving rank gets an MXNetError naming it,
+  fast), and async worker death + rejoin converging to the exact
+  expected parameters.
+"""
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import fault
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore_server import KVStoreServer, recv_msg, send_msg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_listening(port, timeout=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=1.0)
+            s.close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def _counter_total(name, label=None):
+    fam = tm.REGISTRY._families.get(name)
+    if fam is None:
+        return 0
+    return sum(c.value for lv, c in fam.series()
+               if label is None or lv == (label,))
+
+
+def _gauge_values(name):
+    fam = tm.REGISTRY._families.get(name)
+    if fam is None:
+        return {}
+    return {lv: c.value for lv, c in fam.series()}
+
+
+def _client_env(monkeypatch, port, rank, nw, **extra):
+    monkeypatch.setenv("MXNET_TPU_PS_URI", "127.0.0.1")
+    monkeypatch.setenv("MXNET_TPU_PS_PORT", str(port))
+    monkeypatch.setenv("MXNET_TPU_RANK", str(rank))
+    monkeypatch.setenv("MXNET_TPU_NUM_WORKERS", str(nw))
+    for k, v in extra.items():
+        monkeypatch.setenv(k, str(v))
+
+
+def _start_restartable(port, **kwargs):
+    """Bind-with-retry: the previous incarnation's listener may take a
+    moment to release the port."""
+    deadline = time.time() + 30.0
+    while True:
+        try:
+            server = KVStoreServer(port=port, **kwargs)
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+    server.start_background()
+    return server
+
+
+# ---------------------------------------------------------------------------
+# failover smoke (tier-1): snapshot -> restart -> client rides through
+# ---------------------------------------------------------------------------
+
+def _run_push_sequence(monkeypatch, port, pushes):
+    kv = mx.kv.create("dist_sync")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.9))
+    kv.init("w", mx.nd.zeros((4,)))
+    for arr in pushes[: len(pushes) // 2]:
+        kv.push("w", mx.nd.array(arr))
+    return kv
+
+
+def test_server_failover_snapshot_restore_smoke(tmp_path, monkeypatch):
+    """A server restart between pushes is invisible to the client
+    beyond a retry: state (weights AND optimizer momentum) restores
+    from the snapshot, the client notes the new incarnation, and the
+    final weights are bitwise-identical to a never-restarted run."""
+    pushes = [np.full((4,), 0.25 * (i + 1), np.float32) for i in range(4)]
+
+    # twin run, no failover: the expected trajectory
+    port_t = _free_port()
+    twin = _start_restartable(port_t, num_workers=1, sync_mode=True)
+    _client_env(monkeypatch, port_t, 0, 1)
+    kv_t = _run_push_sequence(monkeypatch, port_t, pushes)
+    for arr in pushes[len(pushes) // 2:]:
+        kv_t.push("w", mx.nd.array(arr))
+    expect = mx.nd.zeros((4,))
+    kv_t.pull("w", out=expect)
+    kv_t.close()
+    twin.stop()
+
+    # failover run: push half, restart the server from its snapshot,
+    # push the rest through the SAME client
+    snap = str(tmp_path / "kv.snap")
+    port = _free_port()
+    s1 = _start_restartable(port, num_workers=1, sync_mode=True,
+                            snapshot_path=snap)
+    _client_env(monkeypatch, port, 0, 1)
+    kv = _run_push_sequence(monkeypatch, port, pushes)
+    inc1 = kv._server_inc
+    assert inc1 == s1.incarnation
+    failovers0 = _counter_total("kvstore/server_failovers_total")
+    kv._ps_call("STOP")                   # server 1 exits (snapshotted)
+    s2 = _start_restartable(port, num_workers=1, sync_mode=True,
+                            snapshot_path=snap, restore=True)
+    assert s2.incarnation == (s1.incarnation + 1) & 0xFFFFFFFF
+    for arr in pushes[len(pushes) // 2:]:
+        kv.push("w", mx.nd.array(arr))    # retries ride to server 2
+    got = mx.nd.zeros((4,))
+    kv.pull("w", out=got)
+    assert kv._server_inc == s2.incarnation != inc1
+    assert _counter_total("kvstore/server_failovers_total") \
+        == failovers0 + 1
+    np.testing.assert_array_equal(got.asnumpy(), expect.asnumpy())
+    kv.close()
+    s2.stop()
+
+
+def test_restore_with_missing_snapshot_starts_fresh(tmp_path):
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True,
+                           snapshot_path=str(tmp_path / "nope.snap"),
+                           restore=True)
+    assert server._store == {}
+    server.stop()
+
+
+def test_restore_rejects_corrupt_snapshot(tmp_path):
+    import struct
+    snap = tmp_path / "kv.snap"
+    # a zeroed payload header (empty pickle) must not restore silently
+    snap.write_bytes(b"MXKVSNAP" + b"\x00" * 64)
+    with pytest.raises(MXNetError, match="snapshot"):
+        KVStoreServer(port=0, num_workers=1, snapshot_path=str(snap),
+                      restore=True)
+    # checksum mismatch names the file
+    snap.write_bytes(b"MXKVSNAP" + struct.pack("!Q", 10)
+                     + struct.pack("!I", 999) + b"x" * 10)
+    with pytest.raises(MXNetError, match="checksum"):
+        KVStoreServer(port=0, num_workers=1, snapshot_path=str(snap),
+                      restore=True)
+    # truncation names the byte counts
+    snap.write_bytes(b"MXKVSNAP" + struct.pack("!Q", 10)
+                     + struct.pack("!I", 0) + b"x" * 3)
+    with pytest.raises(MXNetError, match="truncated"):
+        KVStoreServer(port=0, num_workers=1, snapshot_path=str(snap),
+                      restore=True)
+
+
+# ---------------------------------------------------------------------------
+# dead-rank fast fail (tier-1): error naming the rank, never a hang
+# ---------------------------------------------------------------------------
+
+def test_sync_push_dead_rank_fails_fast(monkeypatch):
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                           dead_timeout_s=0.6)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2, MXNET_KV_DEAD_S="0.6")
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((4,)))
+    t0 = time.time()
+    with pytest.raises(MXNetError) as ei:
+        kv.push("w", mx.nd.ones((4,)))     # rank 1 never shows up
+    elapsed = time.time() - t0
+    assert "dead" in str(ei.value) and "1" in str(ei.value)
+    assert "MXNET_KV_DEAD_S" in str(ei.value)
+    assert elapsed < 15.0, "dead-rank detection took %.1fs" % elapsed
+    kv.close()
+    server.stop()
+
+
+def test_barrier_dead_rank_fails_fast(monkeypatch):
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                           dead_timeout_s=0.6)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2, MXNET_KV_DEAD_S="0.6")
+    kv = mx.kv.create("dist_sync")
+    t0 = time.time()
+    with pytest.raises(MXNetError) as ei:
+        kv.barrier()
+    elapsed = time.time() - t0
+    assert "barrier" in str(ei.value) and "1" in str(ei.value)
+    assert elapsed < 15.0
+    kv.close()
+    server.stop()
+
+
+def test_barrier_recovers_after_dead_rank_rejoins(monkeypatch):
+    """Elasticity, not just fail-fast: once the missing rank shows up,
+    the next barrier attempt completes — the failure did not wedge the
+    generation counter."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                           dead_timeout_s=0.6)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2, MXNET_KV_DEAD_S="0.6")
+    kv0 = mx.kv.create("dist_sync")
+    with pytest.raises(MXNetError):
+        kv0.barrier()
+    _client_env(monkeypatch, server.port, 1, 2, MXNET_KV_DEAD_S="0.6")
+    kv1 = mx.kv.create("dist_sync")
+    done = []
+    t = threading.Thread(target=lambda: (kv1.barrier(), done.append(1)))
+    t.start()
+    kv0.barrier()                      # completes: both ranks present
+    t.join(timeout=30)
+    assert done == [1]
+    assert server._barrier_gen == 1
+    kv0.close()
+    kv1.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# partition fault kind (tier-1): dropped connection, not an error reply
+# ---------------------------------------------------------------------------
+
+def test_partition_drops_connection_and_push_applies_once(monkeypatch):
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 1)
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "20")
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((4,)))
+    retries0 = _counter_total("kvstore/retries_total")
+    fault.arm("kv.server", step=1, kind="partition", count=1)
+    try:
+        kv.push("w", mx.nd.full((4,), 2.0))
+    finally:
+        fault.disarm()
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    # applied exactly once despite the dropped-and-resent RPC
+    np.testing.assert_array_equal(out.asnumpy(), np.full((4,), 2.0))
+    assert _counter_total("kvstore/retries_total") > retries0
+    kv.close()
+    server.stop()
+
+
+def test_partition_on_client_reconnect_retries(monkeypatch):
+    """kv.client.reconnect partitions are retried like any vanished
+    server: the op survives a failed redial."""
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 1)
+    monkeypatch.setenv("MXNET_KV_BACKOFF_MS", "20")
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((4,)))
+    # first the server drops the connection, then the first redial is
+    # itself partitioned — the second redial succeeds
+    fault.arm("kv.server", step=1, kind="partition", count=1)
+    fault.arm("kv.client.reconnect", step=1, kind="partition", count=1)
+    try:
+        kv.push("w", mx.nd.ones((4,)))
+    finally:
+        fault.disarm()
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones((4,)))
+    kv.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (tier-1): leave, declare dead, rejoin
+# ---------------------------------------------------------------------------
+
+def test_async_worker_leave_and_rejoin_membership(monkeypatch):
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=False,
+                           dead_timeout_s=0.6)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2, MXNET_KV_DEAD_S="0.6")
+    kv0 = mx.kv.create("dist_async")
+    kv0.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv0.init("w", mx.nd.zeros((2,)))
+    _client_env(monkeypatch, server.port, 1, 2, MXNET_KV_DEAD_S="0.6")
+    kv1 = mx.kv.create("dist_async")
+    assert kv1.member_epoch == 1
+    kv1.init("w", mx.nd.zeros((2,)))       # server's current value wins
+    kv1.push("w", mx.nd.ones((2,)))
+    kv1.close()                            # rank 1 leaves
+    deadline = time.time() + 10
+    while kv0.num_dead_node() < 1 and time.time() < deadline:
+        time.sleep(0.2)
+    assert kv0.num_dead_node() == 1
+    # the survivor keeps pushing — async mode never blocks on the dead
+    kv0.push("w", mx.nd.ones((2,)))
+    rejoins0 = _counter_total("kvstore/worker_rejoins_total", "1")
+    kv1b = mx.kv.create("dist_async")      # rank 1 rejoins
+    assert kv1b.member_epoch == 2
+    assert _counter_total("kvstore/worker_rejoins_total", "1") \
+        == rejoins0 + 1
+    kv1b.init("w", mx.nd.zeros((2,)))
+    kv1b.push("w", mx.nd.ones((2,)))       # resumes contributing
+    out = mx.nd.zeros((2,))
+    kv1b.pull("w", out=out)
+    # three applied updates of -lr*1 each, exactly once each
+    np.testing.assert_array_equal(out.asnumpy(), np.full((2,), -1.5))
+    kv0.close()
+    kv1b.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# straggler telemetry (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_straggler_gauge_per_rank(monkeypatch):
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    server.start_background()
+
+    def _push(rank, delay):
+        s = socket.socket()
+        s.connect(("127.0.0.1", server.port))
+        send_msg(s, ("HELLO", None, rank))
+        recv_msg(s)
+        if rank == 0:
+            send_msg(s, ("INIT", "w", np.zeros((2,), np.float32), 1))
+            recv_msg(s)
+        time.sleep(delay)
+        send_msg(s, ("PUSH", "w", np.ones((2,), np.float32), 2))
+        recv_msg(s)
+        s.close()
+
+    ts = [threading.Thread(target=_push, args=(0, 0.0)),
+          threading.Thread(target=_push, args=(1, 0.4))]
+    ts[0].start()
+    time.sleep(0.1)     # rank 0's INIT lands before rank 1 pushes
+    ts[1].start()
+    for t in ts:
+        t.join(timeout=30)
+    server.stop()
+    vals = _gauge_values("kvstore/straggler_seconds")
+    assert ("0",) in vals and ("1",) in vals
+    assert vals[("1",)] >= 0.2, vals     # rank 1 held the round up
+    assert vals[("0",)] <= vals[("1",)]
+
+
+# ---------------------------------------------------------------------------
+# barrier / liveness internals (unit level, no subprocesses)
+# ---------------------------------------------------------------------------
+
+def _barrier_client(port, rank, seq, results=None, timeout=30.0):
+    s = socket.socket()
+    s.settimeout(timeout)
+    s.connect(("127.0.0.1", port))
+    send_msg(s, ("HELLO", None, rank))
+    recv_msg(s)
+    send_msg(s, ("BARRIER", None, None, seq))
+    resp = recv_msg(s)
+    if results is not None:
+        results[rank] = resp[0]
+    s.close()
+    return resp
+
+
+def test_barrier_generation_increments_once_per_rendezvous():
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    server.start_background()
+    for rendezvous, seq in ((1, 1), (2, 2)):
+        results = {}
+        ts = [threading.Thread(target=_barrier_client,
+                               args=(server.port, r, seq, results))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert results == {0: "OK", 1: "OK"}
+        assert server._barrier_gen == rendezvous, \
+            "generation advanced %d times for %d rendezvous" \
+            % (server._barrier_gen, rendezvous)
+    server.stop()
+
+
+def test_stale_reregistration_cannot_resurrect_completed_barrier():
+    """After a completed barrier, a rank that re-registers (HELLO) and
+    barriers again must WAIT for the other rank — the fresh heartbeat
+    plus an old generation must not complete gen N+1 solo or re-notify
+    gen N."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    server.start_background()
+    results = {}
+    ts = [threading.Thread(target=_barrier_client,
+                           args=(server.port, r, 1, results))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert server._barrier_gen == 1
+
+    # rank 0 re-registers and barriers alone
+    s0 = socket.socket()
+    s0.settimeout(0.8)
+    s0.connect(("127.0.0.1", server.port))
+    send_msg(s0, ("HELLO", None, 0))
+    recv_msg(s0)
+    send_msg(s0, ("BARRIER", None, None, 2))
+    with pytest.raises(socket.timeout):
+        recv_msg(s0)                    # parked: no resurrection
+    assert server._barrier_gen == 1
+    # the other rank arrives -> generation 2 completes exactly once
+    resp1 = _barrier_client(server.port, 1, 2)
+    assert resp1[0] == "OK"
+    s0.settimeout(10.0)
+    assert recv_msg(s0)[0] == "OK"
+    assert server._barrier_gen == 2
+    s0.close()
+    server.stop()
+
+
+def test_rank_rpc_dedup_cache_stays_bounded():
+    """The at-most-once cache holds ONE entry per rank — an acked RPC
+    is evicted the moment the rank's next mutating RPC arrives, so the
+    cache cannot grow across epochs."""
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    s = socket.socket()
+    s.connect(("127.0.0.1", server.port))
+    send_msg(s, ("HELLO", None, 0))
+    recv_msg(s)
+    send_msg(s, ("INIT", "w", np.zeros((2,), np.float32), 1))
+    recv_msg(s)
+    for seq in range(2, 30):
+        send_msg(s, ("PUSH", "w", np.ones((2,), np.float32), seq))
+        assert recv_msg(s)[0] == "OK"
+    assert len(server._rank_rpc) == 1
+    assert server._rank_rpc[0]["seq"] == 29
+    s.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# multiprocess chaos (slow)
+# ---------------------------------------------------------------------------
+
+_SERVER_SCRIPT = r"""
+import os, sys
+marker, port, snap = sys.argv[1], sys.argv[2], sys.argv[3]
+if not os.path.exists(marker):
+    # first incarnation only: crash inside the commit snapshot of the
+    # 6th snapshotting mutation = mid-push of sync round 4
+    open(marker, "w").write("armed")
+    os.environ["MXNET_FAULT_INJECT"] = "kv.server.snapshot:6:crash"
+sys.path.insert(0, %r)
+from mxnet_tpu.kvstore_server import serve_forever
+serve_forever(["--port", port, "--snapshot", snap, "--restore"])
+""" % (REPO,)
+
+
+def _sync_worker_loop(kv, rank, steps, finals, errors):
+    try:
+        for s in range(steps):
+            grad = np.full((4,), ((s + 1) + 8 * rank) * 0.125,
+                           np.float32)
+            kv.push("w", mx.nd.array(grad))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        finals[rank] = out.asnumpy()
+    except Exception as e:      # surfaced by the asserting test body
+        errors[rank] = e
+
+
+def _run_sync_cluster(monkeypatch, port, steps):
+    """Drive a 2-rank sync training exchange against whatever server
+    is at ``port``; returns the final pulled weights per rank."""
+    kvs = []
+    for rank in range(2):
+        _client_env(monkeypatch, port, rank, 2,
+                    MXNET_KV_DEAD_S="120",
+                    MXNET_KV_RETRIES="60",
+                    MXNET_KV_BACKOFF_MS="300",
+                    MXNET_KV_TIMEOUT_MS="240000")
+        kv = mx.kv.create("dist_tpu_sync")
+        if rank == 0:
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                              momentum=0.9))
+        kv.init("w", mx.nd.zeros((4,)))
+        kvs.append(kv)
+    finals, errors = {}, {}
+    ts = [threading.Thread(target=_sync_worker_loop,
+                           args=(kvs[r], r, steps, finals, errors))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert set(finals) == {0, 1}
+    np.testing.assert_array_equal(finals[0], finals[1])
+    return kvs, finals[0]
+
+
+@pytest.mark.slow
+def test_chaos_server_sigkill_midpush_restore_bitwise(tmp_path,
+                                                      monkeypatch):
+    """Acceptance (a): SIGKILL the kvstore server subprocess inside the
+    commit snapshot of a mid-training sync round; a supervisor
+    relaunches it with --restore; both workers ride the outage on
+    retries and the final weights are BITWISE-identical to an unfaulted
+    run — no lost, no doubly-applied update."""
+    steps = 8
+
+    # unfaulted baseline (in-process server, identical arithmetic)
+    base_port = _free_port()
+    base = _start_restartable(base_port, num_workers=2, sync_mode=True)
+    base_kvs, expect = _run_sync_cluster(monkeypatch, base_port, steps)
+    for kv in base_kvs:
+        kv.close()
+    base.stop()
+
+    # chaos run: server subprocess under the supervisor
+    port = _free_port()
+    snap = str(tmp_path / "kv.snap")
+    marker = str(tmp_path / "crash.marker")
+    script = str(tmp_path / "server.py")
+    with open(script, "w") as f:
+        f.write(_SERVER_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_PS_MODE="sync", MXNET_TPU_NUM_WORKERS="2")
+    env.pop("MXNET_TPU_PS_URI", None)
+    cmd = [sys.executable, script, marker, str(port), snap]
+    sup = {}
+
+    def _supervise():
+        sup["rc"] = ckpt.TrainingSupervisor.supervise(
+            cmd, max_failures=2, relaunch_delay_s=0.2, env=env)
+
+    t_sup = threading.Thread(target=_supervise, daemon=True)
+    t_sup.start()
+    assert _wait_listening(port), "server subprocess never came up"
+
+    failovers0 = _counter_total("kvstore/server_failovers_total")
+    kvs, got = _run_sync_cluster(monkeypatch, port, steps)
+    assert os.path.exists(marker), "crash arming never happened"
+    # the crash + supervised relaunch really took place: at least one
+    # client observed the incarnation change
+    assert _counter_total("kvstore/server_failovers_total") \
+        > failovers0, "no failover observed — the fault never fired?"
+    kvs[0]._ps_call("STOP")
+    for kv in kvs:
+        kv.close()
+    t_sup.join(timeout=120)
+    assert sup.get("rc") == 0, sup
+    np.testing.assert_array_equal(got, expect)
+
+
+_BARRIER_WORKER = r"""
+import os, sys
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import mxnet_tpu as mx
+kv = mx.kv.create("dist_tpu_sync")
+print("ENTERING_BARRIER", flush=True)
+kv.barrier()
+print("BARRIER_DONE", flush=True)
+""" % (REPO,)
+
+
+@pytest.mark.slow
+def test_chaos_worker_sigkill_midbarrier_names_rank(tmp_path,
+                                                    monkeypatch):
+    """Acceptance (c): a worker SIGKILLed while parked in a dist_sync
+    barrier surfaces to the surviving rank as a clear MXNetError naming
+    the dead rank within the liveness timeout — never a hang."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                           dead_timeout_s=3.0)
+    server.start_background()
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_BARRIER_WORKER)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_PS_URI="127.0.0.1",
+               MXNET_TPU_PS_PORT=str(server.port),
+               MXNET_TPU_RANK="1", MXNET_TPU_NUM_WORKERS="2",
+               MXNET_KV_DEAD_S="3.0")
+    proc = subprocess.Popen([sys.executable, script], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while server._barrier_waiting < 1 and time.time() < deadline:
+            time.sleep(0.2)
+        assert server._barrier_waiting == 1, \
+            "worker never reached the barrier"
+        proc.kill()                       # SIGKILL while parked
+        proc.wait(timeout=30)
+
+        _client_env(monkeypatch, server.port, 0, 2,
+                    MXNET_KV_DEAD_S="3.0")
+        kv0 = mx.kv.create("dist_sync")
+        t0 = time.time()
+        with pytest.raises(MXNetError) as ei:
+            kv0.barrier()
+        elapsed = time.time() - t0
+        msg = str(ei.value)
+        assert "barrier" in msg and "[1]" in msg and "dead" in msg, msg
+        assert elapsed < 3.0 + 10.0, \
+            "dead rank surfaced only after %.1fs" % elapsed
+        kv0.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        server.stop()
+
+
+_ASYNC_WORKER = r"""
+import os, sys
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+n = int(sys.argv[1])
+kv = mx.kv.create("dist_async")
+kv.init("w", mx.nd.zeros((2,)))     # ignored server-side on rejoin
+for i in range(n):
+    kv.push("w", mx.nd.ones((2,)))
+    print("PUSHED", i + 1, flush=True)
+print("WORKER_DONE", flush=True)
+""" % (REPO,)
+
+
+@pytest.mark.slow
+def test_chaos_async_worker_death_and_rejoin_converges(tmp_path,
+                                                       monkeypatch):
+    """Acceptance (b): in dist_async a SIGKILLed worker leaves the
+    survivors training; a relaunched worker rejoins (membership epoch
+    bumps) and resumes contributing. Every applied update is accounted
+    for exactly once: final w = -lr * total_applied_pushes."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=False,
+                           dead_timeout_s=1.0)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2, MXNET_KV_DEAD_S="1.0")
+    kv0 = mx.kv.create("dist_async")
+    kv0.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv0.init("w", mx.nd.zeros((2,)))
+
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_ASYNC_WORKER)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_PS_URI="127.0.0.1",
+               MXNET_TPU_PS_PORT=str(server.port),
+               MXNET_TPU_RANK="1", MXNET_TPU_NUM_WORKERS="2",
+               MXNET_KV_DEAD_S="1.0")
+    # first life: crash client-side at the 4th push, BEFORE it is sent
+    # -> exactly 3 applied
+    env1 = dict(env, MXNET_FAULT_INJECT="kv.push:4:crash")
+    p1 = subprocess.run([sys.executable, script, "9"], env=env1,
+                        capture_output=True, text=True, timeout=300)
+    assert p1.returncode == 137, (p1.returncode, p1.stdout[-500:])
+    assert "PUSHED 3" in p1.stdout and "PUSHED 4" not in p1.stdout
+
+    # survivors keep training while rank 1 is dead
+    for _ in range(3):
+        kv0.push("w", mx.nd.ones((2,)))
+    deadline = time.time() + 15
+    while kv0.num_dead_node() < 1 and time.time() < deadline:
+        time.sleep(0.2)
+    assert kv0.num_dead_node() == 1, "rank 1 never declared dead"
+
+    # second life: rejoin and contribute 4 more
+    p2 = subprocess.run([sys.executable, script, "4"], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert p2.returncode == 0, p2.stdout[-2000:]
+    assert "WORKER_DONE" in p2.stdout
+    assert server._member_epoch.get(1) == 2, server._member_epoch
+
+    out = mx.nd.zeros((2,))
+    kv0.pull("w", out=out)
+    # 3 (first life) + 3 (survivor) + 4 (second life) applied once each
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full((2,), -0.5 * 10))
+    kv0.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_observational_dead_probe_does_not_declare(monkeypatch):
+    """A DEAD_NODES query with a SHORT timeout may report silent ranks
+    but must not DECLARE them dead: a later HELLO from such a rank is
+    not a rejoin (no membership-epoch bump, no rejoin count)."""
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=False,
+                           dead_timeout_s=60.0)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 1)
+    kv = mx.kv.create("dist_async")
+    time.sleep(0.3)
+    rejoins0 = _counter_total("kvstore/worker_rejoins_total", "0")
+    # external monitoring probe between heartbeats (raw socket — the
+    # rank's own probe RPC would count as live traffic): rank 0 is
+    # silent for > 0.1s, so a short-timeout query REPORTS it...
+    probe = socket.socket()
+    probe.connect(("127.0.0.1", server.port))
+    send_msg(probe, ("DEAD_NODES", None, 0.1))
+    assert recv_msg(probe)[1] == [0]
+    probe.close()
+    # ...but does NOT declare it dead (cluster timeout is 60s)
+    assert 0 not in server._dead_declared
+    kv.close()
+    kv2 = mx.kv.create("dist_async")       # reconnect, NOT a rejoin
+    assert kv2.member_epoch == 1
+    assert _counter_total("kvstore/worker_rejoins_total", "0") == rejoins0
+    kv2.close()
+    server.stop()
+
+
+def test_fresh_client_seq_base_cannot_collide_with_predecessor(
+        monkeypatch):
+    """A restarted worker is a fresh client whose seq counter restarts;
+    seqs start from a random per-client base so its first mutating RPC
+    can never match a predecessor's commit record and be swallowed as a
+    duplicate."""
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 1)
+    kv_a = mx.kv.create("dist_sync")
+    kv_a.init("w", mx.nd.zeros((2,)))      # commits seq base_a+1
+    committed = server._applied_seq[0]
+    kv_a.close()
+    kv_b = mx.kv.create("dist_sync")       # the relaunched worker
+    assert kv_b._seq != kv_a._seq
+    assert kv_b._seq > (1 << 16)           # randomized base, not 0
+    # its first mutating RPC executes for real (store mutates), it is
+    # not replayed from the predecessor's cached ack
+    kv_b.init("x", mx.nd.ones((2,)))
+    assert server._applied_seq[0] != committed
+    out = mx.nd.zeros((2,))
+    kv_b.pull("x", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones((2,)))
+    kv_b.close()
+    server.stop()
+
+
+def test_closed_kvstore_is_terminal(monkeypatch):
+    """close() must not silently resurrect the connection on the next
+    op — a revived client would run with no heartbeat and read as a
+    dead rank mid-round. Ops on a closed store raise."""
+    server = KVStoreServer(port=0, num_workers=1, sync_mode=True)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 1)
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((2,)))
+    kv.close()
+    with pytest.raises(MXNetError, match="closed"):
+        kv.push("w", mx.nd.ones((2,)))
+    server.stop()
+
+
+def test_stop_aborts_parked_sync_round_no_false_ack(monkeypatch):
+    """STOP while a worker is parked in an incomplete sync round must
+    NOT ack its push as OK (the update was never applied or
+    snapshotted): the waiter gets a retryable abort, which surfaces as
+    a clear error when no successor server appears."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                           dead_timeout_s=60.0)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2,
+                MXNET_KV_RETRIES="1", MXNET_KV_BACKOFF_MS="20",
+                MXNET_KV_TIMEOUT_MS="5000")
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((2,)))
+    result = {}
+
+    def _push():
+        try:
+            kv.push("w", mx.nd.ones((2,)))
+            result["outcome"] = "ok"
+        except MXNetError as e:
+            result["outcome"] = "error"
+            result["msg"] = str(e)
+
+    t = threading.Thread(target=_push)
+    t.start()
+    deadline = time.time() + 10
+    while not server._pending and time.time() < deadline:
+        time.sleep(0.05)
+    assert server._pending, "push never parked"
+    stopper = socket.socket()
+    stopper.connect(("127.0.0.1", server.port))
+    send_msg(stopper, ("STOP", None, None))
+    recv_msg(stopper)
+    stopper.close()
+    t.join(timeout=30)
+    assert result.get("outcome") == "error", result
+    kv.close()
+
+
+def test_restore_rejects_changed_cluster_shape(tmp_path):
+    """--restore under a different mode or world size raises a clear
+    error naming both values instead of mixing incompatible state."""
+    snap = str(tmp_path / "kv.snap")
+    s1 = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                       snapshot_path=snap)
+    s1.start_background()
+    sock = socket.socket()
+    sock.connect(("127.0.0.1", s1.port))
+    send_msg(sock, ("HELLO", None, 0))
+    recv_msg(sock)
+    send_msg(sock, ("INIT", "w", np.zeros((2,), np.float32), 1))
+    assert recv_msg(sock)[0] == "OK"       # snapshots on new-key INIT
+    sock.close()
+    s1.stop()
+    with pytest.raises(MXNetError, match="num_workers=2"):
+        KVStoreServer(port=0, num_workers=3, sync_mode=True,
+                      snapshot_path=snap, restore=True)
+    with pytest.raises(MXNetError, match="mode"):
+        KVStoreServer(port=0, num_workers=2, sync_mode=False,
+                      snapshot_path=snap, restore=True)
+    # the matching shape still restores
+    s2 = KVStoreServer(port=0, num_workers=2, sync_mode=True,
+                       snapshot_path=snap, restore=True)
+    assert "w" in s2._store
+    s2.stop()
+
+
+def test_closed_store_guards_every_ps_op(monkeypatch):
+    """barrier/num_dead_node/set_optimizer must refuse on a closed
+    store, not silently fall back to local/jax semantics."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 0, 2)
+    kv = mx.kv.create("dist_sync")
+    kv.close()
+    with pytest.raises(MXNetError, match="closed"):
+        kv.barrier()
+    with pytest.raises(MXNetError, match="closed"):
+        kv.num_dead_node()
+    with pytest.raises(MXNetError, match="closed"):
+        kv.set_optimizer(mx.optimizer.SGD())
+    server.stop()
+
+
+def test_pure_async_rejoin_detected_without_observer(monkeypatch):
+    """With NO sync waiter and NO DEAD_NODES probe ever running, a rank
+    that re-registers after silence past the liveness bound is still
+    recognized as a rejoin (epoch bump) — the HELLO itself compares the
+    silence age."""
+    server = KVStoreServer(port=0, num_workers=2, sync_mode=False,
+                           dead_timeout_s=0.5)
+    server.start_background()
+    _client_env(monkeypatch, server.port, 1, 2, MXNET_KV_DEAD_S="0.5")
+    kv1 = mx.kv.create("dist_async")
+    assert kv1.member_epoch == 1
+    kv1.close()
+    time.sleep(0.8)                        # outlive the bound, unobserved
+    kv1b = mx.kv.create("dist_async")
+    assert kv1b.member_epoch == 2
+    kv1b.close()
+    server.stop()
