@@ -1265,6 +1265,143 @@ def serve_predictor(offered_rps=400, clients=16, duration=4.0,
     return rps, extra
 
 
+def decode_serve(clients=6, requests_per_client=4, slots=4, page_size=16,
+                 d_model=256, n_heads=8, n_kv_heads=2, n_layers=4,
+                 d_ff=512, vocab=2048, max_context=256, dtype="float32"):
+    """Continuous-batching decode serving at fixed offered load: N
+    closed-loop clients stream mixed prompt/output-length generations
+    through a warmed DecodeEngine, and we bank tokens/s, p50/p99
+    time-to-first-token and inter-token latency, realized slot
+    occupancy, and the after-warmup compile count — then re-run the
+    SAME request set gated in admission-sized groups (each group must
+    fully finish before the next submits: the batch-at-admission
+    discipline the PR 3 engine imposes on stateful decode) as the
+    static-batching baseline. The model is small so the number probes
+    the SCHEDULER (iteration-level admit/retire, paged cache, bucketed
+    prefill), not matmul throughput."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from . import telemetry as _tm
+    from .parallel.transformer import (TransformerConfig,
+                                       init_transformer_params)
+    from .serve import DecodeConfig, DecodeEngine
+
+    import jax
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, n_layers=n_layers, d_ff=d_ff,
+        max_len=max_context, pos_type="rope",
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh, seed=0)
+    dcfg = DecodeConfig(slots=slots, page_size=page_size,
+                        num_pages=4 * slots * (max_context // page_size),
+                        max_context=max_context,
+                        queue_depth=4 * clients,
+                        max_new_tokens=max_context // 2,
+                        default_timeout_ms=120000)
+    eng = DecodeEngine(params, cfg, dcfg).start()
+    t0 = time.time()
+    eng.warmup()
+    log("decode warmup (%d programs): %.1fs"
+        % (eng.program_count(), time.time() - t0))
+
+    rng = np.random.RandomState(0)
+    # mixed traffic: short chat-y prompts with long generations next to
+    # long prompts with short completions
+    reqs = []
+    for _ in range(clients * requests_per_client):
+        if rng.rand() < 0.5:
+            plen, mnew = rng.randint(4, 24), rng.randint(32, 64)
+        else:
+            plen, mnew = rng.randint(48, 128), rng.randint(4, 16)
+        reqs.append((list(rng.randint(0, vocab, (plen,))), int(mnew)))
+
+    def _hist_count(name):
+        fam = _tm.REGISTRY._families.get(name)
+        if fam is None:
+            return 0
+        return sum(c.count for _lv, c in fam.series())
+
+    def run_round(submit_plan):
+        """submit_plan: list of request-index groups; every group is
+        submitted together and must fully finish before the next (one
+        big group = continuous batching, slot-sized groups = the
+        static batch-at-admission baseline). The whole round's
+        requests ARRIVE at t=0 — TTFT counts from round start for
+        both disciplines, so a request gated behind an earlier batch
+        pays its head-of-line wait honestly. Timing comes from the
+        sessions' server-side stamps (t_first/t_done), not per-token
+        client threads — on a small host the measurement must not
+        contend with the scheduler it measures. Returns
+        (wall, tokens, ttfts, per-request mean itls)."""
+        ttfts, itls, total = [], [], 0
+        t_start = _tm.monotonic()
+        for group in submit_plan:
+            sessions = [eng.submit(reqs[i][0], max_new_tokens=reqs[i][1])
+                        for i in group]
+            for s in sessions:
+                n = len(s.result())
+                total += n
+                ttfts.append(s.t_first - t_start)
+                if n > 1:
+                    itls.append((s.t_done - s.t_first) / (n - 1))
+        return _tm.monotonic() - t_start, total, ttfts, itls
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 2)
+
+    snap0 = _tm.snapshot()
+    steps0 = _hist_count("decode/step_seconds")
+    all_idx = list(range(len(reqs)))
+    wall, tokens, ttfts, itls = run_round([all_idx])
+    snap1 = _tm.snapshot()
+    steps1 = _hist_count("decode/step_seconds")
+    tok_s = tokens / wall
+    nreq = len(reqs)
+    # tokens per decode step, excluding the prefill-produced firsts =
+    # how full the slot buckets actually ran
+    occupancy = ((snap1["decode_tokens"] - snap0["decode_tokens"] - nreq)
+                 / max(1, steps1 - steps0))
+
+    # static-batching baseline: same requests, admission-sized groups,
+    # each group runs to full completion before the next is admitted
+    groups = [all_idx[i:i + slots] for i in range(0, nreq, slots)]
+    s_wall, s_tokens, s_ttfts, s_itls = run_round(groups)
+
+    extra = {
+        "clients": clients, "requests": nreq, "slots": slots,
+        "page_size": page_size, "max_context": max_context,
+        "dtype": dtype, "tokens": tokens,
+        "ttft_p50_ms": pct(ttfts, 50), "ttft_p99_ms": pct(ttfts, 99),
+        "itl_p50_ms": pct(itls, 50), "itl_p99_ms": pct(itls, 99),
+        "mean_slot_occupancy": round(occupancy, 3),
+        "prefill_buckets": list(dcfg.prefill_buckets),
+        "slot_buckets": list(dcfg.slot_buckets),
+        "programs": eng.program_count(),
+        "compiles_after_warmup": (snap1["backend_compile_total"]
+                                  - snap0["backend_compile_total"]),
+        "rejected": snap1["decode_rejected"] - snap0["decode_rejected"],
+        "preempted": (snap1["decode_preempted"]
+                      - snap0["decode_preempted"]),
+        "static_tokens_per_sec": round(s_tokens / s_wall, 2),
+        "static_ttft_p50_ms": pct(s_ttfts, 50),
+        "static_ttft_p99_ms": pct(s_ttfts, 99),
+        "static_itl_p50_ms": pct(s_itls, 50),
+        "speedup_vs_static": round(tok_s / (s_tokens / s_wall), 3),
+        "ttft_p99_vs_static": round(
+            pct(s_ttfts, 99) / max(1e-9, pct(ttfts, 99)), 2),
+    }
+    eng.close()
+    if extra["compiles_after_warmup"]:
+        raise RuntimeError(
+            "decode served mixed traffic with %d compiles after "
+            "warmup; the bucket/page bound is broken"
+            % extra["compiles_after_warmup"])
+    return tok_s, extra
+
+
 # ---------------------------------------------------------------------------
 # inference jobs (benchmark_score.py port)
 
@@ -1575,6 +1712,14 @@ def _job_predictor_serve():
                    "16 clients fixed offered load)", x)
 
 
+def _job_decode_serve():
+    v, x = decode_serve()
+    return persist("decode_serve_tokens_per_sec", v,
+                   "tok/s (continuous-batching paged-KV decode, mixed "
+                   "prompt/output lengths; TTFT/ITL percentiles + "
+                   "static-batching baseline in extras)", x)
+
+
 def _job_infer_int8():
     v, x = infer_quantized("resnet50")
     return persist("resnet50_infer_int8_img_per_sec", v,
@@ -1600,6 +1745,7 @@ JOBS = {
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
     "predictor_serve": _job_predictor_serve,
+    "decode_serve": _job_decode_serve,
     "data_pipeline": _job_data_pipeline,
     "transformer_lm": _job_transformer_lm,
     "data_pipeline_native": _job_data_pipeline_native,
@@ -1628,6 +1774,7 @@ JOB_PRIORITY = [
     "train_resume",
     "dist_failover",
     "predictor_serve",
+    "decode_serve",
     "data_pipeline",
     "data_pipeline_native",
     "resnet50_train",

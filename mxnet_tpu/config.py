@@ -102,6 +102,41 @@ VARS = {
                                     "crashed worker stays down; with no "
                                     "worker alive /healthz degrades to "
                                     "not-ready."),
+    "MXNET_DECODE_SLOTS": (int, 8,
+                           "Concurrent sequences the decode engine "
+                           "(serve.DecodeEngine) schedules per step. "
+                           "Decode compiles one program per power-of-"
+                           "two slot bucket up to this."),
+    "MXNET_DECODE_PAGE_SIZE": (int, 16,
+                               "Tokens per KV-cache page. Smaller = "
+                               "less reserved-memory waste per "
+                               "sequence, more block-table gather "
+                               "entries per step."),
+    "MXNET_DECODE_NUM_PAGES": (int, 512,
+                               "KV-cache page pool size (page 0 is a "
+                               "reserved null page). HBM cost: 2 * "
+                               "layers * pages * page_size * kv_heads "
+                               "* head_dim * itemsize. Admission "
+                               "refuses requests the free list cannot "
+                               "cover (503, page-exhaustion detail)."),
+    "MXNET_DECODE_MAX_CONTEXT": (int, 256,
+                                 "Max prompt + generated tokens per "
+                                 "sequence (must be a multiple of the "
+                                 "page size; sets the block-table "
+                                 "width and the prefill ladder top)."),
+    "MXNET_DECODE_QUEUE_DEPTH": (int, 64,
+                                 "Decode admission bound: requests "
+                                 "waiting for a slot beyond this are "
+                                 "rejected immediately (HTTP 503)."),
+    "MXNET_DECODE_MAX_NEW_TOKENS": (int, 128,
+                                    "Default and cap for a request's "
+                                    "max_new_tokens (bounds its page "
+                                    "reservation)."),
+    "MXNET_DECODE_DEADLINE_MS": (int, 30000,
+                                 "Default per-request decode deadline "
+                                 "(queued or mid-stream; expired "
+                                 "sessions are retired and their "
+                                 "pages freed). 0 disables."),
     "MXNET_CKPT_GRACE_S": (int, 30,
                            "Preemption grace window: on SIGTERM, fit "
                            "finishes the in-flight batch and takes a "

@@ -51,6 +51,8 @@ kv.client.reconnect kvstore client (re-)dial to the parameter server,
 engine.step         start of each training step in ``BaseModule.fit``
                     (hits count across epochs)
 serve.worker        top of each serve-worker loop iteration
+decode.step         top of each decode-scheduler iteration
+                    (serve.DecodeEngine)
 io.worker           top of each input-pipeline decode task (counted
                     per process: forked workers inherit the arming)
 ==================  ======================================================
@@ -107,6 +109,10 @@ POINTS = {
     "engine.step": "start of a training step in BaseModule.fit "
                    "(hit count spans epochs)",
     "serve.worker": "top of each serve-worker loop iteration",
+    "decode.step": "top of each decode-scheduler iteration "
+                   "(serve.DecodeEngine) — before admission/prefill/"
+                   "step; a crash here retires every live slot and "
+                   "frees its pages",
     "io.worker": "top of each input-pipeline decode task (DataPipeline "
                  "worker process, or the staging thread when workers=0)",
 }

@@ -307,3 +307,160 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     block_k = min(block_k, max(8, k.shape[2]))
     return _flash(q, k, v, bool(causal), float(sm_scale),
                   int(block_q), int(block_k), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention (serving: one query token per sequence against
+# a block-table-addressed page pool — serve/decode.py's hot kernel)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_xla(q, k_pages, v_pages, block_tables, lengths,
+                      sm_scale):
+    """Pure-lax twin of the paged kernel (the CPU tier-1 path and the
+    numeric reference): block-table gather materializes each row's
+    (L, kv_heads, hd) view, then standard masked GQA softmax."""
+    b, kvh, g, hd = q.shape
+    kc = k_pages[block_tables]           # (b, pages, page_size, kvh, hd)
+    vc = v_pages[block_tables]
+    L = kc.shape[1] * kc.shape[2]
+    kc = kc.reshape(b, L, kvh, hd).transpose(0, 2, 1, 3)
+    vc = vc.reshape(b, L, kvh, hd).transpose(0, 2, 1, 3)
+    visible = jnp.arange(L)[None, :] < lengths[:, None]       # (b, L)
+    sc = jnp.einsum("bkgd,bkld->bkgl", q.astype(jnp.float32),
+                    kc.astype(jnp.float32)) * sm_scale
+    sc = jnp.where(visible[:, None, None, :], sc, NEG_INF)
+    o = jnp.einsum("bkgl,bkld->bkgd", jax.nn.softmax(sc, -1),
+                   vc.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, page_size, sm_scale):
+    """Grid (b, kv_heads, pages_per_seq): the trailing page dimension
+    iterates sequentially per (sequence, head), accumulating an online
+    softmax in VMEM scratch exactly like the flash forward kernel —
+    the block table is scalar-prefetched so each step's page DMA is
+    issued from ``block_tables[b, p]`` before the body runs."""
+    b_i = pl.program_id(0)
+    p_i = pl.program_id(2)
+    n_p = pl.num_programs(2)
+
+    @pl.when(p_i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b_i]
+    start = p_i * page_size
+
+    @pl.when(start < length)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale       # (g, hd)
+        k = k_ref[0, :, 0].astype(jnp.float32)               # (ps, hd)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (g, ps)
+        kpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, -1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32)               # (ps, hd)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (g, hd)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p_i == n_p - 1)
+    def _fin():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
+                           sm_scale=None, interpret=None):
+    """Decode-phase attention against a PAGED KV cache: one query token
+    per sequence, keys/values gathered page-by-page via a block table.
+
+    Parameters
+    ----------
+    q : (b, kv_heads, group, head_dim) — query heads grouped per shared
+        K/V head (GQA layout; ``group = n_heads // kv_heads``).
+    k_pages, v_pages : (num_pages, page_size, kv_heads, head_dim) —
+        one layer's slice of the shared page pool.
+    block_tables : (b, pages_per_seq) int32 — page ids per row, in
+        position order.
+    lengths : (b,) int32 — row ``r`` attends positions ``< lengths[r]``.
+
+    Returns (b, kv_heads, group, head_dim). Forward-only (serving);
+    no VJP is defined. On TPU this is a Mosaic kernel whose page DMAs
+    are issued from the scalar-prefetched block table, so HBM traffic
+    is exactly the live pages of each sequence; off-TPU (and under the
+    interpreter inside shard_map) the pure-lax gather twin runs —
+    same contract, the tier-1 path.
+    """
+    b, kvh, g, hd = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if interpret is None:
+        if _interpret_default(q):
+            # production off-TPU path: the XLA twin, not a python-
+            # interpreted per-page DMA emulation (interpret=True still
+            # forces the interpreter for kernel-logic tests)
+            return _paged_decode_xla(q, k_pages, v_pages, block_tables,
+                                     lengths, float(sm_scale))
+        interpret = False
+    return _paged_decode(q, k_pages, v_pages, block_tables, lengths,
+                         float(sm_scale), bool(interpret))
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_decode(q, k_pages, v_pages, block_tables, lengths, sm_scale,
+                  interpret):
+    b, kvh, g, hd = q.shape
+    num_pages, page_size = k_pages.shape[:2]
+    n_pb = block_tables.shape[1]
+    grid = (b, kvh, n_pb)
+
+    def q_map(b_i, h_i, p_i, bt, ln):
+        return (b_i, h_i, 0, 0)
+
+    def kv_map(b_i, h_i, p_i, bt, ln):
+        return (bt[b_i, p_i], 0, h_i, 0)
+
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd), q_map),
+            pl.BlockSpec((1, page_size, 1, hd), kv_map),
+            pl.BlockSpec((1, page_size, 1, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, page_size=page_size,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=spec,
+        out_shape=_sds((b, kvh, g, hd), q.dtype,
+                       _out_vma(q, k_pages, v_pages)),
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, lengths, q, k_pages, v_pages)

@@ -14,5 +14,8 @@ from .ulysses import ulysses_attention, ulysses_self_attention
 from .transformer import (TransformerConfig, init_transformer_params,
                           make_transformer_train_step,
                           transformer_forward_single, init_kv_cache,
-                          transformer_decode_step, transformer_prefill,
+                          init_kv_pages, PagedKVCache,
+                          transformer_decode_step,
+                          transformer_decode_step_paged,
+                          transformer_prefill, transformer_prefill_paged,
                           transformer_generate)

@@ -37,8 +37,10 @@ from .moe import top_k_gating
 
 __all__ = ["TransformerConfig", "init_transformer_params",
            "make_transformer_train_step", "transformer_forward_single",
-           "init_kv_cache", "transformer_decode_step",
-           "transformer_prefill", "transformer_generate"]
+           "init_kv_cache", "init_kv_pages", "PagedKVCache",
+           "transformer_decode_step", "transformer_decode_step_paged",
+           "transformer_prefill", "transformer_prefill_paged",
+           "transformer_generate"]
 
 AXES = ("dp", "sp", "tp", "pp", "ep")
 
@@ -484,7 +486,6 @@ def make_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
     return jax.jit(loop, donate_argnums=(0,))
 
 
-
 def transformer_forward_single(params, tokens, cfg: TransformerConfig):
     """Single-device reference forward (used by tests to validate the
     sharded step; also the flagship single-chip inference path)."""
@@ -538,9 +539,21 @@ def transformer_forward_single(params, tokens, cfg: TransformerConfig):
 # ---------------------------------------------------------------------------
 # KV-cache autoregressive decode (TPU-first addition: the reference's
 # inference story is feedforward/RNN serving; a transformer framework
-# needs an O(1)-per-token decode path. Static shapes throughout — the
-# cache is (layers, b, h, max_len, hd) with a position mask, so the
-# whole generation loop is ONE compiled lax.scan program.)
+# needs an O(1)-per-token decode path. Static shapes throughout, in one
+# of two layouts behind a shared attention path:
+#
+# * DENSE — dict of (layers, b, kv_heads, max_len, hd) arrays, one
+#   contiguous strip per sequence (training-time eval, tests, the
+#   single-prompt generate loop);
+# * PAGED — :class:`PagedKVCache`: a shared pool of fixed-size pages
+#   (layers, num_pages, page_size, kv_heads, hd) plus per-row block
+#   tables, so a serving engine can grow/retire sequences at page
+#   granularity while every decode step keeps ONE compiled shape
+#   (serve/decode.py; allocation lives in serve/kv_pages.py).
+#
+# Both layouts share `_cache_attend` (mask + GQA softmax math), so the
+# paged serving path is numerically the dense path — the acceptance
+# tests assert bitwise equality.
 # ---------------------------------------------------------------------------
 
 def init_kv_cache(cfg: TransformerConfig, batch, max_len=None):
@@ -556,24 +569,144 @@ def init_kv_cache(cfg: TransformerConfig, batch, max_len=None):
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
+class PagedKVCache(object):
+    """Paged KV-cache view: pooled pages + per-row block tables.
+
+    ``k_pages``/``v_pages``: (layers, num_pages, page_size, kv_heads,
+    hd) — the HBM pool, preallocated once and shared by every live
+    sequence. ``block_tables``: (b, pages_per_seq) int32 — position
+    ``p`` of row ``r`` lives at page ``block_tables[r, p // page_size]``
+    offset ``p % page_size``. A registered pytree (page_size is static
+    aux data), so it traces straight through jit with the pool arrays
+    donated.
+    """
+
+    __slots__ = ("k_pages", "v_pages", "block_tables", "page_size")
+
+    def __init__(self, k_pages, v_pages, block_tables, page_size):
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.block_tables = block_tables
+        self.page_size = int(page_size)
+
+    @property
+    def max_context(self):
+        """Positions addressable per row via the block table."""
+        return self.block_tables.shape[1] * self.page_size
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache,
+    lambda c: ((c.k_pages, c.v_pages, c.block_tables), c.page_size),
+    lambda ps, ch: PagedKVCache(ch[0], ch[1], ch[2], ps))
+
+
+def init_kv_pages(cfg: TransformerConfig, num_pages, page_size):
+    """Zeroed page pool ``(k_pages, v_pages)``, each (layers,
+    num_pages, page_size, kv_heads, hd). Sized once at engine start:
+    HBM cost is 2 * layers * num_pages * page_size * kv_heads * hd *
+    itemsize, independent of live traffic."""
+    hd = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, int(num_pages), int(page_size),
+             _kv_heads(cfg), hd)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _positions_vec(pos, b):
+    """Per-row positions (b,) from a scalar (legacy: whole batch at one
+    position) or per-row vector (ragged continuous-batching decode)."""
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (b,))
+    return pos
+
+
+def _rope_token(t, pos_b, base):
+    """RoPE for one token per row: t (b, heads, hd), pos_b (b,)."""
+    return _rope(t[..., None, :], pos_b[:, None, None],
+                 base)[..., 0, :]
+
+
+def _cache_write_token(cache, li, k_t, v_t, pos_b):
+    """Write one token's K/V (b, kv_heads, hd) at per-row positions —
+    the single place the two cache layouts diverge on the write path."""
+    if isinstance(cache, PagedKVCache):
+        page = jnp.take_along_axis(
+            cache.block_tables,
+            (pos_b // cache.page_size)[:, None], axis=1)[:, 0]
+        off = pos_b % cache.page_size
+        return PagedKVCache(
+            cache.k_pages.at[li, page, off].set(
+                k_t.astype(cache.k_pages.dtype)),
+            cache.v_pages.at[li, page, off].set(
+                v_t.astype(cache.v_pages.dtype)),
+            cache.block_tables, cache.page_size)
+    rows = jnp.arange(k_t.shape[0])
+    return {"k": cache["k"].at[li, rows, :, pos_b].set(
+                k_t.astype(cache["k"].dtype)),
+            "v": cache["v"].at[li, rows, :, pos_b].set(
+                v_t.astype(cache["v"].dtype))}
+
+
+def _cache_attend(cache, li, q, pos_b, cfg):
+    """One-token GQA attention against layer ``li`` of either cache
+    layout: q (b, n_heads, hd) -> context (b, d_model). Grouped heads
+    attend the compact cache directly (expanding it per step would
+    materialize the very tensor GQA exists to avoid); rows see
+    positions <= their own pos, so ragged batches never read a
+    neighbour's (or their own stale) tail."""
+    b, nh, hd = q.shape
+    kvh = _kv_heads(cfg)
+    if isinstance(cache, PagedKVCache):
+        if jax.default_backend() == "tpu":
+            from ..ops.pallas.flash_attention import paged_decode_attention
+            o = paged_decode_attention(
+                q.reshape(b, kvh, nh // kvh, hd),
+                cache.k_pages[li], cache.v_pages[li],
+                cache.block_tables, pos_b + 1,
+                sm_scale=1.0 / np.sqrt(hd))
+            return o.reshape(b, cfg.d_model)
+        # pure-lax gather fallback (CPU tier-1): block-table gather
+        # materializes the same (b, kvh, L, hd) view the dense layout
+        # slices, then the shared math below runs unchanged
+        kc = cache.k_pages[li][cache.block_tables]
+        vc = cache.v_pages[li][cache.block_tables]
+        L = kc.shape[1] * kc.shape[2]
+        kc = kc.reshape(b, L, kvh, hd).transpose(0, 2, 1, 3)
+        vc = vc.reshape(b, L, kvh, hd).transpose(0, 2, 1, 3)
+    else:
+        kc = cache["k"][li]                   # (b, kvh, max_len, hd)
+        vc = cache["v"][li]
+        L = kc.shape[2]
+    visible = jnp.arange(L)[None, :] <= pos_b[:, None]      # (b, L)
+    qg = q.reshape(b, kvh, nh // kvh, hd)
+    sc = jnp.einsum("bkgd,bkld->bkgl", qg, kc) / np.sqrt(hd)
+    sc = jnp.where(visible[:, None, None, :], sc, -1e30)
+    o = jnp.einsum("bkgl,bkld->bkgd", jax.nn.softmax(sc, -1), vc)
+    return o.reshape(b, cfg.d_model)
+
+
 def transformer_decode_step(params, cache, tokens_t, pos,
                             cfg: TransformerConfig):
-    """One decode step: tokens_t (b,) int32 at position ``pos`` (traced
-    scalar) -> (logits (b, V), updated cache). Attention reads the full
-    static cache under a <= pos mask, so shapes never change and the
-    step compiles once."""
+    """One decode step: tokens_t (b,) int32 at position(s) ``pos`` ->
+    (logits (b, V), updated cache).
+
+    ``pos`` is a traced scalar (whole batch at one position — the
+    single-prompt generate loop) or a traced (b,) vector of per-row
+    positions (continuous batching: every slot at its own depth).
+    ``cache`` is the dense dict from :func:`init_kv_cache` or a
+    :class:`PagedKVCache`; either way attention reads a fixed-shape
+    view under a <= pos mask, so the step compiles once per (batch,
+    layout) and never again."""
     layers = params["layers"]
     pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
     hd = cfg.d_model // cfg.n_heads
     b = tokens_t.shape[0]
-    max_len = cache["k"].shape[3]
+    pos_b = _positions_vec(pos, b)
 
     x = params["embed"][tokens_t]                     # (b, d)
     if cfg.pos_type == "learned":
-        x = x + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
-                                             keepdims=False)
-    kpos = jnp.arange(max_len)
-    visible = (kpos <= pos)[None, None, :]            # (1, 1, max_len)
+        x = x + params["pos"][pos_b]                  # (b, d) gather
     li_flat = 0
     for st in range(pp):
         for li in range(lps):
@@ -583,28 +716,11 @@ def transformer_decode_step(params, cache, tokens_t, pos,
             k_t = (h @ lp["wk"]).reshape(b, _kv_heads(cfg), hd)
             v_t = (h @ lp["wv"]).reshape(b, _kv_heads(cfg), hd)
             if cfg.pos_type == "rope":
-                p1 = jnp.asarray(pos)[None]
-                q = _rope(q[..., None, :], p1, cfg.rope_base)[..., 0, :]
-                k_t = _rope(k_t[..., None, :], p1,
-                            cfg.rope_base)[..., 0, :]
-            # write this step's K/V at [li_flat, :, :, pos]
-            cache = {
-                "k": cache["k"].at[li_flat, :, :, pos].set(
-                    k_t.astype(cache["k"].dtype)),
-                "v": cache["v"].at[li_flat, :, :, pos].set(
-                    v_t.astype(cache["v"].dtype)),
-            }
-            # grouped attention straight against the compact cache —
-            # expanding it per step would materialize the very tensor
-            # GQA exists to avoid
-            groups = cfg.n_heads // _kv_heads(cfg)
-            qg = q.reshape(b, _kv_heads(cfg), groups, hd)
-            kc = cache["k"][li_flat]              # (b, hk, max_len, hd)
-            vc = cache["v"][li_flat]
-            sc = jnp.einsum("bkgd,bkld->bkgl", qg, kc) / np.sqrt(hd)
-            sc = jnp.where(visible[:, :, None, :], sc, -1e30)
-            o = jnp.einsum("bkgl,bkld->bkgd", jax.nn.softmax(sc, -1), vc)
-            x = x + o.reshape(b, cfg.d_model) @ lp["wo"]
+                q = _rope_token(q, pos_b, cfg.rope_base)
+                k_t = _rope_token(k_t, pos_b, cfg.rope_base)
+            cache = _cache_write_token(cache, li_flat, k_t, v_t, pos_b)
+            o = _cache_attend(cache, li_flat, q, pos_b, cfg)
+            x = x + o @ lp["wo"]
             h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
             if cfg.num_experts:
                 logits = h2 @ lp["gate"]
@@ -627,10 +743,45 @@ def transformer_decode_step(params, cache, tokens_t, pos,
     return x @ params["embed"].T, cache
 
 
-def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
-    """Fill the cache from a prompt with ONE batched causal forward —
-    all prompt K/V per layer come from full-width matmuls (MXU-sized
-    work), not s sequential decode steps. Returns (last_logits, cache)."""
+def _cache_write_prompt(cache, li, kg, vg):
+    """Write a prompt's K/V (b, s, kv_heads, hd) for layer ``li`` into
+    either cache layout — the prefill counterpart of
+    :func:`_cache_write_token`."""
+    b, s, hk, hd = kg.shape
+    if isinstance(cache, PagedKVCache):
+        ps = cache.page_size
+        if s % ps:
+            raise ValueError("prefill bucket %d is not a multiple of "
+                             "page_size %d" % (s, ps))
+        n_pb = s // ps
+        if n_pb > cache.block_tables.shape[1]:
+            raise ValueError("prefill bucket %d needs %d pages/row; "
+                             "block table holds %d"
+                             % (s, n_pb, cache.block_tables.shape[1]))
+        # (b, s, hk, hd) -> (b, pages, page_size, hk, hd): position j
+        # of row r scatters to page block_tables[r, j // ps] offset
+        # j % ps — one reshape, one scatter per layer
+        bt = cache.block_tables[:, :n_pb]
+        return PagedKVCache(
+            cache.k_pages.at[li, bt].set(
+                kg.reshape(b, n_pb, ps, hk, hd)
+                .astype(cache.k_pages.dtype)),
+            cache.v_pages.at[li, bt].set(
+                vg.reshape(b, n_pb, ps, hk, hd)
+                .astype(cache.v_pages.dtype)),
+            cache.block_tables, cache.page_size)
+    # (b, s, hk, d) -> dense layout (b, hk, s, d), written [:s]
+    return {"k": cache["k"].at[li, :, :, :s].set(
+                kg.transpose(0, 2, 1, 3).astype(cache["k"].dtype)),
+            "v": cache["v"].at[li, :, :, :s].set(
+                vg.transpose(0, 2, 1, 3).astype(cache["v"].dtype))}
+
+
+def _prefill_impl(params, tokens, cache, cfg, lengths):
+    """Shared prefill body for both cache layouts: one batched causal
+    forward computes and caches every prompt position's K/V. With
+    ``lengths`` (b,) the returned logits are each row's last REAL
+    position (right-padded ragged prompts); without, position -1."""
     b, s = tokens.shape
     layers = params["layers"]
     pp, lps = jax.tree_util.tree_leaves(layers)[0].shape[:2]
@@ -654,13 +805,7 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
                 pos = jnp.arange(s)
                 q = _rope_bshd(q, pos, cfg.rope_base)
                 kg = _rope_bshd(kg, pos, cfg.rope_base)
-            # (b, s, hk, d) -> cache layout (b, hk, s, d), written [:s]
-            cache = {
-                "k": cache["k"].at[li_flat, :, :, :s].set(
-                    kg.transpose(0, 2, 1, 3).astype(cache["k"].dtype)),
-                "v": cache["v"].at[li_flat, :, :, :s].set(
-                    vg.transpose(0, 2, 1, 3).astype(cache["v"].dtype)),
-            }
+            cache = _cache_write_prompt(cache, li_flat, kg, vg)
             groups = cfg.n_heads // _kv_heads(cfg)
             k = _expand_kv(kg, groups, 2)
             v = _expand_kv(vg, groups, 2)
@@ -688,8 +833,55 @@ def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
                 f = jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
             x = x + f
             li_flat += 1
-    xl = _ln(x[:, -1], params["lnf_g"], params["lnf_b"])
+    if lengths is None:
+        xl = x[:, -1]
+    else:
+        # each row's last REAL position, not the padded tail
+        lengths = jnp.asarray(lengths, jnp.int32)
+        xl = jnp.take_along_axis(x, (lengths - 1)[:, None, None],
+                                 axis=1)[:, 0]
+    xl = _ln(xl, params["lnf_g"], params["lnf_b"])
     return xl @ params["embed"].T, cache
+
+
+def transformer_prefill(params, tokens, cache, cfg: TransformerConfig):
+    """Fill the cache from a prompt with ONE batched causal forward —
+    all prompt K/V per layer come from full-width matmuls (MXU-sized
+    work), not s sequential decode steps. Returns (last_logits, cache).
+    ``cache`` is the dense dict or a :class:`PagedKVCache` (the two
+    layouts share this body; only the K/V write dispatches)."""
+    return _prefill_impl(params, tokens, cache, cfg, lengths=None)
+
+
+def transformer_prefill_paged(params, cache: PagedKVCache, tokens,
+                              lengths, cfg: TransformerConfig):
+    """Bucketed paged prefill: ONE batched causal forward fills each
+    row's pages from its prompt and returns the logits each row needs
+    to pick its first generated token.
+
+    ``tokens``: (b, s) int32 prompts RIGHT-padded to the prefill
+    bucket ``s`` (``s`` must be a multiple of ``cache.page_size``, so
+    the page write is a pure reshape-scatter); ``lengths``: (b,) int32
+    real prompt lengths. Returns (logits at each row's position
+    ``lengths-1`` (b, V), updated cache). K/V of the padded tail land
+    in the row's own reserved pages but are never visible — decode
+    masks ``kpos <= pos`` — and causality keeps them out of every real
+    position's forward, so the result is bitwise what an unpadded
+    prefill computes."""
+    return _prefill_impl(params, tokens, cache, cfg, lengths=lengths)
+
+
+def transformer_decode_step_paged(params, k_pages, v_pages, block_tables,
+                                  tokens_t, pos, cfg: TransformerConfig,
+                                  page_size):
+    """Page-table-consuming decode step (raw-array convenience over
+    :func:`transformer_decode_step` + :class:`PagedKVCache`): returns
+    (logits (b, V), k_pages, v_pages) so a serving engine can donate
+    and rebind the pool arrays directly."""
+    paged = PagedKVCache(k_pages, v_pages, block_tables, page_size)
+    logits, paged = transformer_decode_step(params, paged, tokens_t,
+                                            pos, cfg)
+    return logits, paged.k_pages, paged.v_pages
 
 
 # compiled generation programs, keyed on everything that shapes the

@@ -10,10 +10,17 @@ users") needs on top of the one-request ``serving.Predictor``:
   queue, request coalescing, per-request deadlines, admission control,
   ahead-of-time bucket warmup, graceful drain;
 * :mod:`~mxnet_tpu.serve.http` — stdlib HTTP frontend (``POST
-  /predict`` + ``/metrics`` + ``/healthz``) returning 503 on
-  backpressure and 504 on deadline expiry;
+  /predict`` + ``POST /generate`` token streaming + ``/metrics`` +
+  ``/healthz``) returning 503 on backpressure and 504 on deadline
+  expiry;
 * :mod:`~mxnet_tpu.serve.registry` — :class:`ModelRegistry`: atomic
-  weight hot-swap with zero dropped requests.
+  weight hot-swap with zero dropped requests (attached decode
+  sessions drain first);
+* :mod:`~mxnet_tpu.serve.decode` — :class:`DecodeEngine`: continuous
+  batching for autoregressive decode — iteration-level scheduling,
+  bucketed prefill, streaming tokens (docs/decode_serving.md);
+* :mod:`~mxnet_tpu.serve.kv_pages` — :class:`PagePool`: the HBM
+  KV-cache page allocator behind the decode engine's block tables.
 
 Quick start::
 
@@ -31,14 +38,19 @@ Tuning and architecture: docs/serving.md. Knobs: ``MXNET_SERVE_*``
 (``python -m mxnet_tpu.config``).
 """
 from .batching import (pad_axis0, parse_buckets, pick_bucket,
-                       power_of_two_buckets, unpad_axis0)
+                       power_of_two_buckets, unpad_axis0,
+                       validate_buckets)
 from .engine import (DeadlineExceededError, EngineClosedError,
                      InferenceEngine, QueueFullError, ServeConfig,
                      engines_status)
+from .kv_pages import PagePool, PagePoolExhausted
+from .decode import DecodeConfig, DecodeEngine, DecodeSession
 from .http import ServeHTTPServer, serve_http
 from .registry import ModelRegistry
 
 __all__ = ["InferenceEngine", "ServeConfig", "ModelRegistry", "serve_http",
            "ServeHTTPServer", "QueueFullError", "DeadlineExceededError",
            "EngineClosedError", "engines_status", "power_of_two_buckets",
-           "parse_buckets", "pick_bucket", "pad_axis0", "unpad_axis0"]
+           "parse_buckets", "validate_buckets", "pick_bucket", "pad_axis0",
+           "unpad_axis0", "DecodeConfig", "DecodeEngine", "DecodeSession",
+           "PagePool", "PagePoolExhausted"]

@@ -23,8 +23,8 @@ import numpy as _np
 
 from ..base import MXNetError
 
-__all__ = ["power_of_two_buckets", "parse_buckets", "pick_bucket",
-           "pad_axis0", "unpad_axis0"]
+__all__ = ["power_of_two_buckets", "parse_buckets", "validate_buckets",
+           "pick_bucket", "pad_axis0", "unpad_axis0"]
 
 
 def power_of_two_buckets(max_batch):
@@ -42,30 +42,56 @@ def power_of_two_buckets(max_batch):
     return tuple(buckets)
 
 
+def validate_buckets(buckets, spec=None):
+    """Validate an EXPLICIT bucket ladder — strictly increasing
+    positive sizes — and return it as a tuple. Unsorted, duplicate, or
+    non-positive entries raise an :class:`MXNetError` naming the
+    offending spec: a ladder the operator wrote down is config, and
+    silently reordering/deduplicating config hides the typo it almost
+    certainly is (``"16,4,8"`` meant something else)."""
+    name = repr(spec) if spec is not None else repr(list(buckets))
+    buckets = tuple(int(b) for b in buckets)
+    if not buckets:
+        raise MXNetError("bucket spec %s is empty" % name)
+    for b in buckets:
+        if b < 1:
+            raise MXNetError("bucket spec %s: sizes must be >= 1 "
+                             "(got %d)" % (name, b))
+    for prev, cur in zip(buckets, buckets[1:]):
+        if cur == prev:
+            raise MXNetError("bucket spec %s has duplicate bucket %d"
+                             % (name, cur))
+        if cur < prev:
+            raise MXNetError("bucket spec %s is not sorted ascending "
+                             "(%d after %d)" % (name, cur, prev))
+    return buckets
+
+
 def parse_buckets(spec, max_batch):
     """Bucket tuple from a config spec: an explicit comma list
     (``"1,4,16"``, MXNET_SERVE_BUCKETS) or, when empty, the
-    power-of-two ladder up to ``max_batch``."""
+    power-of-two ladder up to ``max_batch``. Explicit specs must be
+    strictly increasing positive sizes (:func:`validate_buckets`)."""
     if not spec:
         return power_of_two_buckets(max_batch)
     try:
-        buckets = sorted({int(tok) for tok in str(spec).split(",") if tok})
+        buckets = [int(tok) for tok in str(spec).split(",") if tok.strip()]
     except ValueError:
         raise MXNetError("bad bucket spec %r (want e.g. '1,2,4,8')"
                          % (spec,))
-    if not buckets or buckets[0] < 1:
-        raise MXNetError("bad bucket spec %r: buckets must be >= 1"
-                         % (spec,))
-    return tuple(buckets)
+    return validate_buckets(buckets, spec)
 
 
 def pick_bucket(n, buckets):
-    """Smallest bucket holding ``n`` rows."""
+    """Smallest bucket holding ``n`` rows. ``n`` beyond the largest
+    bucket is an explicit error naming the ladder — the caller's
+    admission check should have rejected it."""
     for b in buckets:
         if b >= n:
             return b
-    raise MXNetError("batch of %d rows exceeds the largest bucket %d"
-                     % (n, buckets[-1]))
+    raise MXNetError("batch of %d rows exceeds the largest bucket of "
+                     "%s — split the request or raise the ladder"
+                     % (n, tuple(buckets)))
 
 
 def pad_axis0(arr, target):

@@ -1,0 +1,814 @@
+"""Continuous-batching autoregressive decode serving.
+
+The micro-batching :class:`~mxnet_tpu.serve.engine.InferenceEngine`
+(PR 3) is batch-at-admission: every request in a batch enters and
+leaves together, which is the right shape for stateless scoring and the
+wrong shape for autoregressive decode — one long generation holds the
+whole batch hostage and a short request pays worst-case latency.
+:class:`DecodeEngine` schedules at ITERATION granularity instead:
+
+* the scheduler loop admits and retires requests **every decode
+  step** — a finishing sequence's slot is reassigned on the next
+  iteration, not at end-of-batch;
+* **prefill** and **decode** are separate bucketed phases: prompts
+  prefill through a power-of-two ladder on prompt length (one batched
+  causal forward per admission — MXU-width matmuls), decode runs at
+  fixed slot-count buckets with every live sequence at its own depth;
+* the KV cache lives in a preallocated HBM **page pool** with
+  per-request block tables (serve/kv_pages.py +
+  ``parallel.transformer.PagedKVCache``), so the decode step is ONE
+  donated jitted program per slot bucket — traffic of arbitrary mixed
+  prompt/output lengths compiles ``len(prefill_buckets) +
+  len(slot_buckets)`` XLA programs, ever (the serve bucket ladder's
+  compile-cache discipline, extended to stateful decode);
+* **admission control** refuses work the page pool cannot cover for
+  the request's whole lifetime (prompt + max_new_tokens) — a 503
+  through the existing :class:`QueueFullError` path, with page
+  exhaustion distinct from queue depth in the error detail — so a
+  running sequence is never evicted for memory;
+* tokens **stream** as they are produced (:meth:`DecodeSession.tokens`
+  / ``POST /generate`` chunked responses in serve/http.py), under the
+  standard deadline/tracing machinery: per-step ``decode.step`` /
+  ``decode.prefill`` / ``decode.schedule`` spans fan into every
+  participating request trace exactly like ``serve.batch`` does.
+
+Decoding is greedy (argmax) — deliberately: the acceptance contract is
+that batched continuous decode is BITWISE-identical to per-request
+unbatched :func:`~mxnet_tpu.parallel.transformer.transformer_decode_step`
+decode, and tests/test_decode_serve.py asserts it token-for-token.
+
+Telemetry: ``decode/tokens_total``, ``decode/slot_occupancy``,
+``decode/page_pool_free``, ``decode/prefill_seconds`` /
+``decode/step_seconds``, ``decode/preempted_total``,
+``decode/timeouts_total``, ``decode/worker_restarts_total``.
+Knobs: ``MXNET_DECODE_*`` (config.py). Docs: docs/decode_serving.md.
+"""
+from __future__ import annotations
+
+import functools
+import queue as _queue
+import threading
+from collections import deque
+
+import numpy as _np
+
+from .. import fault as _fault
+from .. import telemetry as _tm
+from .. import tracing as _tr
+from ..base import MXNetError
+from .batching import pick_bucket, power_of_two_buckets
+from .engine import (DeadlineExceededError, EngineClosedError,
+                     QueueFullError)
+from .kv_pages import PagePool, PagePoolExhausted, pages_needed
+
+__all__ = ["DecodeConfig", "DecodeEngine", "DecodeSession"]
+
+_SENTINEL = object()
+
+
+class DecodeConfig(object):
+    """Decode-serving knobs. Defaults come from the ``MXNET_DECODE_*``
+    config tier; constructor arguments override per engine."""
+
+    __slots__ = ("slots", "page_size", "num_pages", "max_context",
+                 "queue_depth", "max_new_tokens", "default_timeout",
+                 "worker_restarts", "prefill_buckets", "slot_buckets")
+
+    def __init__(self, slots=None, page_size=None, num_pages=None,
+                 max_context=None, queue_depth=None, max_new_tokens=None,
+                 default_timeout_ms=None, worker_restarts=None):
+        from ..config import get as _cfg
+
+        def pick(val, name):
+            return _cfg(name) if val is None else val
+
+        self.slots = int(pick(slots, "MXNET_DECODE_SLOTS"))
+        self.page_size = int(pick(page_size, "MXNET_DECODE_PAGE_SIZE"))
+        self.num_pages = int(pick(num_pages, "MXNET_DECODE_NUM_PAGES"))
+        self.max_context = int(pick(max_context,
+                                    "MXNET_DECODE_MAX_CONTEXT"))
+        self.queue_depth = int(pick(queue_depth,
+                                    "MXNET_DECODE_QUEUE_DEPTH"))
+        self.max_new_tokens = int(pick(max_new_tokens,
+                                       "MXNET_DECODE_MAX_NEW_TOKENS"))
+        self.default_timeout = float(pick(
+            default_timeout_ms, "MXNET_DECODE_DEADLINE_MS")) / 1e3
+        self.worker_restarts = max(0, int(pick(
+            worker_restarts, "MXNET_SERVE_WORKER_RESTARTS")))
+        if self.slots < 1:
+            raise MXNetError("slots must be >= 1")
+        if self.queue_depth < 1:
+            raise MXNetError("queue_depth must be >= 1")
+        if self.page_size < 1:
+            raise MXNetError("page_size must be >= 1")
+        if self.max_context % self.page_size:
+            raise MXNetError(
+                "max_context=%d must be a multiple of page_size=%d "
+                "(positions map to whole pages)"
+                % (self.max_context, self.page_size))
+        # prefill ladder: page_size, 2*ps, 4*ps, ... capped at
+        # max_context (appended as the final bucket when not already a
+        # rung) — every bucket a page multiple, so the prefill page
+        # write is a pure reshape-scatter
+        buckets, b = [], self.page_size
+        while b < self.max_context:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_context)
+        self.prefill_buckets = tuple(buckets)
+        self.slot_buckets = power_of_two_buckets(self.slots)
+
+    @property
+    def pages_per_seq(self):
+        return self.max_context // self.page_size
+
+
+class DecodeSession(object):
+    """One admitted generation request: a token STREAM plus its page
+    reservation and decode cursor. Produced tokens arrive on a
+    thread-safe queue as the scheduler emits them; consume with
+    :meth:`tokens` / :meth:`next_token` (streaming) or :meth:`result`
+    (wait for the full generation)."""
+
+    __slots__ = ("prompt", "prompt_len", "max_new_tokens", "stop_token",
+                 "deadline", "t_enq", "t_admit", "t_first", "t_done",
+                 "tctx", "page_ids", "block_table", "pos", "last_token",
+                 "generated", "out_tokens", "error", "_q", "_finished")
+
+    def __init__(self, prompt, max_new_tokens, stop_token, deadline,
+                 tctx):
+        self.prompt = prompt
+        self.prompt_len = len(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.stop_token = stop_token
+        self.deadline = deadline
+        self.t_enq = _tm.monotonic()
+        self.t_admit = None
+        self.t_first = None
+        self.t_done = None
+        self.tctx = tctx
+        self.page_ids = None
+        self.block_table = None
+        self.pos = 0                     # next position to WRITE
+        self.last_token = None           # feeds the next decode step
+        self.generated = 0
+        self.out_tokens = []
+        self.error = None
+        self._q = _queue.Queue()
+        self._finished = False
+
+    # -- producer side (scheduler thread) ---------------------------------
+    def _emit(self, tok):
+        if self.t_first is None:
+            self.t_first = _tm.monotonic()
+        self.out_tokens.append(tok)
+        self.generated += 1
+        self.last_token = tok
+        self._q.put(tok)
+
+    def _finish(self, error=None):
+        if self._finished:
+            return
+        self._finished = True
+        self.error = error
+        self.t_done = _tm.monotonic()
+        if error is not None and self.tctx is not None:
+            _tr.mark_error(error, ctx=self.tctx)
+        self._q.put(_SENTINEL)
+
+    @property
+    def done(self):
+        return self._finished
+
+    # -- consumer side ----------------------------------------------------
+    def next_token(self, timeout=None):
+        """Next generated token id; None when the stream has ended.
+        Waits up to ``timeout`` (default: the session deadline); raises
+        the session's error — :class:`DeadlineExceededError` when the
+        server retired it, or locally when no token arrives in time."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - _tm.monotonic()) + 0.25
+        try:
+            tok = self._q.get(timeout=timeout)
+        except _queue.Empty:
+            raise DeadlineExceededError(
+                "no token within the per-token deadline")
+        if tok is _SENTINEL:
+            self._q.put(_SENTINEL)       # keep the stream terminal
+            if self.error is not None:
+                raise self.error
+            return None
+        return tok
+
+    def tokens(self):
+        """Generator over the token stream (blocks between tokens)."""
+        while True:
+            tok = self.next_token()
+            if tok is None:
+                return
+            yield tok
+
+    def result(self):
+        """Every generated token (blocks until the stream ends)."""
+        for _ in self.tokens():
+            pass
+        return list(self.out_tokens)
+
+
+class DecodeEngine(object):
+    """Iteration-level scheduling decode engine over one transformer.
+
+    Parameters
+    ----------
+    params : pytree
+        Transformer parameters (``init_transformer_params`` layout).
+    model_cfg : parallel.transformer.TransformerConfig
+    config : DecodeConfig, optional
+
+    Weights are traced ARGUMENTS of the compiled programs, so
+    :meth:`swap_params` rotates them with zero recompiles; the page
+    pool is donated through every prefill/step call (true in-place HBM
+    update, no double buffering).
+    """
+
+    def __init__(self, params, model_cfg, config=None):
+        self._cfg = config or DecodeConfig()
+        self._model_cfg = model_cfg
+        self._params = params
+        self._vocab = int(model_cfg.vocab_size)
+        self._pool = PagePool(self._cfg.num_pages)
+        from ..parallel.transformer import init_kv_pages
+        self._k_pages, self._v_pages = init_kv_pages(
+            model_cfg, self._cfg.num_pages, self._cfg.page_size)
+        self._prefill_progs = {}
+        self._step_progs = {}
+        self._cond = threading.Condition()
+        self._waiting = deque()
+        self._live = []
+        self._accepting = True
+        self._closing = False
+        self._ready = False
+        self._worker = None
+        self._warmup_req = None
+        self._restarts_used = 0
+
+        self._m_requests = _tm.counter(
+            "decode/requests_total", "Decode requests admitted")
+        self._m_rejected = _tm.counter(
+            "decode/rejected_total",
+            "Decode requests refused at admission (queue depth or page "
+            "pool)", ("reason",))
+        self._m_tokens = _tm.counter(
+            "decode/tokens_total", "Tokens generated (all sessions)")
+        self._m_occupancy = _tm.gauge(
+            "decode/slot_occupancy",
+            "Live decode slots (out of MXNET_DECODE_SLOTS)")
+        self._m_free = _tm.gauge(
+            "decode/page_pool_free", "Free KV-cache pages in the pool")
+        self._m_prefill = _tm.histogram(
+            "decode/prefill_seconds",
+            "Prefill wall time per admission (bucketed prompt forward)")
+        self._m_step = _tm.histogram(
+            "decode/step_seconds",
+            "Decode step wall time (one token for every live slot)")
+        self._m_preempted = _tm.counter(
+            "decode/preempted_total",
+            "Sessions retired abnormally mid-decode (crash containment "
+            "or deadline expiry in a slot)")
+        self._m_timeouts = _tm.counter(
+            "decode/timeouts_total",
+            "Sessions failed on deadline expiry (queued or decoding)")
+        self._m_free.set(self._pool.free_pages)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Spawn the scheduler thread. Idempotent."""
+        with self._cond:
+            if self._worker is not None and self._worker.is_alive():
+                return self
+            self._closing = False
+            self._accepting = True
+            self._worker = threading.Thread(
+                target=self._worker_main, name="mxnet-decode-scheduler",
+                daemon=True)
+            self._worker.start()
+        return self
+
+    def warmup(self, timeout=600.0):
+        """Ahead-of-time compile every prefill bucket and every decode
+        slot bucket (writes go to the reserved null page). After this,
+        steady-state traffic of ANY prompt/output mix never triggers an
+        XLA compile — the jit cache is exactly ``len(prefill_buckets)
+        + len(slot_buckets)`` programs.
+
+        The compiles run ON the scheduler thread (warmup posts a
+        request to the loop and waits): jax's jit cache is keyed per
+        thread-local context, so a program compiled on the caller's
+        thread can MISS when the scheduler later runs it — a stray
+        recompile per bucket on first traffic. Compile where you
+        execute."""
+        self.start()
+        req = {"event": threading.Event(), "error": None}
+        with self._cond:
+            self._warmup_req = req
+            self._cond.notify_all()
+        if not req["event"].wait(timeout):
+            raise MXNetError("decode warmup did not finish in %.0fs"
+                             % timeout)
+        if req["error"] is not None:
+            raise req["error"]
+        self._ready = True
+        return self
+
+    def _do_warmup(self):
+        """Compile + execute every bucket program (scheduler thread).
+
+        Two passes: the first pass's earliest call sees the freshly
+        created page-pool arrays, whose sharding provenance can key a
+        DIFFERENT executable than pjit outputs do — and pjit outputs
+        (each program donates and returns the pool) are the only
+        provenance steady-state traffic ever presents. The second pass
+        runs every program against pjit-provenance pools, so any such
+        re-specialization compiles here, not on the first request."""
+        for _ in range(2):
+            for b in self._cfg.prefill_buckets:
+                n_pb = b // self._cfg.page_size
+                tok0, self._k_pages, self._v_pages = \
+                    self._prefill_prog(b)(
+                        self._params, self._k_pages, self._v_pages,
+                        _np.zeros(n_pb, _np.int32),
+                        _np.zeros((1, b), _np.int32),
+                        _np.array([b], _np.int32))
+                int(tok0)                # block: compile + execute done
+            for nslots in self._cfg.slot_buckets:
+                toks, self._k_pages, self._v_pages = \
+                    self._step_prog(nslots)(
+                        self._params, self._k_pages, self._v_pages,
+                        _np.zeros((nslots, self._cfg.pages_per_seq),
+                                  _np.int32),
+                        _np.zeros(nslots, _np.int32),
+                        _np.zeros(nslots, _np.int32))
+                _np.asarray(toks)
+
+    @property
+    def ready(self):
+        """Warmed AND the scheduler thread is alive (the /healthz
+        gate, mirroring InferenceEngine.ready)."""
+        return (self._ready and self._worker is not None
+                and self._worker.is_alive())
+
+    @property
+    def config(self):
+        return self._cfg
+
+    def program_count(self):
+        """Compiled decode-path programs held (the compile-cache bound:
+        <= len(prefill_buckets) + len(slot_buckets))."""
+        return len(self._prefill_progs) + len(self._step_progs)
+
+    def pause(self, drain=True, timeout=30.0):
+        """Stop admission; with ``drain`` wait for every live and
+        queued session to finish (what ModelRegistry.swap does before a
+        weight hot-swap). Returns True when fully drained."""
+        with self._cond:
+            self._accepting = False
+            self._cond.notify_all()
+        if not drain:
+            return self._idle()
+        import time
+        t_end = _tm.monotonic() + timeout
+        while not self._idle() and _tm.monotonic() < t_end:
+            time.sleep(0.005)
+        return self._idle()
+
+    def resume(self):
+        """Re-open admission after :meth:`pause`."""
+        with self._cond:
+            if self._closing:
+                raise EngineClosedError("engine is closed")
+            self._accepting = True
+            self._cond.notify_all()
+
+    def swap_params(self, params, timeout=30.0):
+        """Hot-swap the transformer weights: drains every decode
+        session (they finish on the old weights), swaps the param
+        pytree, re-opens admission. Zero recompiles — params are traced
+        arguments of the compiled programs, not baked-in constants."""
+        if not self.pause(drain=True, timeout=timeout):
+            self.resume()
+            raise MXNetError(
+                "decode sessions did not drain within %.1fs; weights "
+                "unchanged" % timeout)
+        self._params = params
+        self.resume()
+        return self
+
+    def _idle(self):
+        with self._cond:
+            return not self._live and not self._waiting
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop admission; with ``drain`` finish every admitted
+        session, else fail them; then stop the scheduler thread."""
+        with self._cond:
+            self._accepting = False
+            if not drain:
+                for sess in list(self._waiting) + list(self._live):
+                    self._release_pages(sess)
+                    sess._finish(EngineClosedError("engine closed"))
+                self._waiting.clear()
+                del self._live[:]
+            self._closing = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=timeout)
+        self._ready = False
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, timeout_ms=None,
+               stop_token=None, ctx=None):
+        """Admit one generation request; returns its
+        :class:`DecodeSession` stream.
+
+        ``prompt``: iterable of int token ids. ``max_new_tokens``
+        defaults to (and is capped by) ``MXNET_DECODE_MAX_NEW_TOKENS``.
+        Raises :class:`QueueFullError` when the waiting queue is at
+        depth, and its subclass :class:`~.kv_pages.PagePoolExhausted`
+        when the page pool cannot cover prompt + max_new_tokens — both
+        map to HTTP 503, distinguishable by the error detail. The page
+        reservation covers the request's WHOLE lifetime, so an admitted
+        session can never be evicted for memory.
+        """
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        for t in prompt:
+            if t < 0 or t >= self._vocab:
+                raise MXNetError("prompt token %d outside the model "
+                                 "vocabulary [0, %d)" % (t, self._vocab))
+        max_new = (self._cfg.max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        max_new = min(max_new, self._cfg.max_new_tokens)
+        plen = len(prompt)
+        if plen > self._cfg.prefill_buckets[-1]:
+            raise MXNetError(
+                "prompt of %d tokens exceeds the largest prefill "
+                "bucket %d" % (plen, self._cfg.prefill_buckets[-1]))
+        if plen + max_new > self._cfg.max_context:
+            raise MXNetError(
+                "prompt (%d) + max_new_tokens (%d) exceeds "
+                "max_context=%d" % (plen, max_new, self._cfg.max_context))
+        timeout = (self._cfg.default_timeout if timeout_ms is None
+                   else float(timeout_ms) / 1e3)
+        deadline = (_tm.monotonic() + timeout) if timeout > 0 else None
+        sess = DecodeSession(prompt, max_new, stop_token, deadline,
+                             ctx if ctx is not None else _tr.active())
+        # pages for the whole lifetime: the prefill BUCKET (its page
+        # write covers the padded prompt) and prompt+max_new positions
+        ps = self._cfg.page_size
+        n_pages = max(pages_needed(plen + max_new, ps),
+                      pages_needed(pick_bucket(
+                          plen, self._cfg.prefill_buckets), ps))
+        with self._cond:
+            if not self._accepting or self._closing:
+                self._m_rejected.labels("closed").inc()
+                raise EngineClosedError(
+                    "decode engine is draining/closed")
+            if len(self._waiting) >= self._cfg.queue_depth:
+                self._m_rejected.labels("queue_depth").inc()
+                raise QueueFullError(
+                    "decode queue full (%d requests waiting); retry "
+                    "later" % self._cfg.queue_depth)
+            try:
+                sess.page_ids = self._pool.alloc(n_pages)
+            except PagePoolExhausted:
+                self._m_rejected.labels("pages").inc()
+                raise
+            bt = _np.zeros(self._cfg.pages_per_seq, _np.int32)
+            bt[:n_pages] = sess.page_ids
+            sess.block_table = bt
+            self._waiting.append(sess)
+            self._m_requests.inc()
+            self._m_free.set(self._pool.free_pages)
+            self._cond.notify_all()
+        return sess
+
+    def generate(self, prompt, max_new_tokens=None, timeout_ms=None,
+                 stop_token=None):
+        """Synchronous convenience: submit + wait + full token list."""
+        return self.submit(prompt, max_new_tokens, timeout_ms,
+                           stop_token).result()
+
+    def cancel(self, sess, reason="cancelled"):
+        """Abort a session — the backpressure release for a client that
+        disconnected mid-stream (serve/http.py calls this), so dead
+        sessions stop holding slots and pages until their deadline.
+
+        A waiting session releases its pages immediately (no compute
+        ever touched them). A live one is marked failed and SWEPT by
+        the scheduler at the next iteration boundary: its pages may
+        still be written by in-flight compute this step, so freeing
+        them here could hand them to a new admission mid-write.
+        Returns True when this call cancelled the session."""
+        err = MXNetError("decode session cancelled: %s" % reason)
+        with self._cond:
+            if sess.done:
+                return False
+            if sess in self._waiting:
+                self._waiting.remove(sess)
+                self._release_pages(sess)
+                sess._finish(err)
+                self._m_free.set(self._pool.free_pages)
+                return True
+            sess._finish(err)            # scheduler sweep retires it
+            self._cond.notify_all()
+            return True
+
+    # -- scheduler ---------------------------------------------------------
+    def _worker_main(self):
+        """Run the scheduler loop; on a crash (a bug, an injected
+        ``decode.step`` fault, a device wedge) retire every live
+        session — their slots free, their pages return to the pool —
+        and restart the loop in place, up to the shared restart
+        budget. The page pool arrays are rebuilt (donated buffers are
+        in an undefined state after a mid-step failure); retirement is
+        exactly what frees the crashed sessions' pages."""
+        while True:
+            try:
+                self._loop()
+                return                   # clean exit: engine closed
+            except BaseException as exc:
+                self._crash_recover(exc)
+                with self._cond:
+                    if self._closing:
+                        return
+                    if self._restarts_used >= self._cfg.worker_restarts:
+                        import logging
+                        logging.error(
+                            "decode scheduler crashed (%s) with the "
+                            "restart budget (%d) exhausted; decode "
+                            "serving stays down", exc,
+                            self._cfg.worker_restarts)
+                        return
+                    self._restarts_used += 1
+                _tm.counter("decode/worker_restarts_total",
+                            "Decode scheduler threads restarted after "
+                            "a crash").inc()
+
+    def _crash_recover(self, exc):
+        err = MXNetError("decode step failed: %s" % exc)
+        with self._cond:
+            victims = list(self._live) + list(self._waiting)
+            del self._live[:]
+            self._waiting.clear()
+            for sess in victims:
+                self._release_pages(sess)
+                self._m_preempted.inc()
+                sess._finish(err)
+            self._m_occupancy.set(0)
+            self._m_free.set(self._pool.free_pages)
+        # donated pool buffers are unusable after a mid-program crash;
+        # same-shape zeros re-hit the warmed fill program (no new
+        # compile)
+        from ..parallel.transformer import init_kv_pages
+        self._k_pages, self._v_pages = init_kv_pages(
+            self._model_cfg, self._cfg.num_pages, self._cfg.page_size)
+
+    def _release_pages(self, sess):
+        if sess.page_ids:
+            self._pool.free(sess.page_ids)
+            sess.page_ids = None
+
+    def _retire_locked(self, sess, error=None):
+        """Retire a session (caller holds the lock): slot freed for
+        next iteration's admission, pages back to the pool."""
+        if sess in self._live:
+            self._live.remove(sess)
+        self._release_pages(sess)
+        sess._finish(error)
+        self._m_occupancy.set(len(self._live))
+        self._m_free.set(self._pool.free_pages)
+
+    def _loop(self):
+        while True:
+            _fault.inject("decode.step")
+            with self._cond:
+                wreq, self._warmup_req = self._warmup_req, None
+            if wreq is not None:
+                try:
+                    self._do_warmup()
+                except BaseException as exc:
+                    wreq["error"] = exc
+                finally:
+                    wreq["event"].set()
+            with self._cond:
+                while (not self._waiting and not self._live
+                       and self._warmup_req is None):
+                    if self._closing:
+                        return
+                    self._cond.wait(0.05)
+                if self._warmup_req is not None:
+                    continue
+                t_sched0 = _tm.monotonic()
+                evictions = self._expire_locked()
+                admits = []
+                while (self._waiting
+                       and len(self._live) < self._cfg.slots):
+                    sess = self._waiting.popleft()
+                    # joins the slot list BEFORE its prefill runs (so a
+                    # concurrent close/crash-recover can't lose it);
+                    # t_admit is None until the prefill lands, which
+                    # keeps it out of this iteration's step batch
+                    self._live.append(sess)
+                    admits.append(sess)
+                self._m_occupancy.set(len(self._live))
+                t_sched1 = _tm.monotonic()
+            if admits or evictions:
+                self._record_schedule(admits, evictions,
+                                      t_sched0, t_sched1)
+            for sess in admits:
+                self._prefill(sess)
+            self._step()
+
+    def _expire_locked(self):
+        """Fail sessions past their deadline (queued: before a prefill
+        is wasted on them; live: the slot frees this iteration) and
+        sweep cancelled live sessions whose pages were kept until
+        in-flight compute landed. Returns the number evicted."""
+        now = _tm.monotonic()
+        evicted = 0
+        for sess in [s for s in self._live if s.done]:
+            # cancelled mid-decode: no compute is in flight between
+            # iterations, so the deferred page release is safe now
+            self._live.remove(sess)
+            self._release_pages(sess)
+            self._m_preempted.inc()
+            evicted += 1
+        self._m_occupancy.set(len(self._live))
+        self._m_free.set(self._pool.free_pages)
+        for sess in [s for s in self._waiting
+                     if s.deadline is not None and now > s.deadline]:
+            self._waiting.remove(sess)
+            self._release_pages(sess)
+            self._m_timeouts.inc()
+            evicted += 1
+            sess._finish(DeadlineExceededError(
+                "deadline expired after %.0f ms in the decode queue"
+                % ((now - sess.t_enq) * 1e3)))
+        for sess in [s for s in self._live
+                     if s.deadline is not None and now > s.deadline]:
+            self._m_timeouts.inc()
+            self._m_preempted.inc()
+            evicted += 1
+            self._retire_locked(sess, DeadlineExceededError(
+                "deadline expired after %d of %d tokens"
+                % (sess.generated, sess.max_new_tokens)))
+        return evicted
+
+    def _record_schedule(self, admits, evictions, t0, t1):
+        sid = None
+        attrs = {"slots": len(self._live),
+                 "live_pages": self._pool.used_pages,
+                 "evictions": evictions}
+        for sess in admits:
+            ctx = sess.tctx
+            if ctx is None or not ctx.sampled:
+                continue
+            if sid is None:
+                sid = _tr.new_span_id()
+            _tr.record_span("decode.schedule", ctx, t0, t1,
+                            span_id=sid, parent_id=ctx.span_id,
+                            attrs=attrs)
+
+    def _prefill(self, sess):
+        """Bucketed prefill for one admission: pad the prompt to its
+        power-of-two ladder bucket, run ONE batched causal forward
+        that writes the prompt K/V into the session's pages, and emit
+        the first generated token from the logits at the last real
+        position."""
+        bucket = pick_bucket(sess.prompt_len, self._cfg.prefill_buckets)
+        n_pb = bucket // self._cfg.page_size
+        with self._cond:
+            if sess.done:                # failed concurrently (close/
+                return                   # cancel/deadline) pre-prefill
+            # snapshot under the lock: a concurrent close may null
+            # page_ids the instant the session is failed
+            page_ids = _np.asarray(sess.page_ids[:n_pb], _np.int32)
+        padded = _np.zeros((1, bucket), _np.int32)
+        padded[0, :sess.prompt_len] = sess.prompt
+        t0 = _tm.monotonic()
+        tok0, self._k_pages, self._v_pages = self._prefill_prog(bucket)(
+            self._params, self._k_pages, self._v_pages, page_ids, padded,
+            _np.array([sess.prompt_len], _np.int32))
+        tok0 = int(tok0)
+        t1 = _tm.monotonic()
+        self._m_prefill.observe(
+            t1 - t0, trace_id=sess.tctx.trace_id if sess.tctx else None)
+        if sess.tctx is not None and sess.tctx.sampled:
+            _tr.record_span("decode.prefill", sess.tctx, t0, t1,
+                            parent_id=sess.tctx.span_id,
+                            attrs={"bucket": bucket,
+                                   "prompt_len": sess.prompt_len})
+        with self._cond:
+            if sess.done:
+                return
+            sess.t_admit = t0
+            sess.pos = sess.prompt_len
+            self._emit_locked(sess, tok0)
+
+    def _emit_locked(self, sess, tok):
+        """Deliver one token; retire the session once it hits its
+        max_new_tokens budget or its stop token (caller holds the
+        lock — retirement mutates the slot list)."""
+        sess._emit(tok)
+        self._m_tokens.inc()
+        if (sess.generated >= sess.max_new_tokens
+                or (sess.stop_token is not None
+                    and tok == sess.stop_token)):
+            self._retire_locked(sess)
+
+    def _step(self):
+        """One decode iteration: every live slot advances one token
+        through the slot-bucket program (dummy slots write the null
+        page and are discarded)."""
+        with self._cond:
+            live = [s for s in self._live if s.t_admit is not None]
+        if not live:
+            return
+        nslots = pick_bucket(len(live), self._cfg.slot_buckets)
+        tokens = _np.zeros(nslots, _np.int32)
+        pos = _np.zeros(nslots, _np.int32)
+        bt = _np.zeros((nslots, self._cfg.pages_per_seq), _np.int32)
+        for i, sess in enumerate(live):
+            tokens[i] = sess.last_token
+            pos[i] = sess.pos
+            bt[i] = sess.block_table
+        t0 = _tm.monotonic()
+        toks, self._k_pages, self._v_pages = self._step_prog(nslots)(
+            self._params, self._k_pages, self._v_pages, bt, tokens, pos)
+        toks = _np.asarray(toks)
+        t1 = _tm.monotonic()
+        self._m_step.observe(t1 - t0)
+
+        traced = [s for s in live
+                  if s.tctx is not None and s.tctx.sampled]
+        if traced:
+            sid = _tr.new_span_id()
+            attrs = {"slots": len(live), "bucket": nslots,
+                     "live_pages": self._pool.used_pages}
+            for sess in traced:
+                _tr.record_span("decode.step", sess.tctx, t0, t1,
+                                span_id=sid,
+                                parent_id=sess.tctx.span_id,
+                                attrs=attrs)
+        with self._cond:
+            for i, sess in enumerate(live):
+                if sess.done:            # expired/retired concurrently
+                    continue
+                sess.pos += 1
+                self._emit_locked(sess, int(toks[i]))
+
+    # -- compiled programs -------------------------------------------------
+    def _prefill_prog(self, bucket):
+        prog = self._prefill_progs.get(bucket)
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+            from ..parallel.transformer import (PagedKVCache,
+                                                transformer_prefill_paged)
+            cfg, ps = self._model_cfg, self._cfg.page_size
+
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def prog(params, k_pages, v_pages, page_ids, tokens, length):
+                paged = PagedKVCache(k_pages, v_pages, page_ids[None],
+                                     ps)
+                logits, paged = transformer_prefill_paged(
+                    params, paged, tokens, length, cfg)
+                return (jnp.argmax(logits, -1).astype(jnp.int32)[0],
+                        paged.k_pages, paged.v_pages)
+
+            self._prefill_progs[bucket] = prog
+        return prog
+
+    def _step_prog(self, nslots):
+        prog = self._step_progs.get(nslots)
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+            from ..parallel.transformer import (PagedKVCache,
+                                                transformer_decode_step)
+            cfg, ps = self._model_cfg, self._cfg.page_size
+
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def prog(params, k_pages, v_pages, block_tables, tokens,
+                     pos):
+                paged = PagedKVCache(k_pages, v_pages, block_tables, ps)
+                logits, paged = transformer_decode_step(
+                    params, paged, tokens, pos, cfg)
+                return (jnp.argmax(logits, -1).astype(jnp.int32),
+                        paged.k_pages, paged.v_pages)
+
+            self._step_progs[nslots] = prog
+        return prog

@@ -42,7 +42,7 @@ from .. import fault as _fault
 from ..base import MXNetError
 from .. import telemetry as _tm
 from .. import tracing as _tr
-from .batching import parse_buckets, pick_bucket
+from .batching import parse_buckets, pick_bucket, validate_buckets
 
 __all__ = ["ServeConfig", "InferenceEngine", "QueueFullError",
            "DeadlineExceededError", "EngineClosedError", "engines_status"]
@@ -105,10 +105,7 @@ class ServeConfig(object):
         spec = buckets if buckets is not None \
             else _cfg("MXNET_SERVE_BUCKETS")
         if isinstance(spec, (tuple, list)):
-            self.buckets = tuple(sorted(set(int(b) for b in spec)))
-            if not self.buckets or self.buckets[0] < 1:
-                raise MXNetError("buckets must be a non-empty list of "
-                                 "sizes >= 1, got %r" % (spec,))
+            self.buckets = validate_buckets(spec)
         else:
             self.buckets = parse_buckets(spec, self.max_batch)
         # the ladder caps the admissible request size

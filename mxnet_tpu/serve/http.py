@@ -7,6 +7,13 @@ deployment without adding dependencies. One threaded server mounts:
   onto the status codes a load balancer expects: **503** on admission
   rejection (full queue / draining; ``Retry-After`` set), **504** on
   deadline expiry, **400** on malformed input.
+* ``POST /generate`` — autoregressive decode through a
+  :class:`~mxnet_tpu.serve.decode.DecodeEngine` (continuous batching +
+  paged KV cache). Streams tokens as newline-delimited JSON chunks
+  (``Transfer-Encoding: chunked``) as the scheduler produces them, or
+  returns one JSON body with ``"stream": false``. Same status mapping;
+  a 503 names whether the queue or the KV page pool is the saturated
+  resource.
 * ``GET /healthz`` — ``ok`` once every batch bucket is compiled
   (:meth:`InferenceEngine.warmup`) and the workers are live
   (:meth:`InferenceEngine.start`), **503** ``warming`` before that; a
@@ -14,7 +21,7 @@ deployment without adding dependencies. One threaded server mounts:
 * ``GET /metrics`` — the shared telemetry registry in Prometheus text
   format (same payload as ``telemetry.serve``; scrape either).
 
-Request body::
+``/predict`` request body::
 
     {"inputs": {"data": [[...], ...]}, "timeout_ms": 500}
 
@@ -23,9 +30,22 @@ or, for single-input models, the bare array ``{"data": [[...], ...]}``
 
     {"outputs": [[[...], ...]], "rows": N}
 
+``/generate`` request body::
+
+    {"prompt": [1, 5, 9], "max_new_tokens": 32, "timeout_ms": 30000,
+     "stream": true, "stop_token": 2}
+
+Streaming response: one ``{"token": t}`` JSON line per generated token,
+then ``{"done": true, "n": N}`` (or ``{"error": ..., "code": ...}`` if
+the session dies mid-stream — the status line was already sent).
+Non-streaming: ``{"tokens": [...], "n": N}``.
+
 ``target`` is an :class:`InferenceEngine` or a
 :class:`serve.ModelRegistry` (hot-swap safe) — anything with
-``submit(feed, timeout_ms)`` and ``ready``.
+``submit(feed, timeout_ms)`` and ``ready`` — or None for a decode-only
+frontend. ``decode`` is a :class:`~mxnet_tpu.serve.decode.DecodeEngine`
+(defaults to ``target.decode_engine()`` when the target is a registry
+with one attached).
 """
 from __future__ import annotations
 
@@ -49,10 +69,11 @@ _REQ_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
 class ServeHTTPServer(object):
     """Handle on a running serving frontend (from :func:`serve_http`)."""
 
-    def __init__(self, httpd, thread, target):
+    def __init__(self, httpd, thread, target, decode=None):
         self._httpd = httpd
         self._thread = thread
         self.target = target
+        self.decode = decode
         self.port = httpd.server_address[1]
         self.url = "http://%s:%d" % (httpd.server_address[0], self.port)
 
@@ -99,10 +120,44 @@ def _parse_body(target, body):
     return feed, timeout_ms
 
 
-def serve_http(target, port=0, addr="127.0.0.1"):
+def _parse_generate_body(body):
+    """(prompt, kwargs, stream) from a /generate request body; raises
+    MXNetError on malformed input (mapped to 400)."""
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MXNetError("request body is not valid JSON: %s" % e)
+    if isinstance(payload, list):
+        payload = {"prompt": payload}
+    if not isinstance(payload, dict) or "prompt" not in payload:
+        raise MXNetError('post {"prompt": [token ids], ...}')
+    prompt = payload["prompt"]
+    if not isinstance(prompt, list) or not prompt \
+            or not all(isinstance(t, int) for t in prompt):
+        raise MXNetError('"prompt" must be a non-empty list of int '
+                         'token ids')
+    kwargs = {}
+    for key in ("max_new_tokens", "timeout_ms", "stop_token"):
+        if payload.get(key) is not None:
+            val = payload[key]
+            if not isinstance(val, (int, float)):
+                raise MXNetError('"%s" must be a number' % key)
+            kwargs[key] = val
+    return prompt, kwargs, bool(payload.get("stream", True))
+
+
+def serve_http(target, port=0, addr="127.0.0.1", decode=None):
     """Start the serving frontend; returns a :class:`ServeHTTPServer`
     (``port=0`` picks a free port — read it from the handle)."""
     import http.server
+
+    if decode is None and target is not None:
+        getter = getattr(target, "decode_engine", None)
+        if callable(getter):
+            decode = getter()
+    if target is None and decode is None:
+        raise MXNetError("serve_http needs a predict target and/or a "
+                         "decode engine")
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -132,7 +187,9 @@ def serve_http(target, port=0, addr="127.0.0.1"):
                             ctype="text/plain; version=0.0.4; "
                                   "charset=utf-8")
             elif path == "/healthz":
-                if target.ready:
+                ok = ((target is None or target.ready)
+                      and (decode is None or decode.ready))
+                if ok:
                     self._reply(200, b"ok\n",
                                 ctype="text/plain; charset=utf-8")
                 else:
@@ -148,7 +205,12 @@ def serve_http(target, port=0, addr="127.0.0.1"):
             self._rid = None             # keep-alive: no stale echo
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)   # always drain: HTTP/1.1
-            if self.path.split("?")[0] != "/predict":
+            path = self.path.split("?")[0]
+            if path == "/predict" and target is not None:
+                handler = self._predict
+            elif path == "/generate" and decode is not None:
+                handler = self._generate
+            else:
                 # keep-alive reuses the socket; an unread body would be
                 # parsed as the next request line
                 self._reply(404, {"error": "not found"})
@@ -160,8 +222,8 @@ def serve_http(target, port=0, addr="127.0.0.1"):
                 rid = _tr.new_trace_id()
             self._rid = rid
             with _tr.start_span("http.request", trace_id=rid,
-                                attrs={"path": "/predict"}) as span:
-                self._predict(body, span)
+                                attrs={"path": path}) as span:
+                handler(body, span)
 
         def _predict(self, body, span):
             try:
@@ -213,6 +275,78 @@ def serve_http(target, port=0, addr="127.0.0.1"):
             span.set_attr("rows", req.rows)
             self._reply(200, body)
 
+        def _chunk(self, obj):
+            """One chunked-transfer frame holding one JSON line."""
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        def _generate(self, body, span):
+            try:
+                prompt, kwargs, stream = _parse_generate_body(body)
+                sess = decode.submit(prompt, ctx=span.ctx, **kwargs)
+            except (QueueFullError, EngineClosedError) as e:
+                # PagePoolExhausted subclasses QueueFullError: same 503
+                # path, page-exhaustion named in the error detail
+                span.set_attr("http_status", 503)
+                _tr.mark_error(e, ctx=span.ctx)
+                self._reply(503, {"error": str(e)},
+                            headers=(("Retry-After", "1"),))
+                return
+            except (MXNetError, ValueError, TypeError) as e:
+                span.set_attr("http_status", 400)
+                self._reply(400, {"error": str(e)})
+                return
+
+            if not stream:
+                try:
+                    toks = sess.result()
+                except DeadlineExceededError as e:
+                    span.set_attr("http_status", 504)
+                    _tr.mark_error(e, ctx=span.ctx)
+                    self._reply(504, {"error": str(e)})
+                    return
+                except MXNetError as e:
+                    span.set_attr("http_status", 500)
+                    _tr.mark_error(e, ctx=span.ctx)
+                    self._reply(500, {"error": str(e)})
+                    return
+                span.set_attr("tokens", len(toks))
+                self._reply(200, {"tokens": toks, "n": len(toks)})
+                return
+
+            # streaming: the status line goes out before the first
+            # token exists, so mid-stream failures ride an in-band
+            # {"error": ...} line (the span still records the status)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            if self._rid is not None:
+                self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            n = 0
+            try:
+                try:
+                    for tok in sess.tokens():
+                        self._chunk({"token": tok})
+                        n += 1
+                    self._chunk({"done": True, "n": n})
+                except DeadlineExceededError as e:
+                    span.set_attr("http_status", 504)
+                    _tr.mark_error(e, ctx=span.ctx)
+                    self._chunk({"error": str(e), "code": 504})
+                except MXNetError as e:
+                    span.set_attr("http_status", 500)
+                    _tr.mark_error(e, ctx=span.ctx)
+                    self._chunk({"error": str(e), "code": 500})
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # client hung up mid-stream: cancel the session so its
+                # slot and page reservation free NOW, not at deadline
+                decode.cancel(sess, "client disconnected")
+            span.set_attr("tokens", n)
+
         def log_message(self, *args):    # no stderr chatter per request
             pass
 
@@ -221,4 +355,4 @@ def serve_http(target, port=0, addr="127.0.0.1"):
     thread = threading.Thread(target=httpd.serve_forever,
                               name="mxnet-serve-http", daemon=True)
     thread.start()
-    return ServeHTTPServer(httpd, thread, target)
+    return ServeHTTPServer(httpd, thread, target, decode)

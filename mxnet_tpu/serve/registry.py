@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 
 from .. import telemetry as _tm
+from ..base import MXNetError
 from .engine import EngineClosedError, InferenceEngine, ServeConfig
 
 __all__ = ["ModelRegistry"]
@@ -36,6 +37,7 @@ class ModelRegistry(object):
         self._input_types = input_types
         self._cfg = config or ServeConfig()
         self._lock = threading.Lock()
+        self._decode = None
         self._m_swaps = _tm.counter(
             "serving/swaps_total", "Model hot-swaps completed")
         self._engine = self._build(param_bytes)
@@ -80,14 +82,41 @@ class ModelRegistry(object):
     def predict(self, feed, timeout_ms=None):
         return self.submit(feed, timeout_ms).result()
 
+    # -- decode attachment -------------------------------------------------
+    def attach_decode(self, engine):
+        """Attach a :class:`~mxnet_tpu.serve.decode.DecodeEngine`
+        serving this model's autoregressive traffic. :meth:`swap` then
+        DRAINS its decode sessions before the hot-swap (every in-flight
+        generation finishes before the flip; pass ``decode_params`` to
+        rotate the decode weights inside the same quiesced window), and
+        :func:`serve_http` routes ``POST /generate`` to it."""
+        self._decode = engine
+        return engine
+
+    def decode_engine(self):
+        """The attached decode engine, or None."""
+        return self._decode
+
     # -- lifecycle ---------------------------------------------------------
-    def swap(self, param_bytes, drain_timeout=30.0):
+    def swap(self, param_bytes, drain_timeout=30.0, decode_params=None):
         """Hot-swap to a new params blob with zero dropped requests.
 
         Builds + warms the replacement engine while the old one keeps
-        serving, flips the active reference atomically, then drains the
+        serving, DRAINS any attached decode engine's sessions BEFORE
+        the flip (each in-flight generation finishes on the weights it
+        started with; new ``/generate`` admissions 503 for the drain
+        window), flips the active reference atomically, then drains the
         old engine (its queued requests complete on the old weights).
-        Returns the new engine."""
+
+        ``decode_params``: the decode engine's new transformer weight
+        pytree (its weights are a separate artifact from the predictor
+        blob). When given, they rotate inside the quiesced window — the
+        predictor flip and the decode weights move together, so no
+        generation and no scoring batch ever mixes versions. When
+        omitted, the decode engine keeps its current weights (the drain
+        still quiesces decode across the flip); call
+        ``DecodeEngine.swap_params`` separately if they rotate on their
+        own cadence. Returns the new engine."""
         new = self._build(param_bytes)
         try:
             new.warmup()                  # compiles land BEFORE the flip
@@ -96,11 +125,34 @@ class ModelRegistry(object):
             # its HBM weight copy; the old engine keeps serving
             new.close(drain=False)
             raise
-        with self._lock:
-            old, self._engine = self._engine, new
+        decode = self._decode
+        if decode is not None:
+            # decode sessions drain BEFORE the flip: generation state
+            # (the KV cache) is weight-coupled in a way stateless
+            # predict batches are not
+            if not decode.pause(drain=True, timeout=drain_timeout):
+                decode.resume()
+                new.close(drain=False)
+                raise MXNetError(
+                    "decode sessions did not drain within %.1fs; "
+                    "swap aborted, old weights still serving"
+                    % drain_timeout)
+            if decode_params is not None:
+                # engine is idle (paused + drained): a plain rebind is
+                # race-free, and programs take params as traced
+                # arguments, so no recompiles either
+                decode._params = decode_params
+        try:
+            with self._lock:
+                old, self._engine = self._engine, new
+        finally:
+            if decode is not None:
+                decode.resume()
         self._m_swaps.inc()
         old.close(drain=True, timeout=drain_timeout)
         return new
 
     def close(self, drain=True, timeout=30.0):
+        if self._decode is not None:
+            self._decode.close(drain=drain, timeout=timeout)
         self.engine().close(drain=drain, timeout=timeout)

@@ -743,6 +743,14 @@ def snapshot():
            "serve_timeouts": _val("serving/timeouts_total"),
            "serve_batches": _val("serving/batches_total"),
            "serve_swaps": _val("serving/swaps_total"),
+           # continuous-batching decode accounting (serve.DecodeEngine):
+           # token volume, admission refusals, and abnormal slot
+           # retirements banked with decode_serve bench records
+           "decode_requests": _val("decode/requests_total"),
+           "decode_rejected": _val("decode/rejected_total"),
+           "decode_tokens": _val("decode/tokens_total"),
+           "decode_preempted": _val("decode/preempted_total"),
+           "decode_timeouts": _val("decode/timeouts_total"),
            # fault-tolerance accounting: crash-consistent checkpoint
            # traffic, kvstore transport retries, serve worker crashes,
            # and armed faults fired (test runs) — the robustness

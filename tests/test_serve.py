@@ -76,6 +76,33 @@ def test_bucket_helpers():
         pick_bucket(9, (1, 2, 4, 8))
 
 
+def test_bucket_spec_hardening():
+    """Satellite: explicit bucket specs must be strictly increasing
+    positive sizes — unsorted/duplicate/non-positive specs raise an
+    MXNetError NAMING the spec instead of being silently normalized."""
+    from mxnet_tpu.serve import parse_buckets, validate_buckets
+    assert parse_buckets("1,4,16", 8) == (1, 4, 16)
+    assert parse_buckets(" 1, 2 ,4 ", 8) == (1, 2, 4)
+    assert parse_buckets("", 8) == (1, 2, 4, 8)
+    for bad in ("16,4,8", "1,2,2,4", "0,1,2", "-1,2", "1,zap,4", ","):
+        with pytest.raises(MXNetError) as ei:
+            parse_buckets(bad, 8)
+        assert repr(bad) in str(ei.value)   # names the offending spec
+    # the same contract guards programmatic ladders (ServeConfig lists)
+    with pytest.raises(MXNetError):
+        validate_buckets([8, 2])
+    with pytest.raises(MXNetError):
+        validate_buckets([2, 2])
+    with pytest.raises(MXNetError):
+        validate_buckets([])
+    with pytest.raises(MXNetError):
+        ServeConfig(buckets=[4, 1])
+    # pick_bucket beyond the ladder: explicit error naming the ladder
+    with pytest.raises(MXNetError) as ei:
+        pick_bucket(9, (1, 2, 4, 8))
+    assert "(1, 2, 4, 8)" in str(ei.value)
+
+
 def test_pad_unpad():
     x = np.arange(12, dtype=np.float32).reshape(3, 4)
     p = pad_axis0(x, 8)
