@@ -1148,24 +1148,46 @@ def trace_overhead(iters=300, rounds=12):
 # ---------------------------------------------------------------------------
 # serving job (serve.InferenceEngine under offered load)
 
-def serve_predictor(offered_rps=400, clients=16, duration=4.0,
-                    max_batch=16, feature=256, hidden=256, classes=64,
-                    batch_wait_ms=2):
-    """Online-serving throughput/latency at FIXED offered load: N client
-    threads each fire requests on an absolute schedule totalling
-    ``offered_rps`` through the dynamic micro-batcher
-    (serve.InferenceEngine), and we bank achieved req/s, p50/p99
-    latency, the realized mean batch size, and padding waste — the
-    serving analog of the training jobs' img/s+telemetry records. The
-    model is a small MLP so the number probes the BATCHING ENGINE
-    (queueing, coalescing, bucket dispatch), not matmul throughput."""
-    import tempfile
+def _serve_offered_load(eng, make_feed, offered_rps, clients, duration):
+    """Fire ``offered_rps`` requests/s at ``eng`` from ``clients``
+    threads on an absolute schedule (fixed offered load, not closed
+    loop); returns (sorted latency array seconds, error count).
+    ``make_feed(client_idx)`` builds each client's request feed once."""
     import threading
-    import mxnet_tpu as mx
-    from . import telemetry as _tm
-    from .serve import InferenceEngine, ServeConfig
-    from .serving import Predictor
+    per_client = [[] for _ in range(clients)]
+    errors = [0] * clients
+    interval = clients / float(offered_rps)
+    t_start = time.time() + 0.05
 
+    def client(idx):
+        feed = make_feed(idx)
+        tick = t_start + idx * interval / clients
+        while tick < t_start + duration:
+            now = time.time()
+            if now < tick:
+                time.sleep(tick - now)
+            t0 = time.time()
+            try:
+                eng.predict(feed)
+                per_client[idx].append(time.time() - t0)
+            except Exception:
+                errors[idx] += 1
+            tick += interval
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return np.array(sorted(sum(per_client, []))), int(sum(errors))
+
+
+def _serve_mlp_symbol(feature, hidden, classes):
+    """The serving benches' probe model: softmax(FC(relu(FC(data)))) —
+    small, so the numbers probe the BATCHING ENGINE, not matmuls.
+    Returns (symbol, {arg:... params})."""
+    import mxnet_tpu as mx
     data = mx.sym.Variable("data")
     h = mx.sym.Activation(
         mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
@@ -1182,10 +1204,34 @@ def serve_predictor(offered_rps=400, clients=16, duration=4.0,
             rng.randn(classes, hidden).astype(np.float32) * 0.05),
         "arg:fc2_bias": mx.nd.array(np.zeros(classes, np.float32)),
     }
+    return sym, params
+
+
+def serve_predictor(offered_rps=400, clients=16, duration=4.0,
+                    max_batch=16, feature=256, hidden=256, classes=64,
+                    batch_wait_ms=2):
+    """Online-serving throughput/latency at FIXED offered load: N client
+    threads each fire requests on an absolute schedule totalling
+    ``offered_rps`` through the dynamic micro-batcher
+    (serve.InferenceEngine), and we bank achieved req/s, p50/p99
+    latency, the realized mean batch size, and padding waste — the
+    serving analog of the training jobs' img/s+telemetry records. The
+    model is a small MLP so the number probes the BATCHING ENGINE
+    (queueing, coalescing, bucket dispatch), not matmul throughput."""
+    import tempfile
+    import mxnet_tpu as mx
+    from . import telemetry as _tm
+    from .serve import InferenceEngine, ServeConfig
+    from .serving import Predictor
+
+    sym, params = _serve_mlp_symbol(feature, hidden, classes)
     with tempfile.NamedTemporaryFile(suffix=".params") as f:
         mx.nd.save(f.name, params)
-        f.seek(0)
-        blob = f.read()
+        # re-open by NAME: the atomic save os.replace'd a fresh inode
+        # over f.name, so the original handle reads the stale (empty)
+        # one — a latent tear since nd.save went crash-consistent
+        with open(f.name, "rb") as g:
+            blob = g.read()
     import jax
     dev_type = 2 if jax.devices()[0].platform == "tpu" else 1
     pred = Predictor(sym.tojson(), blob, dev_type=dev_type,
@@ -1209,38 +1255,16 @@ def serve_predictor(offered_rps=400, clients=16, duration=4.0,
     snap0 = _tm.snapshot()
     rows0, nb0 = _hist_state("serving/batch_rows")
     waste0, nw0 = _hist_state("serving/padding_waste_ratio")
-    per_client = [[] for _ in range(clients)]
-    errors = [0] * clients
-    interval = clients / float(offered_rps)
-    t_start = time.time() + 0.05
 
-    def client(idx):
+    def make_feed(idx):
         # per-thread RandomState: the shared module-level rng is not
         # thread-safe under concurrent draws
-        x = np.random.RandomState(1000 + idx).randn(
-            1, feature).astype(np.float32) + idx
-        tick = t_start + idx * interval / clients
-        while tick < t_start + duration:
-            now = time.time()
-            if now < tick:
-                time.sleep(tick - now)
-            t0 = time.time()
-            try:
-                eng.predict({"data": x})
-                per_client[idx].append(time.time() - t0)
-            except Exception:
-                errors[idx] += 1
-            tick += interval
+        return {"data": np.random.RandomState(1000 + idx).randn(
+            1, feature).astype(np.float32) + idx}
 
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    lat, errors = _serve_offered_load(eng, make_feed, offered_rps,
+                                      clients, duration)
     eng.close(drain=True)
-
-    lat = np.array(sorted(sum(per_client, [])))
     snap = _tm.snapshot()
     rows1, nb1 = _hist_state("serving/batch_rows")
     waste1, nw1 = _hist_state("serving/padding_waste_ratio")
@@ -1250,7 +1274,7 @@ def serve_predictor(offered_rps=400, clients=16, duration=4.0,
     nb, nw = max(1, nb1 - nb0), max(1, nw1 - nw0)
     extra = {
         "offered_rps": offered_rps, "clients": clients,
-        "duration_s": duration, "errors": int(sum(errors)),
+        "duration_s": duration, "errors": errors,
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
         "mean_batch_rows": round((rows1 - rows0) / nb, 3),
@@ -1580,6 +1604,126 @@ def infer_quantized(model="resnet50", batch=32, iters=32,
     return img_s, extra
 
 
+def quantized_serve(offered_rps=240, clients=16, duration=2.5,
+                    max_batch=16, feature=256, hidden=256, classes=64,
+                    batch_wait_ms=2, probe_rows=512):
+    """INT8 quantized serving vs fp32/bf16 through the SAME dynamic
+    micro-batching engine, bucket ladder, and offered load: the probe
+    MLP is checkpointed, quantized via the full production route
+    (``quantize_checkpoint``: calibration -> per-channel int8 artifact
+    -> Predictor over the fused int8 ops), and each variant serves an
+    identical fixed-rate client swarm. Banked per mode: req/s, p50/p99,
+    and the after-warmup compile count — the int8 engine RAISES if it
+    compiled anything under traffic (the zero-compile serving contract
+    must hold for the quantized graph too). Plus a top-1 agreement
+    smoke (int8 argmax vs fp32 argmax over a seeded probe batch) so an
+    accuracy regression fails the bench, not just a latency one.
+
+    CPU caveat (same spirit as decode_serve): off-TPU the int8 dot runs
+    the pure-lax twin and costs about what fp32 does, so the CPU probe
+    validates the PIPELINE (artifact -> engine -> zero compiles ->
+    parity); the 2.9x-class int8 throughput win (BENCH_r05) needs a TPU
+    round where the Pallas epilogue kernel runs on the MXU."""
+    import tempfile
+    import shutil
+    import mxnet_tpu as mx
+    from . import telemetry as _tm
+    from .quantize import quantize_checkpoint
+    from .serve import InferenceEngine, ServeConfig
+    from .serving import Predictor
+    import jax
+
+    dev_type = 2 if jax.devices()[0].platform == "tpu" else 1
+    sym, params = _serve_mlp_symbol(feature, hidden, classes)
+    rng = np.random.RandomState(7)
+    workdir = tempfile.mkdtemp(prefix="quantized_serve_")
+    try:
+        # fp32 + bf16 blobs under the registry's fixed symbol
+        blobs = {}
+        for mode, cast in (("float32", None), ("bfloat16", "bfloat16")):
+            save = {k: (v.astype(cast) if cast else v)
+                    for k, v in params.items()}
+            path = os.path.join(workdir, mode + ".params")
+            mx.nd.save(path, save)
+            with open(path, "rb") as f:
+                blobs[mode] = (sym.tojson(), f.read())
+        # int8: the production route — checkpoint -> calibrate -> artifact
+        prefix = os.path.join(workdir, "probe")
+        from .model import save_checkpoint as _save_ckpt
+        _save_ckpt(prefix, 0,
+                   sym, {k[4:]: v for k, v in params.items()}, {})
+        calib = mx.io.NDArrayIter(
+            rng.randn(128, feature).astype(np.float32),
+            np.zeros((128,), np.float32), batch_size=32)
+        qp = quantize_checkpoint(prefix, calib, calib_mode="percentile")
+        blobs["int8"] = (qp.symbol_json, qp.param_bytes())
+
+        def make_feed(idx):
+            return {"data": np.random.RandomState(1000 + idx).randn(
+                1, feature).astype(np.float32) + idx % 3}
+
+        results = {}
+        buckets = None
+        for mode in ("float32", "bfloat16", "int8"):
+            sjson, blob = blobs[mode]
+            pred = Predictor(sjson, blob, dev_type=dev_type,
+                             input_shapes={"data": (1, feature)})
+            cfg = ServeConfig(max_batch=max_batch,
+                              queue_depth=4 * max_batch,
+                              batch_wait_ms=batch_wait_ms,
+                              default_timeout_ms=10000, workers=1)
+            buckets = list(cfg.buckets)
+            eng = InferenceEngine(pred, cfg).start().warmup()
+            c0 = _tm.snapshot()["backend_compile_total"]
+            lat, errors = _serve_offered_load(eng, make_feed, offered_rps,
+                                              clients, duration)
+            compiles = _tm.snapshot()["backend_compile_total"] - c0
+            eng.close(drain=True)
+            if not len(lat):
+                raise RuntimeError("%s: no request completed" % mode)
+            results[mode] = {
+                "req_per_sec": round(len(lat) / duration, 1),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                "errors": errors,
+                "compiles_after_warmup": int(compiles)}
+        if results["int8"]["compiles_after_warmup"]:
+            raise RuntimeError(
+                "int8 engine compiled %d program(s) under traffic after "
+                "warmup; the quantized bucket ladder leaks compiles"
+                % results["int8"]["compiles_after_warmup"])
+
+        # accuracy-parity smoke: top-1 agreement over a seeded probe
+        X = rng.randn(probe_rows, feature).astype(np.float32)
+        p32 = Predictor(*blobs["float32"], dev_type=dev_type,
+                        input_shapes={"data": (probe_rows, feature)})
+        p8 = Predictor(*blobs["int8"], dev_type=dev_type,
+                       input_shapes={"data": (probe_rows, feature)})
+        ref = p32._exe.forward(is_train=False, data=X)[0].asnumpy()
+        out = p8._exe.forward(is_train=False, data=X)[0].asnumpy()
+        agree = float(np.mean(ref.argmax(1) == out.argmax(1)))
+        if agree < 0.95:
+            raise RuntimeError(
+                "int8 top-1 agreement %.3f < 0.95 vs fp32 on the seeded "
+                "probe; calibration regressed" % agree)
+
+        extra = {
+            "offered_rps": offered_rps, "clients": clients,
+            "duration_s": duration, "buckets": buckets,
+            "modes": results, "top1_agreement_vs_fp32": round(agree, 4),
+            "calib": "percentile",
+            "quantized_layers": sorted(qp.meta),
+            "loop": "fixed offered load, shared _serve_offered_load "
+                    "harness; int8 = checkpoint->artifact->engine route",
+            "cpu_caveat": "off-TPU the int8 dot runs the lax twin at "
+                          "~fp32 cost; the int8 throughput win needs a "
+                          "TPU round (Pallas epilogue kernel on MXU)",
+        }
+        return results["int8"]["req_per_sec"], extra
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # job registry + CLI
 
@@ -1726,6 +1870,14 @@ def _job_infer_int8():
                    "img/s (batch 32, int8 quantized, 1 chip)", x)
 
 
+def _job_quantized_serve():
+    v, x = quantized_serve()
+    return persist("quantized_serve_req_per_sec", v,
+                   "req/s (int8 artifact through the micro-batching "
+                   "engine, 16 clients fixed offered load; fp32/bf16 "
+                   "rows + top-1 agreement in extras)", x)
+
+
 def _make_infer_job(model, dtype, batch=32):
     def job():
         v, x = infer_score(model, batch, dtype)
@@ -1745,6 +1897,7 @@ JOBS = {
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
     "predictor_serve": _job_predictor_serve,
+    "quantized_serve": _job_quantized_serve,
     "decode_serve": _job_decode_serve,
     "data_pipeline": _job_data_pipeline,
     "transformer_lm": _job_transformer_lm,
@@ -1774,6 +1927,7 @@ JOB_PRIORITY = [
     "train_resume",
     "dist_failover",
     "predictor_serve",
+    "quantized_serve",
     "decode_serve",
     "data_pipeline",
     "data_pipeline_native",

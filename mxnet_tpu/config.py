@@ -102,6 +102,22 @@ VARS = {
                                     "crashed worker stays down; with no "
                                     "worker alive /healthz degrades to "
                                     "not-ready."),
+    "MXNET_SERVE_SHADOW_FRACTION": (float, 0.0,
+                                    "Default fraction of live requests "
+                                    "ModelRegistry.enable_shadow mirrors "
+                                    "to the shadow (quantized) engine "
+                                    "for drift measurement "
+                                    "(quantize/shadow_drift). Mirrors "
+                                    "run on a side thread and never "
+                                    "delay or fail primary requests."),
+    "MXNET_QUANT_PERCENTILE": (float, 99.99,
+                               "Percentile of |x| the percentile/"
+                               "entropy calibration observer clips "
+                               "activation ranges at "
+                               "(quantize.calibrate."
+                               "PercentileObserver) — outliers stop "
+                               "stretching every other value's int8 "
+                               "resolution."),
     "MXNET_DECODE_SLOTS": (int, 8,
                            "Concurrent sequences the decode engine "
                            "(serve.DecodeEngine) schedules per step. "

@@ -9,8 +9,11 @@ TPU-native flow (int8 dots ride the MXU via XLA integer dot_general,
 kernels in ops/quantization_ops.py):
 
 1. **calibrate** — run the fp32 graph's internals on calibration
-   batches, recording per-tensor min/max (``calib_mode='naive'``; the
-   reference's entropy mode is accepted and served by naive ranges).
+   batches, recording per-tensor ranges (``calib_mode='naive'`` =
+   exact min/max; ``'entropy'`` routes to the percentile observer in
+   mxnet_tpu/quantize/calibrate.py — outlier-clipped ranges at
+   ``MXNET_QUANT_PERCENTILE``, the practical stand-in for the
+   reference's KL calibration).
 2. **rewrite** — every FullyConnected / Convolution node not excluded
    becomes ``quantize_v2(data) → quantized_op → requantize →
    dequantize`` with calibrated ranges baked into the quantize/
@@ -29,48 +32,19 @@ _QUANTIZABLE = ("FullyConnected", "Convolution")
 
 
 def _collect_ranges(symbol, arg_params, aux_params, calib_data,
-                    data_names, label_names, num_calib_examples=None):
+                    data_names, label_names, num_calib_examples=None,
+                    observer="minmax"):
     """Run internals forward over calibration batches; return
-    {(node_name, out_idx): (min, max)}."""
-    internals = symbol.get_internals()
-    stats = {}
-    seen = 0
-    # bind once per batch shape
-    exe_cache = {}
-    for batch in calib_data:
-        data_list = batch.data if hasattr(batch, "data") else [batch]
-        shapes = {n: tuple(d.shape) for n, d in zip(data_names, data_list)}
-        # seed inference with the known parameter shapes: internals
-        # grouping exposes heads mid-graph that pure deduction can't
-        # always reach backward from
-        for k, v in (arg_params or {}).items():
-            shapes.setdefault(k, tuple(v.shape))
-        key = tuple(sorted(shapes.items()))
-        if key not in exe_cache:
-            exe = internals.simple_bind(grad_req="null", **shapes)
-            for k, v in arg_params.items():
-                if k in exe.arg_dict:
-                    exe.arg_dict[k][:] = v
-            for k, v in (aux_params or {}).items():
-                if k in exe.aux_dict:
-                    exe.aux_dict[k][:] = v
-            exe_cache[key] = exe
-        exe = exe_cache[key]
-        for n, d in zip(data_names, data_list):
-            exe.arg_dict[n][:] = d
-        outs = exe.forward(is_train=False)
-        for (node, oi), val in zip(internals._entries, outs):
-            arr = val.asnumpy()
-            k = (node.name, oi)
-            mn, mx = float(arr.min()), float(arr.max())
-            if k in stats:
-                stats[k] = (min(stats[k][0], mn), max(stats[k][1], mx))
-            else:
-                stats[k] = (mn, mx)
-        seen += data_list[0].shape[0]
-        if num_calib_examples is not None and seen >= num_calib_examples:
-            break
-    return stats
+    {(node_name, out_idx): (min, max)}. One executor is bound per
+    distinct batch shape and reused; ranges merge across batches
+    (implementation: quantize/calibrate.py — ``observer`` picks the
+    statistic, default exact min/max)."""
+    from ..quantize.calibrate import collect_activation_ranges
+    del label_names                      # signature parity; labels unused
+    return collect_activation_ranges(
+        symbol, arg_params, aux_params, calib_data,
+        data_names=list(data_names), observer=observer,
+        num_calib_examples=num_calib_examples)
 
 
 calibrate_symbol = _collect_ranges
@@ -90,16 +64,31 @@ def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
     quantize_model). Returns (qsym, arg_params, aux_params)."""
     from ..symbol import symbol as _S
     from ..ops import registry as _reg
+    from ..quantize.ptq import validate_excluded_names
     if quantized_dtype not in ("int8", "auto"):
         raise MXNetError("quantized_dtype %r not supported"
                          % quantized_dtype)
-    excluded = set(excluded_sym_names)
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError(
+            "calib_mode %r not supported (expected 'none', 'naive', or "
+            "'entropy')" % (calib_mode,))
+    # a typo'd exclusion must fail loudly, not silently quantize the
+    # layer it meant to protect
+    excluded = validate_excluded_names(sym, excluded_sym_names)
 
     stats = {}
-    if calib_mode != "none" and calib_data is not None:
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError(
+                "calib_mode=%r needs calib_data (pass calib_mode='none' "
+                "for uncalibrated dynamic ranges)" % (calib_mode,))
+        # entropy -> the percentile observer (outlier-clipped ranges);
+        # naive -> exact min/max
         stats = _collect_ranges(sym, arg_params, aux_params, calib_data,
                                 list(data_names), list(label_names),
-                                num_calib_examples)
+                                num_calib_examples,
+                                observer="percentile"
+                                if calib_mode == "entropy" else "minmax")
 
     qv2 = "_contrib_quantize_v2"
     new_of = {}        # id(old_node) -> Symbol (all outputs)

@@ -61,13 +61,27 @@ def save(fname, data, format="mxtpu"):
         with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as zf:
             zf.writestr("__meta__", "%s\nkeyed=%d\ncount=%d" %
                         (_MAGIC, int(keyed), len(items)))
+            extended = {}
             for i, (k, v) in enumerate(items):
                 from .sparse import BaseSparseNDArray
                 if isinstance(v, BaseSparseNDArray):
                     v = v.todense()      # zip/NPY layout is dense-only
+                a = v.asnumpy()
+                if a.dtype.kind == "V":
+                    # ml_dtypes (bfloat16, fp8, ...) have no NPY descr —
+                    # a plain np.save round-trips them as opaque void
+                    # bytes and the checkpoint silently stops loading.
+                    # Store raw bytes and record the real dtype + shape
+                    # in a __dtypes__ sidecar member instead.
+                    member = "%05d:%s" % (i, k)
+                    extended[member] = [a.dtype.name, list(a.shape)]
+                    a = _np.frombuffer(a.tobytes(), _np.uint8)
                 buf = io.BytesIO()
-                _np.save(buf, v.asnumpy(), allow_pickle=False)
+                _np.save(buf, a, allow_pickle=False)
                 zf.writestr("%05d:%s" % (i, k), buf.getvalue())
+            if extended:
+                import json
+                zf.writestr("__dtypes__", json.dumps(extended))
 
 
 def load(fname, ctx=None):
@@ -107,17 +121,31 @@ def load(fname, ctx=None):
                     % (fname, meta[0][:32], _MAGIC))
             keyed = bool(int(meta[1].split("=")[1]))
             count = int(meta[2].split("=")[1])
-            names = [n for n in zf.namelist() if n != "__meta__"]
+            names = [n for n in zf.namelist()
+                     if n not in ("__meta__", "__dtypes__")]
             if len(names) != count:
                 raise MXNetError(
                     "checkpoint %r is truncated: holds %d of %d arrays"
                     % (fname, len(names), count))
+            extended = {}
+            if "__dtypes__" in zf.namelist():
+                import json
+                extended = json.loads(zf.read("__dtypes__").decode())
             names.sort()
             out_items = []
             for n in names:
                 idx, key = n.split(":", 1)
                 # zf.read verifies the member's stored CRC-32
                 arr = _np.load(io.BytesIO(zf.read(n)), allow_pickle=False)
+                if n in extended:
+                    # ml_dtypes member stored as raw bytes: reconstruct
+                    # the real dtype (bfloat16 & co) from the sidecar
+                    import ml_dtypes
+                    dtname, shape = extended[n]
+                    arr = _np.frombuffer(
+                        arr.tobytes(),
+                        _np.dtype(getattr(ml_dtypes, dtname))
+                    ).reshape(shape)
                 out_items.append((key, array(arr, ctx=ctx,
                                              dtype=arr.dtype)))
     except MXNetError:

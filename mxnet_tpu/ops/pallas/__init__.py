@@ -9,5 +9,6 @@ Kernels degrade gracefully off-TPU: on CPU test meshes they run in
 pallas interpreter mode, so the same code path is exercised everywhere.
 """
 from .flash_attention import flash_attention
+from .int8_matmul import int8_matmul
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "int8_matmul"]

@@ -1,0 +1,139 @@
+"""INT8 matmul with a fused per-channel rescale epilogue, as a Pallas
+TPU kernel.
+
+The serving-side hot op of the quantized inference path
+(mxnet_tpu/quantize/): ``out[m, n] = (x_q[m, :] . w_q[n, :]) *
+scale[n]`` where ``x_q``/``w_q`` are int8, the dot accumulates in int32
+on the MXU, and the per-output-channel fp32 rescale happens INSIDE the
+kernel epilogue — the int32 accumulator never round-trips through HBM
+and no separate dequantize op exists for XLA to schedule apart from the
+dot (the "Operator Fusion in XLA" framing: the rescale is an epilogue,
+not a graph node).
+
+Grid (m_blocks, n_blocks, k_blocks); the trailing k dimension iterates
+sequentially per (m, n) tile, accumulating into an int32 VMEM scratch
+exactly like flash attention's online-softmax accumulator; the last k
+step multiplies by the (1, block_n) scale tile and writes fp32.
+
+Off-TPU the pure-lax twin (``dot_general`` with
+``preferred_element_type=int32`` + broadcast rescale) is the production
+path — the tier-1 reference the kernel is parity-tested against in
+interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret_default, _out_vma, _pad_to, _sds
+
+__all__ = ["int8_matmul"]
+
+
+def _int8_matmul_xla(x, w, scale):
+    """Pure-lax twin of the kernel (same contract): int8 operands, int32
+    MXU accumulation, per-channel fp32 rescale. XLA fuses the rescale
+    into the dot's epilogue on TPU; on CPU this is the tier-1 path."""
+    acc = lax.dot_general(
+        x.astype(jnp.int8), w.astype(jnp.int8),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                    # (m, n)
+    return acc.astype(jnp.float32) * scale.astype(jnp.float32)[None, :]
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc_scr):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # int8 x int8 -> int32 on the MXU; accumulate across k blocks
+    acc_scr[:] += lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                    # (bm, bn)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        # fused epilogue: per-output-channel rescale, int32 -> fp32
+        o_ref[:] = acc_scr[:].astype(jnp.float32) * s_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                             "block_k", "interpret"))
+def _int8_matmul_pallas(x, w, scale, block_m, block_n, block_k, interpret):
+    m, k = x.shape
+    n = w.shape[0]
+    xf = _pad_to(_pad_to(x, block_m, 0), block_k, 1)
+    wf = _pad_to(_pad_to(w, block_n, 0), block_k, 1)
+    sf = _pad_to(scale.astype(jnp.float32).reshape(1, n), block_n, 1)
+    grid = (xf.shape[0] // block_m, wf.shape[0] // block_n,
+            xf.shape[1] // block_k)
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((block_n, block_k), lambda mi, ni, ki: (ni, ki)),
+            pl.BlockSpec((1, block_n), lambda mi, ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda mi, ni, ki: (mi, ni)),
+        out_shape=_sds((xf.shape[0], wf.shape[0]), jnp.float32,
+                       _out_vma(x, w, scale)),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(xf, wf, sf)
+    return out[:m, :n]
+
+
+def int8_matmul(x, w, scale, block_m=128, block_n=128, block_k=128,
+                interpret=None):
+    """``(x . w^T) * scale[None, :]`` with int8 operands and int32 MXU
+    accumulation.
+
+    Parameters
+    ----------
+    x : (m, k) int8 — quantized activations.
+    w : (n, k) int8 — per-channel-quantized weights (channel = axis 0).
+    scale : (n,) float32 — fused epilogue factor per output channel
+        (``w_scale[n] / act_scale`` for a quantized dense layer).
+    block_m, block_n, block_k : VMEM tile sizes (multiples of the int8
+        tile (32, 128) on TPU; inputs are zero-padded to block
+        multiples, and zero int8 products contribute nothing).
+    interpret : force pallas interpreter mode. Default: the compiled
+        Mosaic kernel on TPU, the pure-lax twin elsewhere (int32
+        accumulation is exact, so twin and kernel agree BITWISE —
+        asserted by tests/test_quantize.py in interpret mode).
+    """
+    x = x.astype(jnp.int8)
+    w = w.astype(jnp.int8)
+    if interpret is None:
+        if _interpret_default(x):
+            return _int8_matmul_xla(x, w, scale)
+        interpret = False
+    m, k = x.shape
+
+    def _ceil(v, mult):
+        return -(-v // mult) * mult
+
+    # tile-legal block shrink for small operands: block_m is an int8
+    # SUBLANE dim (x block) -> multiple of 32; block_n is w's sublane
+    # AND the fp32 out/scale LANE dim -> multiple of 128; block_k is
+    # the int8 lane dim -> multiple of 128. (Inputs are zero-padded to
+    # block multiples, so rounding UP never changes results.)
+    block_m = min(block_m, _ceil(m, 32))
+    block_n = min(block_n, _ceil(w.shape[0], 128))
+    block_k = min(block_k, _ceil(k, 128))
+    return _int8_matmul_pallas(x, w, scale, int(block_m), int(block_n),
+                               int(block_k), bool(interpret))

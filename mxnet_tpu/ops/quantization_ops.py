@@ -18,9 +18,23 @@ from .registry import register, get_op
 _INT8_MIN, _INT8_MAX = -127.0, 127.0
 
 
+def _safe_div(num, denom):
+    """``num / denom`` with a zero denominator mapping to 1.0 — the
+    denominator is substituted BEFORE the division, so the other branch
+    never computes inf/NaN (a plain ``where(d > 0, num / d, 1.0)``
+    still evaluates ``num / 0`` and, multiplied downstream, turns a
+    zero-range tensor into NaN output; see the round-trip tests)."""
+    denom = jnp.asarray(denom, jnp.float32)
+    safe = jnp.where(denom > 0, denom, 1.0)
+    return jnp.where(denom > 0, jnp.asarray(num, jnp.float32) / safe, 1.0)
+
+
 def _range_scale(min_r, max_r):
+    """127 / amax for a (min, max) range; 1.0 for a zero/degenerate
+    range (a constant-zero tensor quantizes to zeros and dequantizes
+    back to zeros, never NaN)."""
     amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
-    return jnp.where(amax > 0, _INT8_MAX / amax, 1.0)
+    return _safe_div(_INT8_MAX, amax)
 
 
 @register("_contrib_quantize", num_outputs=3, differentiable=False,
@@ -118,9 +132,7 @@ def _quantized_fc(*arrays, num_hidden=0, no_bias=False, flatten=True,
         real = real + bias.astype(jnp.float32) / scale_b
     mn = jnp.min(real)
     mx = jnp.max(real)
-    scale = jnp.where((2.0 ** 31 - 1) > 0,
-                      (2.0 ** 31 - 1) / jnp.maximum(jnp.abs(mn),
-                                                    jnp.abs(mx)), 1.0)
+    scale = _safe_div(2.0 ** 31 - 1, jnp.maximum(jnp.abs(mn), jnp.abs(mx)))
     q32 = jnp.round(real * scale).astype(jnp.int32)
     return q32, mn.reshape(()), mx.reshape(())
 
@@ -150,7 +162,7 @@ def _quantized_conv(data, weight, min_data, max_data, min_weight,
     real = _q_range_out(out, min_data, max_data, min_weight, max_weight)
     mn = jnp.min(real)
     mx = jnp.max(real)
-    scale = (2.0 ** 31 - 1) / jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    scale = _safe_div(2.0 ** 31 - 1, jnp.maximum(jnp.abs(mn), jnp.abs(mx)))
     q32 = jnp.round(real * scale).astype(jnp.int32)
     return q32, mn.reshape(()), mx.reshape(())
 
@@ -178,3 +190,85 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
 def _quantized_flatten(data, min_data, max_data):
     return data.reshape((data.shape[0], -1)), min_data.reshape(()), \
         max_data.reshape(())
+
+
+# ---------------------------------------------------------------------------
+# per-channel serving ops (mxnet_tpu/quantize/ PTQ artifacts)
+#
+# Unlike the (out, min, max)-triple reference ops above — which chain
+# quantize_v2 -> quantized_op -> requantize -> dequantize as separate
+# graph nodes with per-TENSOR dynamic ranges — these are the
+# first-class quantized-serving kernels: per-CHANNEL int8 weights with
+# fp32 scales live as graph parameters, the activation scale is a
+# static attr baked from calibration, and the whole
+# quantize -> int8 dot -> rescale -> bias runs as ONE op whose rescale
+# is a dot epilogue (Pallas kernel on TPU, fused by XLA off it), never
+# a separate dequantize node.
+# ---------------------------------------------------------------------------
+
+def _quantize_act(data, act_scale):
+    """fp32 activations -> int8 with a static calibrated scale."""
+    return jnp.clip(jnp.round(data.astype(jnp.float32)
+                              * jnp.float32(act_scale)),
+                    _INT8_MIN, _INT8_MAX).astype(jnp.int8)
+
+
+@register("_contrib_quantized_fc_int8", differentiable=False,
+          attr_defaults={"num_hidden": 0, "no_bias": False, "flatten": True,
+                         "act_scale": 1.0})
+def _quantized_fc_int8(data, weight, scale, bias=None, num_hidden=0,
+                       no_bias=False, flatten=True, act_scale=1.0, **_ig):
+    """Per-channel INT8 fully connected for quantized serving.
+
+    Inputs: ``data`` fp32, ``weight`` int8 ``(num_hidden, k)`` quantized
+    per output channel, ``scale`` fp32 ``(num_hidden,)`` = per-channel
+    weight scales (``w ~= weight * scale[:, None]``), optional ``bias``
+    fp32. ``act_scale`` (static, from calibration) maps activations to
+    int8: ``q = round(data * act_scale)``. Output is fp32:
+    ``(q . weight^T) * (scale / act_scale) + bias`` with the rescale
+    fused into the int8 matmul epilogue (ops/pallas/int8_matmul.py)."""
+    from .pallas.int8_matmul import int8_matmul
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    lead = x.shape[:-1]
+    q = _quantize_act(x.reshape((-1, x.shape[-1])), act_scale)
+    out_scale = scale.astype(jnp.float32) / jnp.float32(act_scale)
+    out = int8_matmul(q, weight.astype(jnp.int8), out_scale)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)
+    return out.reshape(lead + (out.shape[-1],))
+
+
+@register("_contrib_quantized_conv_int8", differentiable=False,
+          attr_defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                         "num_filter": 0, "num_group": 1, "no_bias": False,
+                         "layout": None, "act_scale": 1.0})
+def _quantized_conv_int8(data, weight, scale, bias=None, kernel=(),
+                         stride=(), dilate=(), pad=(), num_filter=0,
+                         num_group=1, no_bias=False, layout=None,
+                         act_scale=1.0, **_ig):
+    """Per-channel INT8 convolution for quantized serving: int8
+    operands, int32 accumulation, per-output-channel rescale fused into
+    the conv's epilogue by XLA (NCHW-family layouts; channel = filter
+    axis 0). Same scale contract as ``_contrib_quantized_fc_int8``."""
+    nd = len(kernel)
+    stride = tuple(stride) or (1,) * nd
+    dilate = tuple(dilate) or (1,) * nd
+    pad = tuple(pad) or (0,) * nd
+    dims = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    q = _quantize_act(data, act_scale)
+    dn = lax.conv_dimension_numbers(q.shape, weight.shape, dims)
+    acc = lax.conv_general_dilated(
+        q.astype(jnp.int32), weight.astype(jnp.int8).astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    chan = (1, -1) + (1,) * nd
+    out = acc.astype(jnp.float32) * (
+        scale.astype(jnp.float32) / jnp.float32(act_scale)).reshape(chan)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(chan)
+    return out

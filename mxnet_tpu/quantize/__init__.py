@@ -1,0 +1,45 @@
+"""Quantized serving subsystem: checkpoint -> calibrated int8 artifact
+-> hot-swappable serving mode.
+
+The supported route from a trained checkpoint to int8 production
+serving (ROADMAP item 5; design grounding: TPU-MLIR per-channel-weight
+/ per-tensor-activation calibration, XLA-fusion epilogue rescale):
+
+* :mod:`~mxnet_tpu.quantize.calibrate` — activation-range observers
+  (:class:`MinMaxObserver`, :class:`PercentileObserver`) run over a
+  shape-cached bound executor;
+* :mod:`~mxnet_tpu.quantize.ptq` — :func:`quantize_checkpoint`:
+  checkpoint -> :class:`QuantizedParams` artifact (per-channel int8
+  weights + fp32 scales + calibrated activation scales, CRC-manifested
+  through the atomic checkpoint path);
+* the int8 compute lives in ``ops/quantization_ops.py``
+  (``_contrib_quantized_fc_int8`` / ``_contrib_quantized_conv_int8``)
+  over the Pallas int8 matmul kernel (``ops/pallas/int8_matmul.py``);
+* serving: ``serve.ModelRegistry.swap(quantized=artifact)`` hot-swaps
+  the int8 variant (zero dropped requests), and
+  ``enable_shadow(artifact, fraction)`` canaries it first — a fraction
+  of live requests mirrors to the quantized engine with per-request
+  output drift recorded as ``quantize/shadow_drift``.
+
+Quick start::
+
+    import mxnet_tpu as mx
+
+    qp = mx.quantize.quantize_checkpoint("ckpt/run7", calib_iter,
+                                         calib_mode="percentile")
+    reg.enable_shadow(qp, fraction=0.1)     # canary under live traffic
+    ...                                     # watch quantize/shadow_drift
+    reg.disable_shadow()
+    reg.swap(quantized=qp)                  # flip to int8, zero drops
+
+Architecture + artifact format: docs/quantization.md.
+"""
+from .calibrate import (MinMaxObserver, PercentileObserver, make_observer,
+                        collect_activation_ranges)
+from .ptq import (QuantizedParams, quantize_checkpoint, quantize_symbol,
+                  validate_excluded_names)
+
+__all__ = ["MinMaxObserver", "PercentileObserver", "make_observer",
+           "collect_activation_ranges", "QuantizedParams",
+           "quantize_checkpoint", "quantize_symbol",
+           "validate_excluded_names"]

@@ -1,0 +1,221 @@
+"""Post-training-quantization calibration: activation-range observers
+over a bound executor.
+
+Reference capability: src/operator/quantization/calibrate.cc + the
+python driver's ``_collect_layer_statistics`` — run calibration batches
+through the fp32 graph's internals and record per-tensor output ranges.
+TPU-native shape: ONE internals executor per distinct batch shape
+(bound once, cached, re-fed per batch — the executor cache is what
+keeps a multi-shape calibration set from recompiling per batch), with
+pluggable observers merging statistics across batches:
+
+* :class:`MinMaxObserver` — running min/max (the reference's ``naive``
+  mode): exact range, outlier-sensitive.
+* :class:`PercentileObserver` — clipped range at a percentile of |x|
+  (the reference's ``entropy`` intent, TPU-MLIR's practical stand-in):
+  a dynamically-rescaled 2048-bin |x| histogram accumulates across
+  batches, and the range is the CDF crossing at
+  ``MXNET_QUANT_PERCENTILE`` — one outlier activation no longer
+  stretches every other value's resolution.
+
+Used by :func:`mxnet_tpu.quantize.ptq.quantize_checkpoint` (per-tensor
+activation scales) and by ``contrib.quantization.quantize_model``
+(whose ``calib_mode='entropy'`` routes here).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+
+__all__ = ["MinMaxObserver", "PercentileObserver", "make_observer",
+           "collect_activation_ranges"]
+
+
+class MinMaxObserver(object):
+    """Running min/max over every observed batch (``naive`` ranges)."""
+
+    __slots__ = ("_mn", "_mx")
+
+    def __init__(self):
+        self._mn = None
+        self._mx = None
+
+    def observe(self, arr):
+        arr = _np.asarray(arr)
+        if arr.size == 0:
+            return
+        mn, mx = float(arr.min()), float(arr.max())
+        self._mn = mn if self._mn is None else min(self._mn, mn)
+        self._mx = mx if self._mx is None else max(self._mx, mx)
+
+    def ranges(self):
+        if self._mn is None:
+            return (0.0, 0.0)
+        return (self._mn, self._mx)
+
+
+class PercentileObserver(object):
+    """Clipped range at a percentile of |x|, merged across batches via
+    a dynamically-rescaled histogram.
+
+    The histogram covers ``[0, bound]`` in ``bins`` equal cells; a
+    batch exceeding ``bound`` grows it by a power-of-two factor and
+    folds the existing counts (bin ``i`` -> ``i // factor``), so
+    accumulation never loses mass and never re-reads old batches.
+    ``ranges()`` returns ``(max(min, -amax_p), min(max, amax_p))``
+    where ``amax_p`` is the |x| CDF crossing at ``percentile`` — signs
+    are preserved (an all-non-negative tensor keeps a 0 lower bound).
+    """
+
+    __slots__ = ("_p", "_bins", "_counts", "_bound", "_mn", "_mx")
+
+    def __init__(self, percentile=None, bins=2048):
+        if percentile is None:
+            from ..config import get as _cfg
+            percentile = _cfg("MXNET_QUANT_PERCENTILE")
+        if not 0.0 < float(percentile) <= 100.0:
+            raise MXNetError("percentile must be in (0, 100], got %r"
+                             % (percentile,))
+        self._p = float(percentile)
+        self._bins = int(bins)
+        self._counts = None
+        self._bound = 0.0
+        self._mn = None
+        self._mx = None
+
+    def observe(self, arr):
+        arr = _np.asarray(arr, dtype=_np.float32)
+        if arr.size == 0:
+            return
+        self._mn = float(arr.min()) if self._mn is None \
+            else min(self._mn, float(arr.min()))
+        self._mx = float(arr.max()) if self._mx is None \
+            else max(self._mx, float(arr.max()))
+        a = _np.abs(arr.ravel())
+        amax = float(a.max())
+        if self._counts is None:
+            self._bound = amax if amax > 0 else 1.0
+            self._counts = _np.histogram(
+                a, bins=self._bins, range=(0.0, self._bound)
+            )[0].astype(_np.int64)
+            return
+        if amax > self._bound:
+            factor = 1
+            while self._bound * factor < amax:
+                factor *= 2
+            if factor >= self._bins:
+                # new bin width >= the whole old range: every old bin
+                # folds into bin 0 (a reshape fold would need
+                # factor <= bins)
+                folded = _np.zeros(1, _np.int64)
+                folded[0] = self._counts.sum()
+            else:
+                folded = self._counts.reshape(self._bins // factor,
+                                              factor).sum(axis=1)
+            self._counts = _np.concatenate(
+                [folded, _np.zeros(self._bins - folded.size, _np.int64)])
+            self._bound *= factor
+        self._counts += _np.histogram(a, bins=self._bins,
+                                      range=(0.0, self._bound))[0]
+
+    def ranges(self):
+        if self._counts is None:
+            return (0.0, 0.0)
+        cdf = _np.cumsum(self._counts)
+        total = int(cdf[-1])
+        if total == 0:
+            return (min(self._mn, 0.0), max(self._mx, 0.0))
+        k = int(_np.searchsorted(cdf, total * self._p / 100.0))
+        amax = (k + 1) * self._bound / self._bins
+        mn = 0.0 if self._mn >= 0 else max(self._mn, -amax)
+        mx = 0.0 if self._mx <= 0 else min(self._mx, amax)
+        return (mn, mx)
+
+
+_OBSERVERS = {"minmax": MinMaxObserver, "naive": MinMaxObserver,
+              "percentile": PercentileObserver,
+              "entropy": PercentileObserver}
+
+
+def make_observer(mode):
+    """Observer factory for a calibration-mode name (``minmax``/
+    ``naive`` -> :class:`MinMaxObserver`; ``percentile``/``entropy`` ->
+    :class:`PercentileObserver`), or pass a callable through."""
+    if callable(mode):
+        return mode
+    try:
+        return _OBSERVERS[mode]
+    except KeyError:
+        raise MXNetError(
+            "unknown calibration mode %r (expected one of %s, or an "
+            "observer factory)" % (mode, sorted(_OBSERVERS))) from None
+
+
+def collect_activation_ranges(symbol, arg_params, aux_params, calib_data,
+                              data_names=("data",), observer="minmax",
+                              num_calib_examples=None):
+    """Run calibration batches through the graph's internals and merge
+    per-tensor output statistics; returns
+    ``{(node_name, out_idx): (min, max)}``.
+
+    ``calib_data`` yields batches (objects with ``.data`` lists, or
+    bare arrays for single-input graphs). One internals executor is
+    bound PER DISTINCT BATCH SHAPE and reused across batches of that
+    shape (``quantize/calib_binds_total`` counts the binds — on a
+    single-shape calibration set it stays at 1 no matter how many
+    batches run); statistics merge across every batch through one
+    observer per tensor. Stops once ``num_calib_examples`` rows were
+    seen (None = the whole iterable).
+    """
+    factory = make_observer(observer)
+    internals = symbol.get_internals()
+    data_names = list(data_names)
+    observers = {}
+    exe_cache = {}
+    seen = 0
+    if hasattr(calib_data, "reset"):
+        calib_data.reset()
+    for batch in calib_data:
+        data_list = batch.data if hasattr(batch, "data") else [batch]
+        shapes = {n: tuple(d.shape) for n, d in zip(data_names, data_list)}
+        # seed inference with the known parameter shapes: internals
+        # grouping exposes heads mid-graph that pure deduction can't
+        # always reach backward from
+        for k, v in (arg_params or {}).items():
+            shapes.setdefault(k, tuple(v.shape))
+        key = tuple(sorted(shapes.items()))
+        exe = exe_cache.get(key)
+        if exe is None:
+            exe = internals.simple_bind(grad_req="null", **shapes)
+            for k, v in (arg_params or {}).items():
+                if k in exe.arg_dict:
+                    exe.arg_dict[k][:] = v
+            for k, v in (aux_params or {}).items():
+                if k in exe.aux_dict:
+                    exe.aux_dict[k][:] = v
+            exe_cache[key] = exe
+            if _tm._enabled:
+                _tm.counter("quantize/calib_binds_total",
+                            "Calibration internals executors bound (one "
+                            "per distinct batch shape)").inc()
+        for n, d in zip(data_names, data_list):
+            exe.arg_dict[n][:] = d
+        outs = exe.forward(is_train=False)
+        for (node, oi), val in zip(internals._entries, outs):
+            k = (node.name, oi)
+            obs = observers.get(k)
+            if obs is None:
+                obs = observers[k] = factory()
+            obs.observe(val.asnumpy())
+        if _tm._enabled:
+            _tm.counter("quantize/calib_batches_total",
+                        "Calibration batches run through the bound "
+                        "internals executors").inc()
+        seen += int(data_list[0].shape[0])
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    if not observers:
+        raise MXNetError("calibration saw no batches; calib_data is empty")
+    return {k: obs.ranges() for k, obs in observers.items()}

@@ -1,0 +1,341 @@
+"""Post-training quantization: trained checkpoint -> calibrated int8
+serving artifact.
+
+The supported route from training output to quantized production
+serving (ROADMAP item 5):
+
+1. :func:`quantize_checkpoint` loads the newest VALID checkpoint under
+   a prefix (``checkpoint.load_latest_valid`` semantics: manifest CRCs
+   verified, torn checkpoints skipped), runs calibration batches
+   through the fp32 graph (quantize/calibrate.py observers), and
+   rewrites every FullyConnected / Convolution into the per-channel
+   int8 serving ops (``_contrib_quantized_fc_int8`` /
+   ``_contrib_quantized_conv_int8``, ops/quantization_ops.py — int8
+   MXU dots with the rescale fused into the epilogue via the Pallas
+   kernel).
+2. The result is a :class:`QuantizedParams` ARTIFACT on disk — symbol
+   json + params (int8 weights, fp32 per-channel scales, untouched
+   fp32 bias/aux) + a CRC'd manifest carrying the calibration
+   metadata — written through ``checkpoint.atomic_writer`` so a crash
+   mid-write never tears it, and loaded back through the same
+   checksum-verified fallback walk as training checkpoints.
+3. ``serve.ModelRegistry.swap(quantized=artifact)`` hot-swaps it under
+   live traffic (drain semantics unchanged), optionally after a
+   shadow A/B canary (``enable_shadow``). See docs/quantization.md.
+
+Per-channel weight / per-tensor activation granularity follows
+TPU-MLIR's calibration design: weight channels get exact fp32 scales
+(free at serving time — they fold into the dot epilogue), activations
+share one calibrated scale per tensor (a per-element scale would break
+the single-dot structure the MXU wants).
+"""
+from __future__ import annotations
+
+import json as _json
+import os
+
+import numpy as _np
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+from .calibrate import collect_activation_ranges
+
+__all__ = ["quantize_checkpoint", "quantize_symbol", "QuantizedParams",
+           "validate_excluded_names"]
+
+_QUANT_OPS = {"FullyConnected": "_contrib_quantized_fc_int8",
+              "Convolution": "_contrib_quantized_conv_int8"}
+_INT8_MAX = 127.0
+
+
+def validate_excluded_names(symbol, excluded_sym_names):
+    """``excluded_sym_names`` entries must name actual op nodes of the
+    graph — a typo'd exclusion silently quantizing the layer it meant
+    to protect is exactly the bug this guards. Raises
+    :class:`MXNetError` naming every stranger; returns the set."""
+    from ..symbol.symbol import _topo
+    excluded = set(excluded_sym_names or ())
+    node_names = {n.name for n in _topo(symbol._entries) if not n.is_var}
+    strangers = sorted(excluded - node_names)
+    if strangers:
+        raise MXNetError(
+            "excluded_sym_names %s name no op node in the graph "
+            "(graph has: %s)" % (strangers, sorted(node_names)))
+    return excluded
+
+
+def _per_channel_quantize(w):
+    """fp32 weight -> (int8 weight, fp32 per-channel scales) with
+    channel = axis 0 (FC: num_hidden; Conv: num_filter). Zero-range
+    channels get scale 1.0 and quantize to zeros (no NaN/inf)."""
+    w = _np.asarray(w, dtype=_np.float32)
+    amax = _np.max(_np.abs(w.reshape(w.shape[0], -1)), axis=1)
+    scale = _np.where(amax > 0, amax / _INT8_MAX, 1.0).astype(_np.float32)
+    q = _np.clip(_np.round(w / scale.reshape((-1,) + (1,) * (w.ndim - 1))),
+                 -_INT8_MAX, _INT8_MAX).astype(_np.int8)
+    return q, scale
+
+
+def _act_scale(ranges):
+    """Calibrated (min, max) -> static activation scale 127/amax
+    (1.0 for a degenerate range: a constant-zero activation tensor
+    quantizes to zeros, never NaN)."""
+    amax = max(abs(float(ranges[0])), abs(float(ranges[1])))
+    return _INT8_MAX / amax if amax > 0 else 1.0
+
+
+def quantize_symbol(symbol, arg_params, act_ranges, excluded_sym_names=(),
+                    logger=None):
+    """Rewrite FullyConnected / Convolution nodes into the per-channel
+    int8 serving ops; returns ``(qsym, qarg_params, meta)``.
+
+    A node quantizes when it is not excluded, its weight is a graph
+    parameter present in ``arg_params``, and ``act_ranges`` carries a
+    calibrated range for its data input (nodes failing any of these
+    stay fp32 — logged, never silently mis-scaled). ``qarg_params``
+    drops each quantized node's fp32 weight and adds
+    ``<node>_weight_q`` (int8) + ``<node>_w_scale`` (fp32 per-channel);
+    bias and every other parameter pass through untouched.
+    """
+    import logging
+    from ..ndarray.ndarray import array as nd_array
+    from ..ops import registry as _reg
+    from ..symbol import symbol as _S
+    log = logger or logging
+    excluded = validate_excluded_names(symbol, excluded_sym_names)
+    arg_params = dict(arg_params or {})
+    qparams = dict(arg_params)
+    meta = {}
+
+    new_of = {}        # id(old_node) -> Symbol (all outputs)
+
+    def _sub(node, oi):
+        return new_of[id(node)][oi]
+
+    for node in _S._topo(symbol._entries):
+        if node.is_var:
+            if node.name in arg_params:
+                # bake the known param shape into the rebuilt variable
+                # so shape inference works on the quantized graph
+                attrs = dict(node.attrs or {})
+                attrs["__shape__"] = tuple(arg_params[node.name].shape)
+                nv = _S._Node(None, node.name, attrs, is_aux=node.is_aux)
+                new_of[id(node)] = _S.Symbol([(nv, 0)])
+            else:
+                new_of[id(node)] = _S.Symbol([(node, 0)])
+            continue
+        inputs_kw = {}
+        for in_name, (src, oi) in zip(node.in_names or [], node.inputs):
+            inputs_kw[in_name] = _sub(src, oi)
+        attrs = dict(node.attrs or {})
+        quantize = node.op in _QUANT_OPS and node.name not in excluded
+        wsrc = None
+        if quantize:
+            slot = (node.in_names or [])
+            if "weight" not in slot or "data" not in slot:
+                quantize = False
+            else:
+                wsrc = node.inputs[slot.index("weight")][0]
+                if not wsrc.is_var or wsrc.name not in arg_params:
+                    quantize = False     # computed weight: stays fp32
+        if quantize:
+            dsrc, doi = node.inputs[(node.in_names or []).index("data")]
+            rng = act_ranges.get((dsrc.name, doi))
+            if rng is None:
+                log.warning("no calibrated range for %r input of %r; "
+                            "layer stays fp32", dsrc.name, node.name)
+                quantize = False
+            elif not (_np.isfinite(rng[0]) and _np.isfinite(rng[1])):
+                log.warning("non-finite calibrated range %s for %r; "
+                            "layer stays fp32", rng, node.name)
+                quantize = False
+        if not quantize:
+            out = _S._apply_op(_reg.get_op(node.op), [],
+                               {**attrs, **inputs_kw}, node.name)
+            new_of[id(node)] = out
+            continue
+
+        wq, wscale = _per_channel_quantize(
+            arg_params[wsrc.name].asnumpy()
+            if hasattr(arg_params[wsrc.name], "asnumpy")
+            else arg_params[wsrc.name])
+        act = _act_scale(rng)
+        qparams.pop(wsrc.name, None)
+        qparams[node.name + "_weight_q"] = nd_array(wq, dtype=_np.int8)
+        qparams[node.name + "_w_scale"] = nd_array(wscale)
+        wq_sym = _S.Variable(node.name + "_weight_q", shape=wq.shape,
+                             dtype="int8")
+        ws_sym = _S.Variable(node.name + "_w_scale", shape=wscale.shape)
+
+        if node.op == "FullyConnected":
+            keep = ("num_hidden", "no_bias", "flatten")
+        else:
+            keep = ("kernel", "stride", "dilate", "pad", "num_filter",
+                    "num_group", "no_bias", "layout")
+        qattrs = {k: attrs[k] for k in keep if k in attrs}
+        qattrs["act_scale"] = act
+        args = [inputs_kw["data"], wq_sym, ws_sym]
+        bias_sym = inputs_kw.get("bias")
+        if bias_sym is not None and not attrs.get("no_bias", False):
+            args.append(bias_sym)
+        else:
+            qattrs["no_bias"] = True
+        qnode = _S._apply_op(_reg.get_op(_QUANT_OPS[node.op]), args,
+                             qattrs, node.name + "_int8")
+        meta[node.name] = {"op": node.op, "act_scale": act,
+                           "channels": int(wq.shape[0]),
+                           "act_range": [float(rng[0]), float(rng[1])]}
+        new_of[id(node)] = qnode
+
+    entries = []
+    for (node, oi) in symbol._entries:
+        entries.extend(new_of[id(node)][oi]._entries)
+    return _S.Symbol(entries), qparams, meta
+
+
+class QuantizedParams(object):
+    """A calibrated int8 serving artifact: quantized symbol + params
+    (per-channel int8 weights, fp32 scales, fp32 bias/aux) + manifest
+    metadata. Produced by :func:`quantize_checkpoint`, consumed by
+    ``serve.ModelRegistry.swap(quantized=...)`` / ``enable_shadow``.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, meta, prefix=None):
+        self.symbol = symbol
+        self.arg_params = dict(arg_params)
+        self.aux_params = dict(aux_params or {})
+        self.meta = dict(meta or {})
+        self.prefix = prefix
+
+    @property
+    def symbol_json(self):
+        return self.symbol.tojson()
+
+    def _save_dict(self):
+        """Checkpoint-format key mapping (``arg:``/``aux:`` prefixed) —
+        the ONE place the artifact's on-disk and in-memory blob key
+        scheme is defined."""
+        save_dict = {("arg:%s" % k): v for k, v in self.arg_params.items()}
+        save_dict.update({("aux:%s" % k): v
+                          for k, v in self.aux_params.items()})
+        return save_dict
+
+    def param_bytes(self):
+        """The params blob in the ``mx.nd.save`` checkpoint format —
+        exactly what ``serving.Predictor`` / ``serve.ModelRegistry``
+        consume."""
+        import tempfile
+        from ..ndarray import utils as _utils
+        fd, tmp = tempfile.mkstemp(suffix=".params")
+        os.close(fd)
+        try:
+            _utils.save(tmp, self._save_dict())
+            with open(tmp, "rb") as f:
+                return f.read()
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def save(self, prefix):
+        """Write the artifact under ``prefix`` (symbol json + params +
+        CRC'd manifest, every file through the atomic write path) and
+        return ``prefix``. Restorable by :meth:`load` with
+        ``load_latest_valid``-grade validation."""
+        from ..checkpoint import write_manifest
+        from ..ndarray import utils as _utils
+        sym_file = "%s-symbol.json" % prefix
+        self.symbol.save(sym_file)               # atomic_writer inside
+        param_file = "%s-%04d.params" % (prefix, 0)
+        _utils.save(param_file, self._save_dict())  # atomic_writer inside
+        write_manifest(prefix, 0,
+                       {"params": param_file, "symbol": sym_file},
+                       extra={"quantized": {"format": 1,
+                                            "layers": self.meta}})
+        self.prefix = prefix
+        if _tm._enabled:
+            _tm.counter("quantize/checkpoints_total",
+                        "Quantized int8 artifacts written").inc()
+        return prefix
+
+    @classmethod
+    def load(cls, prefix):
+        """Load the newest VALID artifact under ``prefix``: manifest
+        CRCs verified, torn artifacts skipped (the
+        ``checkpoint.load_latest_valid`` walk). Raises
+        :class:`MXNetError` when nothing loads or the checkpoint is
+        not a quantized artifact."""
+        from ..checkpoint import load_latest_valid, manifest_path
+        state = load_latest_valid(prefix)
+        if state is None:
+            raise MXNetError("no quantized artifact under %r" % prefix)
+        if state.symbol is None:
+            raise MXNetError("artifact %r has no symbol file" % prefix)
+        try:
+            with open(manifest_path(prefix, state.epoch)) as f:
+                man = _json.load(f)
+        except (OSError, ValueError) as e:
+            raise MXNetError("artifact manifest unreadable: %s" % e) from e
+        qmeta = man.get("quantized")
+        if qmeta is None:
+            raise MXNetError(
+                "%r is a plain checkpoint, not a quantized artifact "
+                "(run quantize_checkpoint to produce one)" % prefix)
+        return cls(state.symbol, state.arg_params, state.aux_params,
+                   qmeta.get("layers", {}), prefix=prefix)
+
+
+def quantize_checkpoint(prefix, calib_data, epoch=None, out_prefix=None,
+                        calib_mode="minmax", excluded_sym_names=(),
+                        data_names=("data",), num_calib_examples=None,
+                        symbol=None, logger=None):
+    """Trained checkpoint -> calibrated int8 artifact on disk.
+
+    Parameters
+    ----------
+    prefix : checkpoint prefix (``model.save_checkpoint`` layout). With
+        ``epoch=None`` the newest checkpoint whose manifest checksums
+        verify is used (torn ones skipped); an explicit ``epoch`` pins
+        one.
+    calib_data : batch iterable fed through the fp32 graph to calibrate
+        activation ranges (quantize/calibrate.py).
+    calib_mode : ``"minmax"``/``"naive"`` (exact ranges) or
+        ``"percentile"``/``"entropy"`` (outlier-clipped at
+        ``MXNET_QUANT_PERCENTILE``), or an observer factory.
+    excluded_sym_names : op-node names kept fp32; every entry must name
+        a real node (:func:`validate_excluded_names`).
+    out_prefix : artifact location; default ``<prefix>-int8``.
+    symbol : override the checkpointed symbol (symbol-less prefixes).
+
+    Returns the saved :class:`QuantizedParams` (``.prefix`` names the
+    artifact on disk; reload anytime with ``QuantizedParams.load``).
+    """
+    from ..checkpoint import load_latest_valid
+    from ..model import load_checkpoint as _load_ckpt
+    if epoch is not None:
+        sym, arg_params, aux_params = _load_ckpt(prefix, epoch)
+    else:
+        state = load_latest_valid(prefix)
+        if state is None:
+            raise MXNetError("no checkpoint under %r to quantize" % prefix)
+        sym, arg_params, aux_params = (state.symbol, state.arg_params,
+                                       state.aux_params)
+    if symbol is not None:
+        sym = symbol
+    if sym is None:
+        raise MXNetError(
+            "checkpoint %r has no symbol file; pass symbol=" % prefix)
+    stats = collect_activation_ranges(
+        sym, arg_params, aux_params, calib_data, data_names=data_names,
+        observer=calib_mode, num_calib_examples=num_calib_examples)
+    qsym, qarg, meta = quantize_symbol(sym, arg_params, stats,
+                                       excluded_sym_names, logger=logger)
+    if not meta:
+        raise MXNetError(
+            "nothing quantized under %r: no FullyConnected/Convolution "
+            "node has a parameter weight and a calibrated input range"
+            % prefix)
+    qp = QuantizedParams(qsym, qarg, aux_params, meta)
+    qp.save(out_prefix or (prefix + "-int8"))
+    return qp

@@ -15,7 +15,10 @@ users") needs on top of the one-request ``serving.Predictor``:
   expiry;
 * :mod:`~mxnet_tpu.serve.registry` — :class:`ModelRegistry`: atomic
   weight hot-swap with zero dropped requests (attached decode
-  sessions drain first);
+  sessions drain first); ``swap(quantized=artifact)`` flips to a
+  calibrated int8 variant (mxnet_tpu/quantize/) and
+  ``enable_shadow(artifact, fraction)`` canaries it under live
+  traffic with drift histograms (docs/quantization.md);
 * :mod:`~mxnet_tpu.serve.decode` — :class:`DecodeEngine`: continuous
   batching for autoregressive decode — iteration-level scheduling,
   bucketed prefill, streaming tokens (docs/decode_serving.md);

@@ -768,6 +768,14 @@ def snapshot():
            "kv_snapshots": _val("kvstore/snapshots_total"),
            "kv_worker_rejoins": _val("kvstore/worker_rejoins_total"),
            "serve_worker_restarts": _val("serving/worker_restarts_total"),
+           # quantized-serving accounting: artifacts produced, int8
+           # hot-swaps, and the shadow A/B canary volume banked with
+           # quantized_serve bench records
+           "quantize_checkpoints": _val("quantize/checkpoints_total"),
+           "quantize_swaps": _val("quantize/swaps_total"),
+           "quantize_shadow_requests":
+               _val("quantize/shadow_requests_total"),
+           "quantize_shadow_errors": _val("quantize/shadow_errors_total"),
            "faults_injected": _val("fault/injected_total")}
     fam = REGISTRY._families.get("serving/batch_rows")
     if fam is not None:

@@ -89,6 +89,88 @@ def test_quantize_model_excluded_layer():
     assert "fc2_quantized" in names                   # quantized
 
 
+def test_quantize_model_unknown_excluded_raises():
+    """Satellite: a typo'd excluded_sym_names entry must raise an
+    MXNetError NAMING the stranger instead of silently quantizing the
+    layer it meant to protect."""
+    sym = _mlp_sym()
+    with pytest.raises(MXNetError, match="fc_zap"):
+        mx.contrib.quantize_model(
+            sym, {"fc1_weight": mx.nd.zeros((16, 10))}, {},
+            excluded_sym_names=("fc_zap",), calib_mode="none")
+
+
+def test_quantize_model_calib_mode_validation():
+    """Satellite: an unknown calib_mode raises instead of silently
+    serving naive ranges; naive/entropy without calib_data raise."""
+    sym = _mlp_sym()
+    with pytest.raises(MXNetError, match="calib_mode"):
+        mx.contrib.quantize_model(sym, {}, {}, calib_mode="zapcalib")
+    with pytest.raises(MXNetError, match="calib_data"):
+        mx.contrib.quantize_model(sym, {}, {}, calib_mode="entropy")
+    with pytest.raises(MXNetError, match="calib_data"):
+        mx.contrib.quantize_model(sym, {}, {}, calib_mode="naive")
+
+
+def test_quantize_model_entropy_routes_to_percentile(monkeypatch):
+    """Satellite: calib_mode='entropy' now runs the percentile
+    observer (quantize/calibrate.py) — an outlier activation no longer
+    defines the whole calibrated range the way naive min/max does."""
+    from mxnet_tpu.symbol.symbol import _topo
+    monkeypatch.setenv("MXNET_QUANT_PERCENTILE", "90")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 10).astype(np.float32)
+    X[0, 0] = 1000.0                     # one absurd outlier
+    sym = _mlp_sym()
+    exe = _fit_fp32(sym, X, None)
+    arg_params = {n: a.copy() for n, a in exe.arg_dict.items()
+                  if n not in ("data", "softmax_label")}
+    calib = _Batches([mx.nd.array(X)])
+
+    def data_quantize_range(calib_mode):
+        qsym, _, _ = mx.contrib.quantize_model(
+            sym, arg_params, {}, data_names=("data",),
+            calib_mode=calib_mode, calib_data=_Batches([mx.nd.array(X)]))
+        # the quantize_v2 node fed by the raw data variable carries the
+        # calibrated range as attrs
+        for n in _topo(qsym._entries):
+            if n.op == "_contrib_quantize_v2" \
+                    and n.inputs[0][0].name == "data":
+                return float(n.attrs["max_calib_range"])
+        raise AssertionError("no quantize_v2 node over data")
+
+    naive = data_quantize_range("naive")
+    entropy = data_quantize_range("entropy")
+    assert naive == pytest.approx(1000.0)      # min/max eats the outlier
+    assert entropy < 100.0, entropy            # percentile clips it
+
+
+def test_collect_ranges_executor_cache_and_merge():
+    """Satellite: mixed batch shapes through _collect_ranges bind ONE
+    executor per distinct shape (telemetry-counted) and ranges merge
+    across every batch, whichever executor ran it."""
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.contrib.quantization import _collect_ranges
+    sym = _mlp_sym()
+    rng = np.random.RandomState(3)
+    arg_params = {"fc1_weight": mx.nd.array(rng.randn(16, 10) * 0.1),
+                  "fc1_bias": mx.nd.zeros((16,)),
+                  "fc2_weight": mx.nd.array(rng.randn(4, 16) * 0.1),
+                  "fc2_bias": mx.nd.zeros((4,))}
+    b1 = np.full((8, 10), 2.0, np.float32)       # shape A
+    b2 = np.full((4, 10), -7.0, np.float32)      # shape B
+    b3 = np.full((8, 10), 5.0, np.float32)       # shape A again: reuse
+    binds0 = tm.counter("quantize/calib_binds_total").value
+    stats = _collect_ranges(sym, arg_params, {},
+                            _Batches([mx.nd.array(b) for b in
+                                      (b1, b2, b3)]),
+                            ["data"], ["softmax_label"])
+    # 3 batches, 2 distinct shapes -> exactly 2 executor binds
+    assert tm.counter("quantize/calib_binds_total").value - binds0 == 2
+    # ranges merged across ALL batches (including the cache-hit one)
+    assert stats[("data", 0)] == (-7.0, 5.0)
+
+
 # ---------------------------------------------------------------------------
 # text
 # ---------------------------------------------------------------------------

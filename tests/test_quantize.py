@@ -1,0 +1,483 @@
+"""Quantized serving subsystem (mxnet_tpu/quantize/ + the per-channel
+int8 ops + serve integration).
+
+Acceptance (ISSUE 11): train a small model -> quantize_checkpoint ->
+ModelRegistry.swap() to the int8 variant under 16 concurrent live
+clients with ZERO dropped requests, zero XLA compiles after warmup
+(telemetry-asserted), quantized outputs bitwise-deterministic across
+repeat requests, and shadow-mode drift histograms populated; the Pallas
+int8 matmul kernel parity-tested against its lax twin.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointCorruptError
+from mxnet_tpu.quantize import (MinMaxObserver, PercentileObserver,
+                                QuantizedParams, quantize_checkpoint)
+from mxnet_tpu.serve import ModelRegistry, ServeConfig
+
+FEATURE = 8
+HIDDEN = 16
+CLASSES = 4
+
+
+def _mlp_serve_sym():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=HIDDEN, name="fc1"),
+        act_type="relu")
+    return mx.sym.softmax(
+        mx.sym.FullyConnected(h, num_hidden=CLASSES, name="fc2"),
+        name="prob")
+
+
+def _train_and_checkpoint(tmp_path, steps=6):
+    """Actually TRAIN the probe model (Module.fit on a separable
+    synthetic task), then checkpoint the trained weights under the
+    SERVING symbol — the artifact route starts from a real training
+    output, not hand-rolled params."""
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.model import save_checkpoint
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, FEATURE).astype(np.float32)
+    w_true = rng.randn(FEATURE, CLASSES).astype(np.float32)
+    Y = np.argmax(X @ w_true, axis=1).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=HIDDEN, name="fc1"),
+        act_type="relu")
+    fc2 = mx.sym.FullyConnected(h, num_hidden=CLASSES, name="fc2")
+    train_sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(train_sym,
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.fit(it, num_epoch=steps, optimizer_params={"learning_rate": 0.1})
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "trained")
+    save_checkpoint(prefix, 0, _mlp_serve_sym(),
+                    {k: v for k, v in arg.items()}, dict(aux))
+    return prefix, X
+
+
+def _calib_iter(X, batch_size=16):
+    from mxnet_tpu.io import NDArrayIter
+    return NDArrayIter(X, np.zeros((X.shape[0],), np.float32),
+                       batch_size=batch_size)
+
+
+def _blob(params_path):
+    with open(params_path, "rb") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# Pallas int8 matmul kernel
+# ---------------------------------------------------------------------------
+
+def test_int8_matmul_kernel_parity_bitwise():
+    """The Pallas kernel (interpret mode off-TPU) agrees BITWISE with
+    the pure-lax twin: the int32 accumulation is exact and the fp32
+    epilogue multiplies the same operands."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.int8_matmul import (_int8_matmul_xla,
+                                                  int8_matmul)
+    rng = np.random.RandomState(3)
+    for m, k, n in ((1, 8, 4), (5, 37, 11), (16, 256, 64)):
+        x = rng.randint(-127, 128, (m, k)).astype(np.int8)
+        w = rng.randint(-127, 128, (n, k)).astype(np.int8)
+        s = (rng.rand(n).astype(np.float32) * 0.1 + 1e-3)
+        ref = np.asarray(_int8_matmul_xla(jnp.asarray(x), jnp.asarray(w),
+                                          jnp.asarray(s)))
+        out = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(w),
+                                     jnp.asarray(s), interpret=True))
+        assert out.dtype == np.float32
+        assert out.tobytes() == ref.tobytes(), (m, k, n)
+
+
+def test_int8_matmul_kernel_zero_scale_channels():
+    """A zero scale channel (a zero-range weight channel) produces
+    exact zeros, never NaN."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.int8_matmul import int8_matmul
+    x = np.ones((3, 16), np.int8)
+    w = np.ones((4, 16), np.int8)
+    s = np.array([0.0, 1.0, 0.5, 0.0], np.float32)
+    out = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(w),
+                                 jnp.asarray(s), interpret=True))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[:, 0], 0.0)
+    np.testing.assert_array_equal(out[:, 1], 16.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: zero-range / constant / all-negative round trips through
+# the reference-style (out, min, max) quantization ops
+# ---------------------------------------------------------------------------
+
+def _op(name):
+    from mxnet_tpu.ops.registry import get_op
+    return get_op(name).fn
+
+
+def test_quantize_roundtrip_zero_range():
+    """An all-zero (zero-range) tensor must quantize to zeros and
+    dequantize back to zeros — the unguarded 127/amax used to put inf
+    into the graph (and NaN downstream)."""
+    import jax.numpy as jnp
+    x = jnp.zeros((4, 4), jnp.float32)
+    q, mn, mx_ = _op("_contrib_quantize_v2")(x)
+    out = np.asarray(_op("_contrib_dequantize")(q, mn, mx_))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out, 0.0)
+    # constant tensor (nonzero, zero width): exact round trip
+    c = jnp.full((3, 3), 5.0, jnp.float32)
+    q, mn, mx_ = _op("_contrib_quantize_v2")(c)
+    assert int(np.asarray(q).max()) == 127
+    np.testing.assert_allclose(
+        np.asarray(_op("_contrib_dequantize")(q, mn, mx_)), 5.0,
+        rtol=1e-6)
+
+
+def test_quantize_roundtrip_all_negative():
+    import jax.numpy as jnp
+    x = jnp.asarray([[-5.0, -1.0], [-3.0, -2.0]], jnp.float32)
+    q, mn, mx_ = _op("_contrib_quantize_v2")(x)
+    out = np.asarray(_op("_contrib_dequantize")(q, mn, mx_))
+    assert np.all(np.isfinite(out))
+    # amax = 5 -> one int8 step = 5/127
+    np.testing.assert_allclose(out, np.asarray(x), atol=5.0 / 127 / 2)
+    assert int(np.asarray(q).min()) == -127
+
+
+def test_quantize_symmetric_saturation():
+    """Values at +/-amax land exactly on +/-127 (symmetric, no zero
+    offset) and round-trip to +/-amax."""
+    import jax.numpy as jnp
+    x = jnp.asarray([3.0, -3.0, 0.0, 1.5], jnp.float32)
+    q, mn, mx_ = _op("_contrib_quantize_v2")(x)
+    qn = np.asarray(q)
+    assert qn[0] == 127 and qn[1] == -127 and qn[2] == 0
+    out = np.asarray(_op("_contrib_dequantize")(q, mn, mx_))
+    np.testing.assert_allclose(out[:2], [3.0, -3.0], rtol=1e-6)
+
+
+def test_quantized_fc_zero_range_no_nan():
+    """_contrib_quantized_fully_connected with an all-zero input (so
+    the int32 output range is zero-width) must emit finite zeros — the
+    output scale used to be (2^31-1)/0."""
+    import jax.numpy as jnp
+    data = jnp.zeros((2, 4), jnp.int8)
+    weight = jnp.ones((3, 4), jnp.int8)
+    zero = jnp.zeros((), jnp.float32)
+    one = jnp.ones((), jnp.float32)
+    q32, mn, mx_ = _op("_contrib_quantized_fully_connected")(
+        data, weight, -zero, zero, -one, one, no_bias=True, num_hidden=3)
+    assert np.all(np.isfinite(np.asarray(q32)))
+    assert np.all(np.isfinite(np.asarray(mn)))
+    np.testing.assert_array_equal(np.asarray(q32), 0)
+
+
+def test_quantized_conv_zero_range_no_nan():
+    import jax.numpy as jnp
+    data = jnp.zeros((1, 2, 4, 4), jnp.int8)
+    weight = jnp.ones((3, 2, 3, 3), jnp.int8)
+    zero = jnp.zeros((), jnp.float32)
+    one = jnp.ones((), jnp.float32)
+    q32, mn, mx_ = _op("_contrib_quantized_conv")(
+        data, weight, -zero, zero, -one, one, kernel=(3, 3),
+        num_filter=3)
+    assert np.all(np.isfinite(np.asarray(q32)))
+    np.testing.assert_array_equal(np.asarray(q32), 0)
+
+
+# ---------------------------------------------------------------------------
+# per-channel serving ops
+# ---------------------------------------------------------------------------
+
+def test_quantized_fc_int8_tracks_fp32():
+    import jax.numpy as jnp
+    from mxnet_tpu.quantize.ptq import _per_channel_quantize
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 16).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32) * 0.5
+    b = rng.randn(8).astype(np.float32)
+    wq, ws = _per_channel_quantize(w)
+    amax = np.abs(x).max()
+    out = np.asarray(_op("_contrib_quantized_fc_int8")(
+        jnp.asarray(x), jnp.asarray(wq), jnp.asarray(ws), jnp.asarray(b),
+        num_hidden=8, act_scale=float(127.0 / amax)))
+    ref = x @ w.T + b
+    assert np.max(np.abs(out - ref)) < np.abs(ref).max() * 0.02
+    # per-channel: a zero weight channel stays exactly zero (scale 1.0)
+    w[3] = 0.0
+    wq, ws = _per_channel_quantize(w)
+    assert ws[3] == 1.0 and not wq[3].any()
+
+
+def test_quantized_conv_int8_tracks_fp32():
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.quantize.ptq import _per_channel_quantize
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+    wq, ws = _per_channel_quantize(w)
+    out = np.asarray(_op("_contrib_quantized_conv_int8")(
+        jnp.asarray(x), jnp.asarray(wq), jnp.asarray(ws), None,
+        kernel=(3, 3), num_filter=4, no_bias=True,
+        act_scale=float(127.0 / np.abs(x).max())))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn))
+    assert np.max(np.abs(out - ref)) < np.abs(ref).max() * 0.03
+
+
+# ---------------------------------------------------------------------------
+# calibration observers
+# ---------------------------------------------------------------------------
+
+def test_minmax_observer_merges_batches():
+    obs = MinMaxObserver()
+    obs.observe(np.array([1.0, 2.0]))
+    obs.observe(np.array([-4.0, 0.5]))
+    assert obs.ranges() == (-4.0, 2.0)
+
+
+def test_percentile_observer_clips_outliers():
+    obs = PercentileObserver(percentile=99.0)
+    rng = np.random.RandomState(0)
+    obs.observe(rng.randn(10000).astype(np.float32))
+    obs.observe(np.array([1000.0], np.float32))   # one outlier
+    mn, mx = obs.ranges()
+    assert mx < 100.0, "outlier was not clipped (max=%s)" % mx
+    assert mn < 0 < mx
+    # exact-percentile sanity vs numpy on the merged stream
+    with pytest.raises(MXNetError):
+        PercentileObserver(percentile=0.0)
+    with pytest.raises(MXNetError):
+        PercentileObserver(percentile=101.0)
+
+
+def test_percentile_observer_all_nonnegative_keeps_zero_floor():
+    obs = PercentileObserver(percentile=99.9)
+    obs.observe(np.abs(np.random.RandomState(1).randn(1000)))
+    mn, mx = obs.ranges()
+    assert mn == 0.0 and mx > 0
+
+
+# ---------------------------------------------------------------------------
+# artifact: quantize_checkpoint -> QuantizedParams round trip
+# ---------------------------------------------------------------------------
+
+def test_quantize_checkpoint_artifact_roundtrip(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=2)
+    qp = quantize_checkpoint(prefix, _calib_iter(X))
+    assert qp.prefix == prefix + "-int8"
+    assert set(qp.meta) == {"fc1", "fc2"}
+    # artifact files exist with a CRC'd manifest
+    assert os.path.exists(qp.prefix + "-symbol.json")
+    assert os.path.exists(qp.prefix + "-0000.params")
+    assert os.path.exists(qp.prefix + "-0000.manifest.json")
+    # reload through the checksum-verified walk
+    qp2 = QuantizedParams.load(qp.prefix)
+    assert set(qp2.arg_params) == set(qp.arg_params)
+    assert qp2.arg_params["fc1_weight_q"].dtype == np.int8
+    assert "fc1_weight" not in qp2.arg_params     # fp32 weight dropped
+    assert qp2.meta["fc1"]["act_scale"] > 0
+    # quantized outputs track the fp32 model
+    from mxnet_tpu.serving import Predictor
+    from mxnet_tpu.model import load_checkpoint
+    sym, arg, aux = load_checkpoint(prefix, 0)
+    exe = sym.simple_bind(data=(16, FEATURE))
+    for k, v in arg.items():
+        exe.arg_dict[k][:] = v
+    exe.arg_dict["data"][:] = mx.nd.array(X[:16])
+    ref = exe.forward(is_train=False)[0].asnumpy()
+    pred = Predictor(qp2.symbol_json, qp2.param_bytes(),
+                     input_shapes={"data": (16, FEATURE)})
+    out = pred._exe.forward(is_train=False, data=X[:16])[0].asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.05
+    assert np.mean(ref.argmax(1) == out.argmax(1)) >= 0.95
+
+
+def test_quantized_artifact_corruption_detected(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    qp = quantize_checkpoint(prefix, _calib_iter(X))
+    # tear the params file: the checksum walk must refuse it loudly,
+    # never serve garbage weights
+    with open(qp.prefix + "-0000.params", "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 64)
+    with pytest.raises((CheckpointCorruptError, MXNetError)):
+        QuantizedParams.load(qp.prefix)
+
+
+def test_load_plain_checkpoint_is_not_an_artifact(tmp_path):
+    prefix, _X = _train_and_checkpoint(tmp_path, steps=1)
+    with pytest.raises(MXNetError, match="not a quantized artifact"):
+        QuantizedParams.load(prefix)
+
+
+def test_quantize_checkpoint_unknown_excluded_raises(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    with pytest.raises(MXNetError, match="fc_zap"):
+        quantize_checkpoint(prefix, _calib_iter(X),
+                            excluded_sym_names=("fc_zap",))
+
+
+def test_quantize_checkpoint_excluded_layer_stays_fp32(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    qp = quantize_checkpoint(prefix, _calib_iter(X),
+                             excluded_sym_names=("fc1",),
+                             out_prefix=str(tmp_path / "part-int8"))
+    assert set(qp.meta) == {"fc2"}
+    assert "fc1_weight" in qp.arg_params
+    assert "fc2_weight_q" in qp.arg_params
+
+
+# ---------------------------------------------------------------------------
+# serve integration: shadow A/B + hot-swap (the ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+def _registry_for(prefix, config=None):
+    from mxnet_tpu.model import load_checkpoint
+    from mxnet_tpu.ndarray import utils as nd_utils
+    sym, arg, aux = load_checkpoint(prefix, 0)
+    path = prefix + "-blob.params"
+    nd_utils.save(path, {("arg:%s" % k): v for k, v in arg.items()})
+    return ModelRegistry(
+        sym.tojson(), _blob(path), input_shapes={"data": (1, FEATURE)},
+        config=config or ServeConfig(max_batch=4, queue_depth=256,
+                                     batch_wait_ms=1,
+                                     default_timeout_ms=30000, workers=1))
+
+
+def test_swap_argument_validation(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    reg = _registry_for(prefix)
+    try:
+        with pytest.raises(MXNetError, match="exactly one"):
+            reg.swap()
+        with pytest.raises(MXNetError, match="exactly one"):
+            reg.swap(b"blob", quantized=("jso", b"x"))
+        with pytest.raises(MXNetError, match="QuantizedParams"):
+            reg.swap(quantized=12345)
+    finally:
+        reg.close()
+
+
+def test_e2e_train_quantize_swap_shadow_under_live_traffic(tmp_path):
+    """The acceptance path: trained checkpoint -> quantize_checkpoint
+    -> shadow canary -> ModelRegistry.swap(quantized=...) under 16
+    concurrent live clients — zero dropped requests, zero XLA compiles
+    after the quantized warmup, drift histograms populated, and the
+    quantized outputs bitwise-deterministic across repeat requests."""
+    prefix, X = _train_and_checkpoint(tmp_path, steps=3)
+    qp = quantize_checkpoint(prefix, _calib_iter(X),
+                             calib_mode="percentile")
+    reg = _registry_for(prefix)
+    reg.warmup()
+
+    n_clients = 16
+    per_phase = 8
+    errors = []
+    feeds = [np.random.RandomState(100 + i).randn(
+        1, FEATURE).astype(np.float32) for i in range(n_clients)]
+
+    def run_phase():
+        barrier = threading.Barrier(n_clients)
+
+        def client(i):
+            try:
+                barrier.wait()
+                for _ in range(per_phase):
+                    out = reg.predict({"data": feeds[i]})
+                    assert len(out) == 1 and out[0].shape == (1, CLASSES)
+            except Exception as e:       # pragma: no cover - diagnostic
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # phase 1: fp32 baseline traffic
+    run_phase()
+    assert not errors, errors
+
+    # phase 2: shadow canary at fraction 1.0 — every request mirrors
+    def drift_count():
+        fam = tm.REGISTRY._families.get("quantize/shadow_drift")
+        return sum(c.count for _lv, c in fam.series()) if fam else 0
+
+    drifts0 = drift_count()
+    reg.enable_shadow(qp, fraction=1.0)
+    run_phase()
+    assert not errors, errors
+    reg.disable_shadow()                 # joins pending comparisons
+    compared = drift_count() - drifts0
+    assert compared > 0, "shadow drift histogram not populated"
+    report = reg.shadow_report()
+    assert report["compared_total"] >= compared
+    assert report["drift_max"] is not None and report["drift_max"] < 0.1, \
+        "int8 drifted implausibly far from fp32 on a softmax head"
+
+    # phase 3: flip to int8 under traffic; its engine warms inside swap
+    reg.swap(quantized=qp)
+    assert reg.quantized_active
+    assert tm.counter("quantize/swaps_total").value >= 1
+    compiles0 = tm.snapshot()["backend_compile_total"]
+    run_phase()
+    assert not errors, errors
+    # zero XLA compiles after warmup, through the quantized graph
+    assert tm.snapshot()["backend_compile_total"] == compiles0
+    # bitwise determinism across repeat requests
+    a = reg.predict({"data": feeds[0]})[0]
+    b = reg.predict({"data": feeds[0]})[0]
+    assert a.tobytes() == b.tobytes()
+    # and the served int8 outputs match a direct quantized forward
+    from mxnet_tpu.serving import Predictor
+    pred = Predictor(qp.symbol_json, qp.param_bytes(),
+                     input_shapes={"data": (1, FEATURE)})
+    direct = pred._exe.forward(is_train=False, data=feeds[0])[0].asnumpy()
+    assert a.tobytes() == direct.tobytes()
+    reg.close()
+
+
+def test_shadow_failure_never_fails_primary(tmp_path):
+    """A saturated/closed shadow engine drops the mirror sample; the
+    primary request still succeeds."""
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    qp = quantize_checkpoint(prefix, _calib_iter(X))
+    reg = _registry_for(prefix)
+    reg.warmup()
+    shadow_eng = reg.enable_shadow(qp, fraction=1.0)
+    shadow_eng.close(drain=False)        # kill the shadow behind its back
+    out = reg.predict({"data": np.zeros((1, FEATURE), np.float32)})
+    assert out[0].shape == (1, CLASSES)
+    assert tm.counter("quantize/shadow_dropped_total").value >= 1
+    reg.close()
+
+
+def test_shadow_fraction_zero_never_mirrors(tmp_path):
+    prefix, X = _train_and_checkpoint(tmp_path, steps=1)
+    qp = quantize_checkpoint(prefix, _calib_iter(X))
+    reg = _registry_for(prefix)
+    reg.warmup()
+    mirrored0 = tm.counter("quantize/shadow_requests_total").value
+    reg.enable_shadow(qp, fraction=0.0)
+    for _ in range(8):
+        reg.predict({"data": np.zeros((1, FEATURE), np.float32)})
+    assert tm.counter("quantize/shadow_requests_total").value == mirrored0
+    reg.close()
