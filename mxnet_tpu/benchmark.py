@@ -102,6 +102,28 @@ def _mfu_extra(mfu, pk, convention=None, conv_net=True):
             "conv nets; treat with suspicion" % (mfu, MFU_PLAUSIBLE_CONV))
     return extra
 
+def _note_mfu_divergence(extra, tol=0.20):
+    """Where a hand-counted ``mfu_est`` and a measured ``mfu_measured``
+    (XLA ``cost_analysis`` FLOPs via health.capture_cost) coexist,
+    record a warning when they disagree by more than ``tol`` — the
+    measured number is the authoritative one (it counts the FLOPs the
+    compiler actually scheduled), and a large gap means the hand
+    convention above (MAC-vs-FLOP, the 3x-forward train rule) misreads
+    this workload."""
+    est, meas = extra.get("mfu_est"), extra.get("mfu_measured")
+    if not est or not meas:
+        return
+    ratio = meas / est
+    extra["mfu_measured_vs_est"] = round(ratio, 3)
+    if abs(ratio - 1.0) > tol:
+        extra["mfu_divergence_warning"] = (
+            "measured MFU %.4f vs hand-counted %.4f (ratio %.2f) "
+            "diverge by more than %d%%; trust the measured number — "
+            "the hand FLOP convention (%s) misreads this workload"
+            % (meas, est, ratio, int(tol * 100),
+               extra.get("flop_convention", FLOP_CONVENTION)))
+
+
 # forward GFLOPs/image at the standard input size (2x MACs), used to
 # sanity-gate measurements: a reading implying more FLOP/s than the
 # chip's physical peak means the timing loop was not actually blocking
@@ -801,6 +823,16 @@ def _measure_module_train(sym, batch, input_shape, num_classes, iters,
             "fused_step_cache_hits": (snap1["fused_step_cache_hits"]
                                       - snap0["fused_step_cache_hits"]),
         }
+        if fused:
+            # measured MFU from the compiled program's own cost
+            # analysis (health.capture_cost at program build) — the
+            # number that settles benchmark.py's hand-counted FLOP
+            # convention ambiguity (see _mfu_extra)
+            rec = mod._exec.fused_cost()
+            if rec is not None:
+                extra["flops_per_step_measured"] = rec["flops"]
+                extra["mfu_measured"] = round(
+                    rec["flops"] / dt / peak_flops("float32"), 4)
         return img_s, extra
     finally:
         if prev is None:
@@ -828,6 +860,7 @@ def train_resnet_module_fused(batch=32, iters=10, num_layers=50,
             "implausible measurement: %.0f img/s implies MFU %.2f > 1 "
             "— transport not blocking, refusing to bank" % (img_s, mfu))
     extra.update(_mfu_extra(mfu, pk))
+    _note_mfu_divergence(extra)
     extra["unfused_img_per_sec"] = round(unfused_img_s, 2)
     extra["unfused_ms_per_step"] = unfused_x["ms_per_step"]
     extra["unfused_dispatches_per_step"] = unfused_x["dispatches_per_step"]
@@ -1143,6 +1176,120 @@ def trace_overhead(iters=300, rounds=12):
     # persist() keeps the highest value per metric, so bank a
     # higher-is-better rate (dispatches/s with tracing compiled out)
     return 1e6 / us["off"], extra
+
+
+# ---------------------------------------------------------------------------
+# health-layer overhead job (health.py cost-model proof)
+
+def health_overhead(batch=256, hidden=1024, iters=25, rounds=8):
+    """Fused-step wall time with the numerics sentinels off / ``step``
+    / ``full`` and the flight recorder off / on, banked min-of-rounds
+    with the mode order alternated per round (trace_overhead's
+    drift-cancelling discipline). The probe MLP is sized so one step
+    is a few ms of real compute — the sentinel's fixed cost (a small
+    D2H fetch) must be judged against a realistic step, not a
+    dispatch-latency microbench.
+
+    RAISES when ``step``-mode overhead exceeds 2% — the budget
+    docs/observability.md promises for always-on production
+    sentinels. ``full`` (per-param attribution) and the recorder rows
+    are informational: full is a debugging mode, and the recorder
+    writes nothing on the steady-step path (compiles/checkpoints/
+    faults are the events), so its row documents exactly that."""
+    import tempfile
+    import mxnet_tpu as mx
+    from . import health as _health
+    from . import blackbox as _bb
+    from .context import current_context
+    from .io import DataBatch
+    from .module import Module
+
+    data = mx.sym.Variable("data")
+    h1 = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=hidden, name="fc1"), act_type="relu")
+    h2 = mx.sym.Activation(mx.sym.FullyConnected(
+        h1, num_hidden=hidden, name="fc2"), act_type="relu")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        h2, num_hidden=10, name="fc3"), name="softmax")
+
+    mod = Module(sym, context=current_context())
+    mod.bind(data_shapes=[("data", (batch, hidden))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    db = DataBatch(
+        data=[mx.nd.array(rng.randn(batch, hidden).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, size=(batch,))
+                           .astype(np.float32))])
+    rec_path = tempfile.mktemp(prefix="health_overhead_", suffix=".bin")
+
+    prev_mode = _health.numerics_mode()
+    prev_rec = _bb.path()
+
+    def loop(mode, recorder):
+        _health.set_numerics(mode)
+        _bb.configure(rec_path if recorder else None)
+        try:
+            pname = mod._param_names[0]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                mod.forward_backward(db)
+                mod.update()
+            _fetch(mod._exec.arg_dict[pname]._data)
+            return time.perf_counter() - t0
+        finally:
+            _bb.configure(None)
+
+    # "off2" measures the IDENTICAL configuration as "off" a second
+    # time: its spread against "off" is the harness's own noise floor,
+    # and the 2% budget is only enforceable above it — on a loaded
+    # host, min-of-rounds still jitters several percent, and a hard
+    # gate inside the noise would flake with no code regression
+    configs = (("off", ("off", False)), ("step", ("step", False)),
+               ("full", ("full", False)), ("step_rec", ("step", True)),
+               ("off2", ("off", False)))
+    try:
+        for _name, (m, r) in configs:
+            loop(m, r)                   # warm: each mode's program
+        best = {name: float("inf") for name, _ in configs}
+        for rnd in range(rounds):
+            order = configs if rnd % 2 == 0 else tuple(reversed(configs))
+            for name, (m, r) in order:
+                best[name] = min(best[name], loop(m, r))
+    finally:
+        _health.set_numerics(prev_mode)
+        _bb.configure(prev_rec)
+        if os.path.exists(rec_path):
+            os.unlink(rec_path)
+        if os.path.exists(rec_path + ".1"):
+            os.unlink(rec_path + ".1")
+
+    ms = {k: v / iters * 1e3 for k, v in best.items()}
+    pct = {k: round((ms[k] / ms["off"] - 1.0) * 100, 2) for k in ms}
+    noise_pct = abs(pct["off2"])
+    extra = {
+        "ms_per_step_off": round(ms["off"], 3),
+        "ms_per_step_step": round(ms["step"], 3),
+        "ms_per_step_full": round(ms["full"], 3),
+        "ms_per_step_step_recorder": round(ms["step_rec"], 3),
+        "overhead_pct_step": pct["step"],
+        "overhead_pct_full": pct["full"],
+        "overhead_pct_step_recorder": pct["step_rec"],
+        "harness_noise_pct": noise_pct,
+        "batch": batch, "hidden": hidden,
+        "loop": "min-of-%d rounds, mode order alternated; off2 = "
+                "off re-measured (noise floor)" % rounds,
+    }
+    if pct["step"] > max(2.0, 2 * noise_pct):
+        raise RuntimeError(
+            "step-mode numerics sentinel overhead %.2f%% exceeds the "
+            "2%% budget and the %.2f%% harness noise floor (off %.3f "
+            "ms vs step %.3f ms per step)"
+            % (pct["step"], noise_pct, ms["off"], ms["step"]))
+    return 1e3 / ms["step"], extra
 
 
 # ---------------------------------------------------------------------------
@@ -1687,6 +1834,14 @@ def quantized_serve(offered_rps=240, clients=16, duration=2.5,
                 "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
                 "errors": errors,
                 "compiles_after_warmup": int(compiles)}
+            # measured per-bucket MFU from the live health gauges
+            # (cost_analysis FLOPs / compute wall) — each mode's
+            # engine overwrote the gauges during ITS round, so read
+            # them here, before the next variant serves
+            from . import health as _health
+            bucket_mfu = _health.mfu_summary().get("serve_bucket_mfu")
+            if bucket_mfu:
+                results[mode]["mfu_measured"] = max(bucket_mfu.values())
         if results["int8"]["compiles_after_warmup"]:
             raise RuntimeError(
                 "int8 engine compiled %d program(s) under traffic after "
@@ -1849,6 +2004,14 @@ def _job_trace_overhead():
                    host_metric=True)
 
 
+def _job_health_overhead():
+    v, x = health_overhead()
+    return persist("health_overhead_steps_per_sec", v,
+                   "fused steps/s with MXNET_NUMERICS=step (off/step/"
+                   "full/recorder overhead %% in extras; raises past "
+                   "the 2%% step-mode budget)", x, host_metric=True)
+
+
 def _job_predictor_serve():
     v, x = serve_predictor()
     return persist("predictor_serve_req_per_sec", v,
@@ -1891,6 +2054,7 @@ def _make_infer_job(model, dtype, batch=32):
 
 JOBS = {
     "trace_overhead": _job_trace_overhead,
+    "health_overhead": _job_health_overhead,
     "train_resume": _job_train_resume,
     "dist_failover": _job_dist_failover,
     "mlp_train": _job_mlp_train,
@@ -1924,6 +2088,7 @@ JOB_PRIORITY = [
     "mlp_train",
     "mlp_train_fused",
     "trace_overhead",
+    "health_overhead",
     "train_resume",
     "dist_failover",
     "predictor_serve",

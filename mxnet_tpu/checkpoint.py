@@ -117,6 +117,13 @@ def record_checkpoint_save(param_file, t0):
     save_seconds, bytes_total) — shared by every save_checkpoint
     writer so the accounting cannot drift between them."""
     from . import telemetry as _tm
+    try:
+        from . import blackbox as _bb
+        _bb.record_event("checkpoint",
+                         file=os.path.basename(param_file),
+                         seconds=round(_tm.monotonic() - t0, 4))
+    except Exception:
+        pass
     if not _tm._enabled:
         return
     _tm.counter("checkpoint/saves_total", "Checkpoints written").inc()

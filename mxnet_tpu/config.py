@@ -243,6 +243,71 @@ VARS = {
                        "from the active trace context). 0 keeps the "
                        "plain formatter, which appends [trace=…] when "
                        "a context is active."),
+    "MXNET_NUMERICS": (str, "off",
+                       "In-program numerics sentinels folded into the "
+                       "fused train step (health.py): off | step "
+                       "(loss proxy + global grad norm + nonfinite "
+                       "count, one small D2H fetch per step) | full "
+                       "(adds per-parameter attribution so a trip "
+                       "names the layer). Zero extra host dispatches, "
+                       "zero recompiles across LR steps."),
+    "MXNET_NUMERICS_POLICY": (str, "warn",
+                              "What a numerics-sentinel trip does: "
+                              "warn (log + count + flight-record, "
+                              "keep training) | raise "
+                              "(health.NumericsError) | "
+                              "checkpoint-and-raise (fit saves the "
+                              "tripped state under <prefix>.numerics "
+                              "for forensics, then raises)."),
+    "MXNET_NUMERICS_SPIKE": (float, 0.0,
+                             "Grad-norm spike threshold: trip when the "
+                             "global grad norm exceeds this many times "
+                             "its running EMA. 0 disables spike "
+                             "detection (nonfinite detection stays "
+                             "on)."),
+    "MXNET_FLIGHT_RECORDER": (str, "",
+                              "Crash-safe flight-recorder path "
+                              "(blackbox.py): lifecycle events "
+                              "(compiles, swaps, failovers, rejoins, "
+                              "checkpoints, faults, alerts, numerics "
+                              "trips) appended as CRC-framed fsync'd "
+                              "records readable post-mortem via "
+                              "python -m mxnet_tpu.blackbox. Empty "
+                              "disables."),
+    "MXNET_FLIGHT_RECORDER_MB": (float, 4.0,
+                                 "Flight-recorder ring bound: the "
+                                 "active segment rotates to <path>.1 "
+                                 "at half this size, so on-disk "
+                                 "footprint never exceeds ~this many "
+                                 "MB and the newest events always "
+                                 "survive."),
+    "MXNET_SLO_INTERVAL_S": (float, 2.0,
+                             "SLO evaluator wake period (health.py "
+                             "background thread; it only READS "
+                             "telemetry). Rules fire on multi-window "
+                             "burn rate, so the interval bounds "
+                             "detection latency, not sensitivity."),
+    "MXNET_SLO_SERVE_P99_MS": (float, 1000.0,
+                               "Default serve_p99 SLO rule threshold: "
+                               "interval-local p99 of serving/"
+                               "request_seconds above this fires "
+                               "/alerts after the burn windows "
+                               "agree."),
+    "MXNET_SLO_DECODE_ITL_P99_MS": (float, 250.0,
+                                    "Default decode_itl_p99 SLO rule "
+                                    "threshold over decode/"
+                                    "step_seconds p99 (inter-token "
+                                    "latency)."),
+    "MXNET_TPU_PEAK_FLOPS": (float, 197e12,
+                             "Peak accelerator FLOP/s used as the MFU "
+                             "denominator by BOTH benchmark.py "
+                             "estimates and the live executor/mfu "
+                             "gauge (health.py). Default: v5e bf16 "
+                             "MXU peak."),
+    "MXNET_TPU_PEAK_HBM_GBPS": (float, 819.0,
+                                "Peak HBM bandwidth (GB/s) for the "
+                                "hbm_bw_util roofline gauges. "
+                                "Default: v5e."),
     "MXNET_FAULT_INJECT": (str, "",
                            "Arm fault-injection points at import: "
                            "point:step:kind[:count] comma list "

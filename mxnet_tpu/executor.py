@@ -21,6 +21,7 @@ import numpy as _np
 from .base import MXNetError, install_donation_warning_filter
 from .ndarray.ndarray import NDArray, zeros
 from .context import current_context
+from . import health as _health
 from . import random as _random
 from . import telemetry as _tm
 from . import tracing as _tr
@@ -100,6 +101,15 @@ class Executor(object):
         self._jitted = {}
         self._vjp_jitted = {}
         self._fused_jitted = {}
+        # health-layer accounting: captured cost-analysis records per
+        # program, grad-norm EMA for spike detection, and the previous
+        # step-end stamp the throughput-MFU interval is measured from
+        self._fwd_cost = {}
+        self._fused_costs = {}
+        self._fused_cost_rec = None
+        self._numerics_state = {}
+        self._pending_sentinel = None
+        self._last_step_end = None
         self.outputs = []
         self._monitor_callback = None
         self._dp_mesh = None
@@ -246,9 +256,19 @@ class Executor(object):
         for k, v in kwargs.items():
             self._stage_input(k, v)
         key = _random.next_key() if self._needs_rng else None
+        fwd = self._fwd(bool(is_train))
+        env = self._env()
         with _tr.child_span("executor.forward",
                             attrs={"is_train": bool(is_train)}):
-            outs, new_aux = self._fwd(bool(is_train))(self._env(), key)
+            outs, new_aux = fwd(env, key)
+        if bool(is_train) not in self._fwd_cost:
+            # one-shot roofline capture per forward program (an HLO
+            # cost pass over the lowered module, not a second compile);
+            # keyed by a process-unique sequence, never id(self) — a
+            # GC-reused address must not inherit a dead graph's FLOPs
+            self._fwd_cost[bool(is_train)] = _health.capture_cost(
+                "executor_forward", _health.next_cost_key("fwd"),
+                fwd, (env, key))
         self._last_key = key
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
@@ -301,16 +321,60 @@ class Executor(object):
             tgt._set_data(gs[n])
 
     # -- fused train step --------------------------------------------------
-    def _build_fused_step(self, rule, update_names, default_ct, donate):
+    def _build_fused_step(self, rule, update_names, default_ct, donate,
+                          numerics="off"):
         """Trace + jit ONE program computing forward outputs, all
         gradients (jax.vjp over the same pure graph function), the
         optimizer update for every parameter in ``update_names`` via
         ``rule``, and the aux-state updates. Parameter and optimizer-state
         buffers are donated so XLA aliases them input→output: an in-place
-        HBM update with no per-parameter copies."""
+        HBM update with no per-parameter copies.
+
+        ``numerics`` != 'off' folds the health sentinels into the SAME
+        program: a loss proxy (mean of the first output), the global
+        gradient L2 norm, and the nonfinite-element count — all over
+        the gradients the program already holds, so the sentinel costs
+        a handful of reductions and ZERO extra host dispatches or
+        recompiles (hyper scalars stay traced arguments). ``full``
+        additionally returns per-parameter norm/nonfinite vectors for
+        blast-radius attribution. Everything is packed into ONE flat
+        float32 vector so the host pays a single small D2H fetch per
+        step."""
         import jax
         import jax.numpy as jnp
         fn = _graph_eval_fn(self._symbol, True)
+
+        def _sentinel(gs, outs):
+            # step mode costs ONE reduction pass over each gradient:
+            # the per-param squared-sum. Nonfinite detection falls out
+            # free — squares are non-negative, so a single NaN/inf
+            # element makes the param's squared-sum NaN/inf (nothing
+            # can cancel it) and the "nonfinite" figure is the count
+            # of AFFECTED PARAMS. full mode pays a second elementwise
+            # pass for exact per-param element counts (the debugging
+            # mode; the 2% budget applies to step).
+            f32 = jnp.float32
+            sq, nf = [], []
+            for n in update_names:
+                g = gs[n]
+                if jnp.issubdtype(g.dtype, jnp.inexact):
+                    g32 = g.astype(f32)
+                    sq.append(jnp.sum(jnp.square(g32)))
+                    if numerics == "full":
+                        nf.append(jnp.sum(~jnp.isfinite(g32))
+                                  .astype(f32))
+                else:
+                    sq.append(jnp.zeros((), f32))
+                    if numerics == "full":
+                        nf.append(jnp.zeros((), f32))
+            sq = jnp.stack(sq)
+            loss = jnp.mean(outs[0]).astype(f32)
+            bad = jnp.sum(jnp.stack(nf)) if numerics == "full" \
+                else jnp.sum(~jnp.isfinite(sq)).astype(f32)
+            head = jnp.stack([loss, jnp.sqrt(jnp.sum(sq)), bad])
+            if numerics == "step":
+                return head
+            return jnp.concatenate([head, jnp.sqrt(sq), jnp.stack(nf)])
 
         def _core(genv, senv, henv, fenv, key, cts):
             def fwd(ge):
@@ -322,10 +386,11 @@ class Executor(object):
             if cts is None:
                 cts = tuple(jnp.ones(o.shape, dtype=o.dtype) for o in outs)
             (gs,) = vjp_fn(tuple(cts))
+            sentinel = _sentinel(gs, outs) if numerics != "off" else None
             new_p, new_s = {}, {}
             for n in update_names:
                 new_p[n], new_s[n] = rule(genv[n], gs[n], senv[n], henv[n])
-            return new_p, new_s, new_aux, outs
+            return new_p, new_s, new_aux, outs, sentinel
 
         if default_ct:
             def run(genv, senv, henv, fenv, key):
@@ -377,25 +442,9 @@ class Executor(object):
         # buffers held by external code stay valid on TPU
         from .config import get as _cfg
         donate = bool(_cfg("MXNET_UPDATE_BUFFER_DONATION"))
-        cache_key = (rule, update_names, out_grads is None, donate)
-        run = self._fused_jitted.get(cache_key)
-        if run is None:
-            install_donation_warning_filter()
-            run = self._build_fused_step(rule, update_names,
-                                         out_grads is None, donate)
-            self._fused_jitted[cache_key] = run
-            if _tm._enabled:
-                _tm._ensure_compile_listener()
-                _tm.counter("executor/fused_step_compile_total",
-                            "Fused train-step program builds "
-                            "(fwd+bwd+update traced as one program)").inc()
-                _tm.counter("executor/fused_step_cache_miss_total",
-                            "Fused train-step calls that built a new "
-                            "program").inc()
-        elif _tm._enabled:
-            _tm.counter("executor/fused_step_cache_hit_total",
-                        "Fused train-step calls served from the program "
-                        "cache").inc()
+        numerics = _health.numerics_mode()
+        cache_key = (rule, update_names, out_grads is None, donate,
+                     numerics)
 
         env = self._env()
         genv = {n: env.pop(n) for n in update_names}
@@ -418,15 +467,45 @@ class Executor(object):
         if out_grads is not None:
             args.append(self._normalize_out_grads(out_grads))
 
+        run = self._fused_jitted.get(cache_key)
+        if run is None:
+            install_donation_warning_filter()
+            run = self._build_fused_step(rule, update_names,
+                                         out_grads is None, donate,
+                                         numerics)
+            self._fused_jitted[cache_key] = run
+            # roofline capture at compile time (HLO cost pass, NOT a
+            # second backend compile; its pseudo-compile events are
+            # suppressed from the telemetry counters)
+            self._fused_costs[cache_key] = _health.capture_cost(
+                "fused_step", _health.next_cost_key("step"),
+                run, tuple(args))
+            # the interval ending here includes trace+lower+compile:
+            # never let it pollute the throughput-MFU gauge
+            self._last_step_end = None
+            if _tm._enabled:
+                _tm._ensure_compile_listener()
+                _tm.counter("executor/fused_step_compile_total",
+                            "Fused train-step program builds "
+                            "(fwd+bwd+update traced as one program)").inc()
+                _tm.counter("executor/fused_step_cache_miss_total",
+                            "Fused train-step calls that built a new "
+                            "program").inc()
+        elif _tm._enabled:
+            _tm.counter("executor/fused_step_cache_hit_total",
+                        "Fused train-step calls served from the program "
+                        "cache").inc()
+        self._fused_cost_rec = self._fused_costs.get(cache_key)
+
         from . import engine as _engine
         from . import profiler as _prof
         token = _tm.dispatch_begin() if _tm._enabled else None
         with _tr.child_span("executor.train_step"):
             if _engine.profiling_imperative():
                 with _prof.scope("fused_train_step", "executor"):
-                    new_p, new_s, new_aux, outs = run(*args)
+                    new_p, new_s, new_aux, outs, sentinel = run(*args)
             else:
-                new_p, new_s, new_aux, outs = run(*args)
+                new_p, new_s, new_aux, outs, sentinel = run(*args)
         if token is not None:
             _tm.dispatch_end("fused_train_step", token)
 
@@ -440,7 +519,66 @@ class Executor(object):
         if _tm._enabled:
             _tm.counter("executor/fused_step_total",
                         "Completed fused train steps").inc()
+
+        # throughput MFU: the interval between consecutive step ends is
+        # the honest steady-state step wall (compute + whatever host
+        # work the loop pays); combined with the program's measured
+        # FLOPs it sets executor/mfu + executor/hbm_bw_util
+        now = _tm.monotonic()
+        last, self._last_step_end = self._last_step_end, now
+        if last is not None and self._fused_cost_rec is not None:
+            _health.note_executor_step(self._fused_cost_rec, now - last)
+
+        # the sentinel verdict is read ONE step deferred: step N's
+        # vector is fetched after step N+1 has been dispatched, so the
+        # (tiny) D2H blocks only on a program that must already have
+        # finished — the host/device pipeline never stalls and a trip
+        # still surfaces within one step (flush_numerics() drains the
+        # tail at epoch/run end)
+        pending, self._pending_sentinel = self._pending_sentinel, None
+        if sentinel is not None:
+            self._pending_sentinel = (sentinel, numerics, update_names)
+        if pending is not None:
+            self._check_sentinel(*pending)
         return self.outputs
+
+    def _check_sentinel(self, sentinel, numerics, update_names):
+        """Read one step's packed sentinel vector (a single small D2H
+        fetch — not an op dispatch, not a recompile; the
+        health_overhead bench bounds it under 2% of the step) and
+        apply the numerics policy."""
+        vals = _np.asarray(sentinel)
+        report = {"loss": float(vals[0]),
+                  "grad_norm": float(vals[1]),
+                  "nonfinite": int(vals[2])}
+        if numerics == "full":
+            p = len(update_names)
+            report["per_param"] = {
+                n: {"norm": float(vals[3 + i]),
+                    "nonfinite": int(vals[3 + p + i])}
+                for i, n in enumerate(update_names)}
+        _health.check_numerics(report, state=self._numerics_state)
+
+    def flush_numerics(self):
+        """Drain the deferred sentinel of the LAST fused step (applies
+        the policy for a trip on a run's final step); called by
+        ``Module.fit`` at each epoch end."""
+        pending, self._pending_sentinel = self._pending_sentinel, None
+        if pending is not None:
+            self._check_sentinel(*pending)
+
+    def fused_cost(self):
+        """Cost-analysis record of the most recently used fused-step
+        program ({'flops','bytes',...}), or None where the backend
+        offers no analysis (benchmark.py banks ``mfu_measured`` from
+        this)."""
+        return self._fused_cost_rec
+
+    def forward_cost(self, is_train=False):
+        """Cost-analysis record of the compiled forward program (the
+        serve engine aliases this under its bucket for per-bucket
+        MFU)."""
+        return self._fwd_cost.get(bool(is_train))
 
     # -- parameter management ---------------------------------------------
     def alias_args(self, other, names):

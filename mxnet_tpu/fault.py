@@ -217,6 +217,14 @@ def inject(point):
         _tr.mark_error("fault injected at %r (hit %d)" % (point, hit))
     except Exception:
         pass
+    try:
+        # the flight recorder gets the fault BEFORE a crash kind calls
+        # os._exit — the post-mortem ring names its own killer (the
+        # record is fsync'd by the time record_event returns)
+        from . import blackbox as _bb
+        _bb.record_event("fault", point=point, kind=kind, hit=hit)
+    except Exception:
+        pass
     if kind == "crash":
         # SIGKILL-grade: no atexit, no finally, buffers not flushed —
         # the honest preemption simulation

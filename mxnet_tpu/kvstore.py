@@ -199,6 +199,12 @@ class KVStore(object):
                     "kvstore/server_failovers_total",
                     "KVStore server restarts observed by this client "
                     "(incarnation changes)").inc()
+            try:
+                from . import blackbox as _bb
+                _bb.record_event("failover", old=str(old), new=str(inc),
+                                 rank=self._env_rank)
+            except Exception:
+                pass
             import logging
             logging.warning(
                 "kvstore server restarted (incarnation %s -> %s); rank "

@@ -605,6 +605,13 @@ class KVStoreServer(object):
                             "kvstore/worker_rejoins_total",
                             "Ranks re-admitted after being declared "
                             "dead", ("rank",)).labels(str(r)).inc()
+                    try:
+                        from . import blackbox as _bb
+                        _bb.record_event(
+                            "rejoin", rank=r,
+                            member_epoch=self._member_epoch[r])
+                    except Exception:
+                        pass
                 self._dead_declared.discard(r)
                 self._last_seen[r] = time.monotonic()
                 return ("OK", {"incarnation": self.incarnation,

@@ -12,6 +12,7 @@ import threading
 import time
 
 from .. import fault as _fault
+from .. import health as _health
 from .. import metric as _metric
 from .. import io as _io
 from .. import tracing as _tr
@@ -341,10 +342,25 @@ class BaseModule(object):
                                                "nbatch": nbatch}):
                         if monitor is not None:
                             monitor.tic()
-                        with _tr.child_span("train.forward_backward"):
-                            self.forward_backward(data_batch)
-                        with _tr.child_span("train.update"):
-                            self.update()
+                        try:
+                            with _tr.child_span("train.forward_backward"):
+                                self.forward_backward(data_batch)
+                            with _tr.child_span("train.update"):
+                                self.update()
+                        except _health.NumericsError:
+                            # policy checkpoint-and-raise: preserve the
+                            # tripped state under a FORENSIC prefix (the
+                            # nonfinite params are the blast-radius
+                            # evidence) without clobbering the recovery
+                            # chain load_latest_valid walks, then stop
+                            if (checkpoint_prefix is not None
+                                    and _health.numerics_policy()
+                                    == "checkpoint-and-raise"):
+                                self._save_fit_checkpoint(
+                                    checkpoint_prefix + ".numerics",
+                                    epoch, nbatch + 1,
+                                    save_optimizer_states, train_data)
+                            raise
                         if isinstance(data_batch, list):
                             self.update_metric(
                                 eval_metric,
@@ -394,6 +410,20 @@ class BaseModule(object):
                             "batch %d; stopping fit (resume=True picks "
                             "up here)", epoch, nbatch)
                         return
+
+                # drain the deferred numerics sentinel of the epoch's
+                # final step (its verdict is read one step behind so
+                # the device pipeline never stalls)
+                try:
+                    self._flush_numerics()
+                except _health.NumericsError:
+                    if (checkpoint_prefix is not None
+                            and _health.numerics_policy()
+                            == "checkpoint-and-raise"):
+                        self._save_fit_checkpoint(
+                            checkpoint_prefix + ".numerics", epoch,
+                            nbatch, save_optimizer_states, train_data)
+                    raise
 
                 for name, val in eval_name_vals:
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
@@ -554,6 +584,14 @@ class BaseModule(object):
     def prepare(self, data_batch, sparse_row_id_fn=None):
         """Prepare for processing a batch (row-sparse pull hook in the
         reference; no-op here)."""
+
+    def _flush_numerics(self):
+        """Drain the bound executor's deferred numerics sentinel (the
+        per-step verdict is read one step behind); no-op for modules
+        without a fused-step executor."""
+        exe = getattr(self, "_exec", None)
+        if exe is not None and hasattr(exe, "flush_numerics"):
+            exe.flush_numerics()
 
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()

@@ -53,6 +53,7 @@ from collections import deque
 import numpy as _np
 
 from .. import fault as _fault
+from .. import health as _health
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from ..base import MXNetError
@@ -242,6 +243,7 @@ class DecodeEngine(object):
             model_cfg, self._cfg.num_pages, self._cfg.page_size)
         self._prefill_progs = {}
         self._step_progs = {}
+        self._prog_costs = {}            # (phase, bucket) -> rec | None
         self._cond = threading.Condition()
         self._waiting = deque()
         self._live = []
@@ -330,24 +332,39 @@ class DecodeEngine(object):
         provenance steady-state traffic ever presents. The second pass
         runs every program against pjit-provenance pools, so any such
         re-specialization compiles here, not on the first request."""
-        for _ in range(2):
+        for pass_i in range(2):
             for b in self._cfg.prefill_buckets:
                 n_pb = b // self._cfg.page_size
+                pargs = (self._params, self._k_pages, self._v_pages,
+                         _np.zeros(n_pb, _np.int32),
+                         _np.zeros((1, b), _np.int32),
+                         _np.array([b], _np.int32))
+                if pass_i == 0:
+                    # roofline capture BEFORE executing: the pools are
+                    # donated by the call, so only the pre-call arrays
+                    # are certain to be live for the HLO cost pass
+                    self._prog_costs[("prefill", b)] = \
+                        _health.capture_cost(
+                            "decode_prefill",
+                            _health.next_cost_key("dec"),
+                            self._prefill_prog(b), pargs)
                 tok0, self._k_pages, self._v_pages = \
-                    self._prefill_prog(b)(
-                        self._params, self._k_pages, self._v_pages,
-                        _np.zeros(n_pb, _np.int32),
-                        _np.zeros((1, b), _np.int32),
-                        _np.array([b], _np.int32))
+                    self._prefill_prog(b)(*pargs)
                 int(tok0)                # block: compile + execute done
             for nslots in self._cfg.slot_buckets:
+                sargs = (self._params, self._k_pages, self._v_pages,
+                         _np.zeros((nslots, self._cfg.pages_per_seq),
+                                   _np.int32),
+                         _np.zeros(nslots, _np.int32),
+                         _np.zeros(nslots, _np.int32))
+                if pass_i == 0:
+                    self._prog_costs[("step", nslots)] = \
+                        _health.capture_cost(
+                            "decode_step",
+                            _health.next_cost_key("dec"),
+                            self._step_prog(nslots), sargs)
                 toks, self._k_pages, self._v_pages = \
-                    self._step_prog(nslots)(
-                        self._params, self._k_pages, self._v_pages,
-                        _np.zeros((nslots, self._cfg.pages_per_seq),
-                                  _np.int32),
-                        _np.zeros(nslots, _np.int32),
-                        _np.zeros(nslots, _np.int32))
+                    self._step_prog(nslots)(*sargs)
                 _np.asarray(toks)
 
     @property
@@ -706,6 +723,8 @@ class DecodeEngine(object):
         t1 = _tm.monotonic()
         self._m_prefill.observe(
             t1 - t0, trace_id=sess.tctx.trace_id if sess.tctx else None)
+        _health.note_decode("prefill", bucket, t1 - t0,
+                            self._prog_costs.get(("prefill", bucket)))
         if sess.tctx is not None and sess.tctx.sampled:
             _tr.record_span("decode.prefill", sess.tctx, t0, t1,
                             parent_id=sess.tctx.span_id,
@@ -751,6 +770,8 @@ class DecodeEngine(object):
         toks = _np.asarray(toks)
         t1 = _tm.monotonic()
         self._m_step.observe(t1 - t0)
+        _health.note_decode("step", nslots, t1 - t0,
+                            self._prog_costs.get(("step", nslots)))
 
         traced = [s for s in live
                   if s.tctx is not None and s.tctx.sampled]

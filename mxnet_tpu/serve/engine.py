@@ -40,6 +40,7 @@ import numpy as _np
 
 from .. import fault as _fault
 from ..base import MXNetError
+from .. import health as _health
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from .batching import parse_buckets, pick_bucket, validate_buckets
@@ -220,6 +221,8 @@ class InferenceEngine(object):
             self._dtypes[k] = arr.dtype
         self._preds = {}                 # bucket -> Predictor
         self._pred_locks = {}            # bucket -> forward lock
+        self._bucket_cost = {}           # bucket -> cost record | None
+        self._cost_tag = None            # unique registry tag, lazy
         self._build_lock = threading.Lock()
         self._queue = deque()
         self._cond = threading.Condition()
@@ -285,8 +288,23 @@ class InferenceEngine(object):
                 outs = pred._exe.forward(is_train=False, **feed)
                 for o in outs:
                     o.asnumpy()
+            self._note_bucket_cost(b, pred)
         self._ready = True
         return self
+
+    def _note_bucket_cost(self, bucket, pred):
+        """Alias the bucket forward's cost-analysis capture (taken by
+        the executor on its first forward) under this ENGINE's bucket
+        so measured compute walls turn into per-bucket serving/mfu.
+        The registry key carries a process-unique engine tag: two live
+        engines (shadow A/B, swap drain) must never share a record."""
+        if bucket not in self._bucket_cost:
+            if self._cost_tag is None:
+                self._cost_tag = _health.next_cost_key("eng")
+            self._bucket_cost[bucket] = _health.register_cost(
+                "serve_bucket", "%s/%s" % (self._cost_tag, bucket),
+                pred._exe.forward_cost(False))
+        return self._bucket_cost[bucket]
 
     @property
     def ready(self):
@@ -563,6 +581,8 @@ class InferenceEngine(object):
         self._m_waste.observe((bucket - rows) / float(bucket))
         self._m_compute.observe(
             t1 - t0, trace_id=leader.trace_id if leader else None)
+        _health.note_serve_batch(bucket, t1 - t0,
+                                 self._note_bucket_cost(bucket, pred))
         exact_fit = len(live) == 1 and live[0].rows == outs_np[0].shape[0]
         offset = 0
         results = []

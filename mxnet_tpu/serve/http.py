@@ -198,6 +198,10 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
             elif path == "/traces":
                 code, payload = _tr.traces_endpoint(query)
                 self._reply(code, payload)
+            elif path == "/alerts":
+                from .. import health as _hl
+                code, payload = _hl.alerts_endpoint(query)
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": "not found"})
 

@@ -393,6 +393,12 @@ class ModelRegistry(object):
         if quantized is not None:
             _tm.counter("quantize/swaps_total",
                         "Hot-swaps to a quantized int8 variant").inc()
+        try:
+            from .. import blackbox as _bb
+            _bb.record_event("swap", quantized=quantized is not None,
+                             decode_rotated=decode_params is not None)
+        except Exception:
+            pass
         old.close(drain=True, timeout=drain_timeout)
         return new
 

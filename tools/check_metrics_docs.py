@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Static check: metric and span names vs docs/observability.md.
+"""Static check: metric/span/flight-event/SLO-rule names vs
+docs/observability.md.
 
 Every metric family registered with a string literal
 (``telemetry.counter/gauge/histogram("name", ...)``) and every span
 name opened with a literal (``tracing.start_span/child_span/
 record_span("name", ...)``) anywhere under ``mxnet_tpu/`` must appear
 in docs/observability.md — and every name listed in that doc's metric
-and span tables must still exist in the code. Fails listing the
+and span tables must still exist in the code. The same contract covers
+the health layer: flight-recorder event names (``blackbox.EVENTS``
+keys plus every ``record_event("name", ...)`` literal) must match the
+table under the ``<!-- flight-recorder-events -->`` marker, and SLO
+rule names (``health.watch("name", ...)`` literals under mxnet_tpu/)
+must match the table under ``<!-- slo-rules -->``. Fails listing the
 missing names on either side, so the observability surface and its
 documentation cannot silently drift (the same contract fault.POINTS
 enforces for injection points).
@@ -26,8 +32,11 @@ DOC = os.path.join(ROOT, "docs", "observability.md")
 
 _METRIC_CALLS = {"counter", "gauge", "histogram"}
 _SPAN_CALLS = {"start_span", "child_span", "record_span"}
+_EVENT_CALLS = {"record_event"}
+_RULE_CALLS = {"watch"}
 _METRIC_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+$")
 _SPAN_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+_PLAIN_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 
 def _call_name(node):
@@ -39,9 +48,12 @@ def _call_name(node):
 
 
 def collect_code_names():
-    """(metric_names, span_names) registered via string literals under
-    mxnet_tpu/."""
-    metrics, spans = set(), set()
+    """(metric_names, span_names, event_names, rule_names) registered
+    via string literals under mxnet_tpu/. Event names additionally
+    include the keys of blackbox.EVENTS (the registered universe — a
+    registered event with no call site yet must still be documented);
+    rule names are ``health.watch("...")`` first-arg literals."""
+    metrics, spans, events, rules = set(), set(), set(), set()
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in filenames:
@@ -54,6 +66,15 @@ def collect_code_names():
                 except SyntaxError as e:
                     raise SystemExit("cannot parse %s: %s" % (path, e))
             for node in ast.walk(tree):
+                if fn == "blackbox.py" and isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "EVENTS"
+                                for t in node.targets) \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            events.add(k.value)
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 arg0 = node.args[0]
@@ -65,7 +86,11 @@ def collect_code_names():
                     metrics.add(arg0.value)
                 elif name in _SPAN_CALLS and _SPAN_RE.match(arg0.value):
                     spans.add(arg0.value)
-    return metrics, spans
+                elif name in _EVENT_CALLS and _PLAIN_RE.match(arg0.value):
+                    events.add(arg0.value)
+                elif name in _RULE_CALLS and _PLAIN_RE.match(arg0.value):
+                    rules.add(arg0.value)
+    return metrics, spans, events, rules
 
 
 def collect_doc_names():
@@ -92,16 +117,49 @@ def collect_doc_names():
     return metrics, spans
 
 
+def collect_doc_marked(marker):
+    """Backticked first-cell tokens of the ONE table that follows the
+    ``<!-- marker -->`` comment in the doc (plain lowercase names
+    would false-positive against ordinary prose tables, so these two
+    tables are marker-delimited)."""
+    names = set()
+    in_table = armed = False
+    with open(DOC, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if ("<!-- %s -->" % marker) in line:
+                armed = True
+                continue
+            if not armed:
+                continue
+            if line.startswith("|"):
+                in_table = True
+                cells = line.split("|")
+                if len(cells) >= 2:
+                    for tok in re.findall(r"`([^`]+)`", cells[1]):
+                        if _PLAIN_RE.match(tok.strip()):
+                            names.add(tok.strip())
+            elif in_table:
+                break                    # table ended
+    return names
+
+
 def check():
-    """Returns a dict of the four possible drift directions; all empty
+    """Returns a dict of the possible drift directions; all empty
     means code and docs agree."""
-    code_m, code_s = collect_code_names()
+    code_m, code_s, code_e, code_r = collect_code_names()
     doc_m, doc_s = collect_doc_names()
+    doc_e = collect_doc_marked("flight-recorder-events")
+    doc_r = collect_doc_marked("slo-rules")
     return {
         "metrics_undocumented": sorted(code_m - doc_m),
         "metrics_stale_in_docs": sorted(doc_m - code_m),
         "spans_undocumented": sorted(code_s - doc_s),
         "spans_stale_in_docs": sorted(doc_s - code_s),
+        "flight_events_undocumented": sorted(code_e - doc_e),
+        "flight_events_stale_in_docs": sorted(doc_e - code_e),
+        "slo_rules_undocumented": sorted(code_r - doc_r),
+        "slo_rules_stale_in_docs": sorted(doc_r - code_r),
     }
 
 
@@ -115,14 +173,16 @@ def main():
             for n in names:
                 print("  - %s" % n)
     if not ok:
-        print("\ndocs/observability.md and the registered metric/span "
-              "name literals under mxnet_tpu/ are out of sync "
-              "(undocumented = add a table row; stale = the doc names "
-              "something the code no longer registers).")
+        print("\ndocs/observability.md and the registered metric/span/"
+              "flight-event/SLO-rule name literals under mxnet_tpu/ "
+              "are out of sync (undocumented = add a table row; stale "
+              "= the doc names something the code no longer "
+              "registers).")
         return 1
-    code_m, code_s = collect_code_names()
-    print("ok: %d metrics and %d spans in sync with "
-          "docs/observability.md" % (len(code_m), len(code_s)))
+    code_m, code_s, code_e, code_r = collect_code_names()
+    print("ok: %d metrics, %d spans, %d flight events, %d SLO rules "
+          "in sync with docs/observability.md"
+          % (len(code_m), len(code_s), len(code_e), len(code_r)))
     return 0
 
 
