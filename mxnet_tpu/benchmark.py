@@ -950,7 +950,211 @@ def train_resume(steps=27, period=8, batch=64):
             "num_checkpoints": len(ckpt_steps),
             "with_optimizer_states": True,
         }
+        # restore-to-first-step wall in a FRESH process, compile cache
+        # cold vs warm: the resumed trainer's fused-step build routes
+        # through programs.get_or_build, so with MXNET_COMPILE_CACHE_DIR
+        # populated the second restore loads the program from disk
+        try:
+            extra.update(_restore_first_step_pair(prefix, batch, tmpdir))
+        except Exception as e:
+            extra["restore_first_step_error"] = str(e)
         return mbps, extra
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+_RESTORE_STEP_DRIVER = r'''
+import json, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.checkpoint import load_latest_valid
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.models import mlp
+from mxnet_tpu.module import Module
+
+prefix, batch = sys.argv[1], int(sys.argv[2])
+t0 = time.time()
+state = load_latest_valid(prefix)
+mod = Module(mlp())
+mod.bind(data_shapes=[("data", (batch, 784))],
+         label_shapes=[("softmax_label", (batch,))])
+mod.init_params()
+mod.set_params(state.arg_params, state.aux_params, force_init=True)
+mod.init_optimizer(optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.05,
+                                     "momentum": 0.9})
+if state.states_fname:
+    mod.load_optimizer_states(state.states_fname)
+t1 = time.time()
+rng = np.random.RandomState(0)
+db = DataBatch(
+    data=[mx.nd.array(rng.randn(batch, 784).astype(np.float32))],
+    label=[mx.nd.array(rng.randint(0, 10, size=(batch,))
+                       .astype(np.float32))])
+mod.forward_backward(db)
+mod.update()
+mod.get_outputs()[0].asnumpy()           # step delivered D2H
+t2 = time.time()
+snap = tm.snapshot()
+print("RESTORE_STEP " + json.dumps({
+    "restore_ms": round((t1 - t0) * 1e3, 2),
+    "first_step_ms": round((t2 - t1) * 1e3, 2),
+    "compiles": snap["programs_compile_total"],
+    "disk_hits": snap["programs_disk_hits"]}), flush=True)
+'''
+
+
+def _run_driver(source, args, env_extra, marker, timeout=600):
+    """Run a bench driver script in a FRESH python process and parse
+    its ``marker``-prefixed JSON line."""
+    import subprocess
+    import tempfile
+    fd, script = tempfile.mkstemp(suffix=".py", prefix="mx_bench_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(source)
+        env = dict(os.environ)
+        env.update(env_extra)
+        # the driver lives in /tmp: python puts the SCRIPT's dir on
+        # sys.path, not the cwd, so the repo root must ride PYTHONPATH
+        env["PYTHONPATH"] = _ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        r = subprocess.run([sys.executable, script] + list(args),
+                           capture_output=True, text=True,
+                           timeout=timeout, cwd=_ROOT, env=env)
+        for line in reversed((r.stdout or "").splitlines()):
+            if line.startswith(marker + " "):
+                return json.loads(line[len(marker) + 1:])
+        raise RuntimeError(
+            "driver produced no %s line (rc %d): %s" % (
+                marker, r.returncode, (r.stderr or "")[-800:]))
+    finally:
+        try:
+            os.unlink(script)
+        except OSError:
+            pass
+
+
+def _restore_first_step_pair(prefix, batch, tmpdir):
+    """(cold, warm) restore-to-first-step walls: same driver, same
+    checkpoint, one shared compile-cache dir — run 1 populates it,
+    run 2 loads the fused-step program from disk."""
+    cache = os.path.join(tmpdir, "compile_cache")
+    env = {"MXNET_COMPILE_CACHE_DIR": cache, "MXNET_TELEMETRY": "1"}
+    cold = _run_driver(_RESTORE_STEP_DRIVER, [prefix, str(batch)], env,
+                       "RESTORE_STEP")
+    warm = _run_driver(_RESTORE_STEP_DRIVER, [prefix, str(batch)], env,
+                       "RESTORE_STEP")
+    total_c = cold["restore_ms"] + cold["first_step_ms"]
+    total_w = warm["restore_ms"] + warm["first_step_ms"]
+    return {
+        "restore_to_first_step_cold_ms": round(total_c, 2),
+        "restore_to_first_step_warm_ms": round(total_w, 2),
+        "restore_first_step_cold_ms": cold["first_step_ms"],
+        "restore_first_step_warm_ms": warm["first_step_ms"],
+        "restore_step_compiles_cold": cold["compiles"],
+        "restore_step_compiles_warm": warm["compiles"],
+        "restore_step_disk_hits_warm": warm["disk_hits"],
+        "restore_step_speedup": round(total_c / max(total_w, 1e-9), 3),
+    }
+
+
+_COLD_START_DRIVER = r'''
+import hashlib, json, sys, time
+t_imp0 = time.time()
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.serve import InferenceEngine, ServeConfig
+from mxnet_tpu.serving import Predictor
+t_imp1 = time.time()
+
+params_path, max_batch = sys.argv[1], int(sys.argv[2])
+data = mx.sym.Variable("data")
+h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+h = mx.sym.Activation(h, act_type="relu", name="relu1")
+h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+sym = mx.sym.softmax(h, name="prob")
+rng = np.random.RandomState(7)
+mx.nd.save(params_path, {
+    "arg:fc1_weight": mx.nd.array(
+        (rng.randn(64, 784) * 0.1).astype(np.float32)),
+    "arg:fc1_bias": mx.nd.array(np.zeros(64, np.float32)),
+    "arg:fc2_weight": mx.nd.array(
+        (rng.randn(10, 64) * 0.1).astype(np.float32)),
+    "arg:fc2_bias": mx.nd.array(np.zeros(10, np.float32))})
+with open(params_path, "rb") as f:
+    blob = f.read()
+t_build0 = time.time()
+pred = Predictor(sym.tojson(), blob, input_shapes={"data": (1, 784)})
+eng = InferenceEngine(pred, ServeConfig(max_batch=max_batch, workers=1))
+t_warm0 = time.time()
+eng.warmup()
+t_warm1 = time.time()
+# bitwise probe: one fixed input through every bucket program
+probe_rng = np.random.RandomState(11)
+h = hashlib.md5()
+for b in eng.config.buckets:
+    x = probe_rng.randn(b, 784).astype(np.float32)
+    outs = eng._bucket_pred(b)._exe.forward(is_train=False, data=x)
+    h.update(outs[0].asnumpy().tobytes())
+snap = tm.snapshot()
+print("COLD_START " + json.dumps({
+    "import_s": round(t_imp1 - t_imp0, 3),
+    "build_s": round(t_warm0 - t_build0, 3),
+    "warmup_s": round(t_warm1 - t_warm0, 3),
+    "buckets": len(eng.config.buckets),
+    "compiles": snap["programs_compile_total"],
+    "disk_hits": snap["programs_disk_hits"],
+    "compile_requests": snap["backend_compile_total"],
+    "probe_md5": h.hexdigest()}), flush=True)
+'''
+
+
+def cold_start(max_batch=128):
+    """Replica cold start, compile cache cold vs warm: two FRESH
+    processes each build + warm an 8-bucket MLP serve ladder against
+    one shared ``MXNET_COMPILE_CACHE_DIR``. The first compiles and
+    populates the cache + warm-set manifest; the second's warmup must
+    perform ZERO real backend compiles (everything
+    ``programs/disk_hits_total``) and serve bitwise-identical outputs —
+    the acceptance contract, telemetry-asserted here. Banks the
+    cold/warm warmup wall ratio."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix="mx_cold_start_")
+    try:
+        env = {"MXNET_COMPILE_CACHE_DIR": os.path.join(tmpdir, "cache"),
+               "MXNET_TELEMETRY": "1"}
+        args = [os.path.join(tmpdir, "m.params"), str(max_batch)]
+        cold = _run_driver(_COLD_START_DRIVER, args, env, "COLD_START")
+        warm = _run_driver(_COLD_START_DRIVER, args, env, "COLD_START")
+        if warm["compiles"] != 0:
+            raise RuntimeError(
+                "warm replica performed %d real backend compiles; "
+                "expected 0 (disk hits: %d)"
+                % (warm["compiles"], warm["disk_hits"]))
+        if warm["probe_md5"] != cold["probe_md5"]:
+            raise RuntimeError(
+                "warm replica outputs are not bitwise-identical to the "
+                "cold-compiled replica")
+        ratio = cold["warmup_s"] / max(warm["warmup_s"], 1e-9)
+        extra = {
+            "buckets": cold["buckets"],
+            "cold_warmup_s": cold["warmup_s"],
+            "warm_warmup_s": warm["warmup_s"],
+            "cold_compiles": cold["compiles"],
+            "warm_compiles": warm["compiles"],
+            "warm_disk_hits": warm["disk_hits"],
+            "cold_ready_s": round(cold["import_s"] + cold["build_s"]
+                                  + cold["warmup_s"], 3),
+            "warm_ready_s": round(warm["import_s"] + warm["build_s"]
+                                  + warm["warmup_s"], 3),
+            "probe_bitwise_identical": True,
+        }
+        return ratio, extra
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
@@ -1934,6 +2138,14 @@ def _job_train_resume():
                    host_metric=True)
 
 
+def _job_cold_start():
+    v, x = cold_start()
+    return persist("cold_start_speedup", v,
+                   "x (8-bucket MLP ladder warmup wall, compile cache "
+                   "cold vs warm across fresh processes; warm replica "
+                   "asserted 0 real compiles + bitwise outputs)", x)
+
+
 def _job_mlp_train_fused():
     v, x = train_mlp_module_fused()
     return persist("mlp_train_fused_img_per_sec", v,
@@ -2056,6 +2268,7 @@ JOBS = {
     "trace_overhead": _job_trace_overhead,
     "health_overhead": _job_health_overhead,
     "train_resume": _job_train_resume,
+    "cold_start": _job_cold_start,
     "dist_failover": _job_dist_failover,
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
@@ -2090,6 +2303,7 @@ JOB_PRIORITY = [
     "trace_overhead",
     "health_overhead",
     "train_resume",
+    "cold_start",
     "dist_failover",
     "predictor_serve",
     "quantized_serve",

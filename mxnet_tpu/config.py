@@ -308,6 +308,25 @@ VARS = {
                                 "Peak HBM bandwidth (GB/s) for the "
                                 "hbm_bw_util roofline gauges. "
                                 "Default: v5e."),
+    "MXNET_COMPILE_CACHE_DIR": (str, "",
+                                "Persistent compile cache directory "
+                                "(programs.py wires jax's "
+                                "jax_compilation_cache_dir underneath): "
+                                "compiled XLA executables are "
+                                "serialized here and a fresh process "
+                                "loads them from disk instead of "
+                                "recompiling — the sub-minute replica "
+                                "cold-start path. The registry also "
+                                "keeps <dir>/warmset.json, the warm-set "
+                                "manifest prewarm replays at startup. "
+                                "Empty disables. See "
+                                "docs/compile_cache.md."),
+    "MXNET_PROGRAMS_MAX": (int, 512,
+                           "Compiled-program registry bound "
+                           "(programs.get_or_build): past this many "
+                           "entries the least-recently-used is evicted "
+                           "(programs/evictions_total counts them). "
+                           "0 = unbounded."),
     "MXNET_FAULT_INJECT": (str, "",
                            "Arm fault-injection points at import: "
                            "point:step:kind[:count] comma list "

@@ -22,6 +22,7 @@ from .base import MXNetError, install_donation_warning_filter
 from .ndarray.ndarray import NDArray, zeros
 from .context import current_context
 from . import health as _health
+from . import programs as _pg
 from . import random as _random
 from . import telemetry as _tm
 from . import tracing as _tr
@@ -98,9 +99,17 @@ class Executor(object):
         self._needs_rng = any(
             (not n.is_var) and _reg.get_op(n.op).needs_rng
             for n in _topo(symbol._entries))
-        self._jitted = {}
-        self._vjp_jitted = {}
-        self._fused_jitted = {}
+        # graph fingerprint for the process-wide program registry
+        # (programs.py): executors bound to the same symbol at the same
+        # shapes SHARE one jitted program — a hot-swap replacement
+        # engine re-warms its ladder as cache hits, and with
+        # MXNET_COMPILE_CACHE_DIR set a fresh process loads it from disk
+        self._graph_hash = _pg.graph_hash(symbol)
+        self._jitted = {}               # memo over the registry (keys
+        self._vjp_jitted = {}           # re-fingerprint per entry; the
+        self._fused_jitted = {}         # registry owns the programs)
+        self._fwd_keys = {}             # is_train -> ProgramKey
+        self._rule_salts = {}           # closure rule -> instance salt
         # health-layer accounting: captured cost-analysis records per
         # program, grad-norm EMA for spike detection, and the previous
         # step-end stamp the throughput-MFU interval is measured from
@@ -135,6 +144,14 @@ class Executor(object):
         (data + labels)."""
         self._dp_mesh = mesh
         self._dp_batch_names = tuple(batch_arg_names)
+        # the mesh signature is part of every program fingerprint:
+        # drop the memos so programs built before the mesh was set
+        # can't be confused with their sharded successors (rebuilds
+        # are registry hits when an equivalent program already exists)
+        self._jitted.clear()
+        self._vjp_jitted.clear()
+        self._fused_jitted.clear()
+        self._fwd_keys.clear()
         # re-place already-bound buffers so the first forward starts from
         # consistently-committed arrays
         for n, arr in list(self.arg_dict.items()):
@@ -168,13 +185,43 @@ class Executor(object):
         return jax.device_put(data, sh)
 
     # -- compilation -------------------------------------------------------
+    def _buffer_sig(self):
+        """Abstract input spec of the bound buffers ([(name, shape,
+        dtype)] over args + aux) — the shape component of every program
+        fingerprint, so the same graph bound at two shapes registers
+        two distinct entries."""
+        sig = [[n, list(a.shape), str(a.dtype)]
+               for n, a in zip(self._arg_names, self.arg_arrays)]
+        sig += [[n, list(a.shape), str(a.dtype)]
+                for n, a in zip(self._aux_names, self.aux_arrays)]
+        return sig
+
+    def _mesh_sig(self):
+        """Sharding/mesh fingerprint component (None off-mesh)."""
+        if self._dp_mesh is None:
+            return None
+        return {"axes": {k: int(v) for k, v in self._dp_mesh.shape.items()},
+                "batch": sorted(self._dp_batch_names)}
+
     def _fwd(self, is_train):
-        if is_train not in self._jitted:
-            import jax
-            fn = _graph_eval_fn(self._symbol, is_train)
-            self._jitted[is_train] = jax.jit(fn)
-            _note_graph_compile()
-        return self._jitted[is_train]
+        is_train = bool(is_train)
+        j = self._jitted.get(is_train)
+        if j is None:
+            key = _pg.ProgramKey(
+                "executor_forward", self._graph_hash,
+                {"is_train": is_train, "args": self._buffer_sig(),
+                 "mesh": self._mesh_sig(), "rng": self._needs_rng})
+
+            def build():
+                import jax
+                fn = _graph_eval_fn(self._symbol, is_train)
+                _note_graph_compile()
+                return jax.jit(fn)
+
+            j = _pg.get_or_build(key, build)
+            self._jitted[is_train] = j
+            self._fwd_keys[is_train] = key
+        return j
 
     def _vjp(self, grad_names_key, add_names_key=()):
         """Jitted (arg_env, fixed_env, key, cotangents, accumulators) ->
@@ -183,27 +230,39 @@ class Executor(object):
         buffers summed INSIDE the program — no per-parameter host
         dispatch after it returns."""
         cache_key = (grad_names_key, add_names_key)
-        if cache_key not in self._vjp_jitted:
-            import jax
-            fn = _graph_eval_fn(self._symbol, True)
+        j = self._vjp_jitted.get(cache_key)
+        if j is None:
+            key = _pg.ProgramKey(
+                "executor_vjp", self._graph_hash,
+                {"grads": list(grad_names_key),
+                 "adds": list(add_names_key),
+                 "args": self._buffer_sig(), "mesh": self._mesh_sig(),
+                 "rng": self._needs_rng})
 
-            def run(genv, fenv, key, cts, acc):
-                def fwd(ge):
-                    env = dict(fenv)
-                    env.update(ge)
-                    outs, _aux = fn(env, key)
-                    return outs
+            def build():
+                import jax
+                fn = _graph_eval_fn(self._symbol, True)
 
-                _outs, vjp = jax.vjp(fwd, genv)
-                (gs,) = vjp(tuple(cts))
-                gs = dict(gs)
-                for n in add_names_key:
-                    gs[n] = acc[n] + gs[n]
-                return gs
+                def run(genv, fenv, key, cts, acc):
+                    def fwd(ge):
+                        env = dict(fenv)
+                        env.update(ge)
+                        outs, _aux = fn(env, key)
+                        return outs
 
-            self._vjp_jitted[cache_key] = jax.jit(run)
-            _note_graph_compile()
-        return self._vjp_jitted[cache_key]
+                    _outs, vjp = jax.vjp(fwd, genv)
+                    (gs,) = vjp(tuple(cts))
+                    gs = dict(gs)
+                    for n in add_names_key:
+                        gs[n] = acc[n] + gs[n]
+                    return gs
+
+                _note_graph_compile()
+                return jax.jit(run)
+
+            j = _pg.get_or_build(key, build)
+            self._vjp_jitted[cache_key] = j
+        return j
 
     # -- execution ---------------------------------------------------------
     def _env(self):
@@ -269,6 +328,9 @@ class Executor(object):
             self._fwd_cost[bool(is_train)] = _health.capture_cost(
                 "executor_forward", _health.next_cost_key("fwd"),
                 fwd, (env, key))
+            pkey = self._fwd_keys.get(bool(is_train))
+            if pkey is not None:
+                _pg.attach_cost(pkey, self._fwd_cost[bool(is_train)])
         self._last_key = key
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
@@ -470,27 +532,69 @@ class Executor(object):
         run = self._fused_jitted.get(cache_key)
         if run is None:
             install_donation_warning_filter()
-            run = self._build_fused_step(rule, update_names,
-                                         out_grads is None, donate,
-                                         numerics)
+            # process-wide registry entry: a resumed trainer (or a
+            # second Module over the same graph/optimizer) shares the
+            # program, and MXNET_COMPILE_CACHE_DIR makes the build a
+            # persistent-cache disk load in a fresh process. A rule
+            # that is a closure gets an instance salt — baked-in cell
+            # contents have no stable cross-object identity
+            rule_id = "%s.%s" % (getattr(rule, "__module__", "?"),
+                                 getattr(rule, "__qualname__",
+                                         type(rule).__name__))
+            instance = None
+            if getattr(rule, "__closure__", None) is not None:
+                # one STABLE salt per (executor, rule object): a rebuild
+                # after set_dp_mesh must re-hit the same registry entry
+                # instead of pinning a duplicate donated program
+                instance = self._rule_salts.get(rule)
+                if instance is None:
+                    instance = self._rule_salts[rule] = \
+                        _pg.next_instance("rule")
+            pkey = _pg.ProgramKey(
+                "fused_step", self._graph_hash,
+                {"rule": rule_id, "update": list(update_names),
+                 "default_ct": out_grads is None, "donate": donate,
+                 "numerics": numerics, "args": self._buffer_sig(),
+                 "mesh": self._mesh_sig(), "rng": self._needs_rng},
+                instance=instance)
+            built = []
+
+            def build():
+                built.append(True)
+                if _tm._enabled:
+                    _tm._ensure_compile_listener()
+                    _tm.counter("executor/fused_step_compile_total",
+                                "Fused train-step program builds "
+                                "(fwd+bwd+update traced as one program)"
+                                ).inc()
+                return self._build_fused_step(
+                    rule, update_names, out_grads is None, donate,
+                    numerics)
+
+            run = _pg.get_or_build(pkey, build)
             self._fused_jitted[cache_key] = run
             # roofline capture at compile time (HLO cost pass, NOT a
             # second backend compile; its pseudo-compile events are
             # suppressed from the telemetry counters)
-            self._fused_costs[cache_key] = _health.capture_cost(
-                "fused_step", _health.next_cost_key("step"),
-                run, tuple(args))
+            self._fused_costs[cache_key] = _pg.attach_cost(
+                pkey, _health.capture_cost(
+                    "fused_step", _health.next_cost_key("step"),
+                    run, tuple(args)))
             # the interval ending here includes trace+lower+compile:
             # never let it pollute the throughput-MFU gauge
             self._last_step_end = None
             if _tm._enabled:
-                _tm._ensure_compile_listener()
-                _tm.counter("executor/fused_step_compile_total",
-                            "Fused train-step program builds "
-                            "(fwd+bwd+update traced as one program)").inc()
-                _tm.counter("executor/fused_step_cache_miss_total",
-                            "Fused train-step calls that built a new "
-                            "program").inc()
+                if built:
+                    _tm.counter("executor/fused_step_cache_miss_total",
+                                "Fused train-step calls that built a "
+                                "new program").inc()
+                else:
+                    # local memo miss served by the process-wide
+                    # registry: still a cache hit — hits + misses must
+                    # account for every train_step program lookup
+                    _tm.counter("executor/fused_step_cache_hit_total",
+                                "Fused train-step calls served from "
+                                "the program cache").inc()
         elif _tm._enabled:
             _tm.counter("executor/fused_step_cache_hit_total",
                         "Fused train-step calls served from the program "

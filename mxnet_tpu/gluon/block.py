@@ -321,13 +321,37 @@ class CachedOp(object):
         An abstract discovery pass (jax.eval_shape — zero FLOPs) fixes the
         output arity and the order of aux-state writes before the real jit
         trace, so the registered op has a static signature."""
-        import jax
-        from .. import autograd
-        from ..ops import registry as _reg
-
         mode_key = bool(train_mode)
         if mode_key in self._modes:
             return self._modes[mode_key]
+        # the mode build goes through the process-wide compiled-program
+        # registry (programs.py) for uniform build accounting.
+        # Instance-salted: the pure function captures THIS block's
+        # parameter identities (aux writes key on id(p)), so the built
+        # op must never be shared across block instances — and
+        # retain=False, because an instance-salted entry can never be
+        # a cache hit (self._modes is checked first) and would only
+        # consume MXNET_PROGRAMS_MAX slots that genuinely shared
+        # executor/serve programs need.
+        from .. import programs as _pg
+        pkey = _pg.ProgramKey(
+            "cachedop",
+            _pg.graph_hash({"block": type(self._block).__qualname__}),
+            {"mode": "train" if mode_key else "predict",
+             "params": [[list(v.shape), str(v.dtype)]
+                        for v in param_vals],
+             "inputs": [[list(v.shape), str(v.dtype)]
+                        for v in input_vals]},
+            instance="cachedop:%d" % self._uid)
+        return _pg.get_or_build(
+            pkey, lambda: self._build_mode(mode_key, params, param_vals,
+                                           input_vals),
+            retain=False)
+
+    def _build_mode(self, mode_key, params, param_vals, input_vals):
+        import jax
+        from .. import autograd
+        from ..ops import registry as _reg
 
         block = self._block
         n_params = len(params)

@@ -203,6 +203,16 @@ class BaseModule(object):
         """
         assert num_epoch is not None, "please specify number of epochs"
 
+        if checkpoint_prefix is not None or resume:
+            # a checkpointing (hence restartable) run wires the
+            # persistent compile cache up front: the resumed process's
+            # fused-step build — routed through programs.get_or_build —
+            # loads from disk instead of recompiling, so
+            # restore-to-first-step is dominated by the restore, not
+            # XLA (the train_resume bench banks both walls)
+            from .. import programs as _pg
+            _pg.ensure_persistent_cache()
+
         resume_state = None
         skip_nbatch = 0
         io_seeked = False

@@ -169,11 +169,18 @@ def collect_activation_ranges(symbol, arg_params, aux_params, calib_data,
     observer per tensor. Stops once ``num_calib_examples`` rows were
     seen (None = the whole iterable).
     """
+    from .. import programs as _pg
     factory = make_observer(observer)
     internals = symbol.get_internals()
     data_names = list(data_names)
     observers = {}
     exe_cache = {}
+    # calibration executors route through the compiled-program registry
+    # for uniform accounting/eviction, instance-salted per collect call:
+    # the bound executor holds THIS model's written weights and must
+    # never be shared with another calibration run's
+    instance = _pg.next_instance("calib")
+    graph = _pg.graph_hash(internals)
     seen = 0
     if hasattr(calib_data, "reset"):
         calib_data.reset()
@@ -188,18 +195,31 @@ def collect_activation_ranges(symbol, arg_params, aux_params, calib_data,
         key = tuple(sorted(shapes.items()))
         exe = exe_cache.get(key)
         if exe is None:
-            exe = internals.simple_bind(grad_req="null", **shapes)
-            for k, v in (arg_params or {}).items():
-                if k in exe.arg_dict:
-                    exe.arg_dict[k][:] = v
-            for k, v in (aux_params or {}).items():
-                if k in exe.aux_dict:
-                    exe.aux_dict[k][:] = v
+            def bind():
+                exe = internals.simple_bind(grad_req="null", **shapes)
+                for k, v in (arg_params or {}).items():
+                    if k in exe.arg_dict:
+                        exe.arg_dict[k][:] = v
+                for k, v in (aux_params or {}).items():
+                    if k in exe.aux_dict:
+                        exe.aux_dict[k][:] = v
+                if _tm._enabled:
+                    _tm.counter("quantize/calib_binds_total",
+                                "Calibration internals executors bound "
+                                "(one per distinct batch shape)").inc()
+                return exe
+
+            # retain=False: the bound executor holds this model's
+            # written weights on device — exe_cache (this call) must
+            # stay its only owner, or back-to-back calibrations of
+            # large models would pin each other's buffers in the
+            # process-wide registry
+            exe = _pg.get_or_build(
+                _pg.ProgramKey(
+                    "calib_executor", graph,
+                    {"shapes": {n: list(s) for n, s in shapes.items()}},
+                    instance=instance), bind, retain=False)
             exe_cache[key] = exe
-            if _tm._enabled:
-                _tm.counter("quantize/calib_binds_total",
-                            "Calibration internals executors bound (one "
-                            "per distinct batch shape)").inc()
         for n, d in zip(data_names, data_list):
             exe.arg_dict[n][:] = d
         outs = exe.forward(is_train=False)

@@ -54,6 +54,7 @@ import numpy as _np
 
 from .. import fault as _fault
 from .. import health as _health
+from .. import programs as _pg
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from ..base import MXNetError
@@ -244,6 +245,16 @@ class DecodeEngine(object):
         self._prefill_progs = {}
         self._step_progs = {}
         self._prog_costs = {}            # (phase, bucket) -> rec | None
+        # graph fingerprint for the compiled-program registry: the
+        # model architecture + parameter layout + page size determine
+        # the prefill/step programs (weights are traced arguments)
+        import jax as _jax
+        psig = [[list(l.shape), str(l.dtype)]
+                for l in _jax.tree_util.tree_leaves(params)]
+        self._graph_hash = _pg.graph_hash(
+            {"model": repr(model_cfg), "params": psig,
+             "page_size": int(self._cfg.page_size)})
+        self._warm_report = None
         self._cond = threading.Condition()
         self._waiting = deque()
         self._live = []
@@ -323,49 +334,67 @@ class DecodeEngine(object):
         return self
 
     def _do_warmup(self):
-        """Compile + execute every bucket program (scheduler thread).
+        """Compile + execute every bucket program (scheduler thread),
+        routed through :func:`programs.prewarm` — the configured
+        buckets plus any warm-set manifest entries for this model
+        replay here, loading from the persistent compile cache when
+        ``MXNET_COMPILE_CACHE_DIR`` is set.
 
-        Two passes: the first pass's earliest call sees the freshly
-        created page-pool arrays, whose sharding provenance can key a
-        DIFFERENT executable than pjit outputs do — and pjit outputs
-        (each program donates and returns the pool) are the only
-        provenance steady-state traffic ever presents. The second pass
-        runs every program against pjit-provenance pools, so any such
-        re-specialization compiles here, not on the first request."""
-        for pass_i in range(2):
-            for b in self._cfg.prefill_buckets:
-                n_pb = b // self._cfg.page_size
-                pargs = (self._params, self._k_pages, self._v_pages,
-                         _np.zeros(n_pb, _np.int32),
-                         _np.zeros((1, b), _np.int32),
-                         _np.array([b], _np.int32))
-                if pass_i == 0:
-                    # roofline capture BEFORE executing: the pools are
-                    # donated by the call, so only the pre-call arrays
-                    # are certain to be live for the HLO cost pass
-                    self._prog_costs[("prefill", b)] = \
-                        _health.capture_cost(
-                            "decode_prefill",
-                            _health.next_cost_key("dec"),
-                            self._prefill_prog(b), pargs)
-                tok0, self._k_pages, self._v_pages = \
-                    self._prefill_prog(b)(*pargs)
-                int(tok0)                # block: compile + execute done
-            for nslots in self._cfg.slot_buckets:
-                sargs = (self._params, self._k_pages, self._v_pages,
-                         _np.zeros((nslots, self._cfg.pages_per_seq),
-                                   _np.int32),
-                         _np.zeros(nslots, _np.int32),
-                         _np.zeros(nslots, _np.int32))
-                if pass_i == 0:
-                    self._prog_costs[("step", nslots)] = \
-                        _health.capture_cost(
-                            "decode_step",
-                            _health.next_cost_key("dec"),
-                            self._step_prog(nslots), sargs)
-                toks, self._k_pages, self._v_pages = \
-                    self._step_prog(nslots)(*sargs)
-                _np.asarray(toks)
+        Each program is warmed with :func:`programs.warm_twice`: these
+        are DONATED loops (every call donates and returns the page
+        pools), so pjit keeps one executable per input-sharding
+        provenance and each program must also run against
+        pjit-provenance pools — the only provenance steady-state
+        traffic ever presents — so any re-specialization compiles
+        here, not on the first request."""
+        include = ([("decode_prefill", {"bucket": int(b)})
+                    for b in self._cfg.prefill_buckets]
+                   + [("decode_step", {"slots": int(n)})
+                      for n in self._cfg.slot_buckets])
+        self._warm_report = _pg.prewarm(
+            sites={"decode_prefill": self._warm_prefill_spec,
+                   "decode_step": self._warm_step_spec},
+            include=include, graph=self._graph_hash)
+
+    def _warm_prefill_spec(self, spec):
+        bucket = int(spec.get("bucket", 0))
+        if bucket not in self._cfg.prefill_buckets:
+            return False
+        n_pb = bucket // self._cfg.page_size
+        pargs = (self._params, self._k_pages, self._v_pages,
+                 _np.zeros(n_pb, _np.int32),
+                 _np.zeros((1, bucket), _np.int32),
+                 _np.array([bucket], _np.int32))
+        prog = self._prefill_prog(bucket)
+        if ("prefill", bucket) not in self._prog_costs:
+            # roofline capture BEFORE executing: the pools are donated
+            # by the call, so only the pre-call arrays are certain to
+            # be live for the HLO cost pass
+            self._prog_costs[("prefill", bucket)] = _health.capture_cost(
+                "decode_prefill", _health.next_cost_key("dec"),
+                prog, pargs)
+        tok0, self._k_pages, self._v_pages = _pg.warm_twice(
+            prog, pargs,
+            rebuild=lambda out, a: (a[0], out[1], out[2]) + a[3:])
+        int(tok0)                        # block: compile + execute done
+
+    def _warm_step_spec(self, spec):
+        nslots = int(spec.get("slots", 0))
+        if nslots not in self._cfg.slot_buckets:
+            return False
+        sargs = (self._params, self._k_pages, self._v_pages,
+                 _np.zeros((nslots, self._cfg.pages_per_seq), _np.int32),
+                 _np.zeros(nslots, _np.int32),
+                 _np.zeros(nslots, _np.int32))
+        prog = self._step_prog(nslots)
+        if ("step", nslots) not in self._prog_costs:
+            self._prog_costs[("step", nslots)] = _health.capture_cost(
+                "decode_step", _health.next_cost_key("dec"),
+                prog, sargs)
+        toks, self._k_pages, self._v_pages = _pg.warm_twice(
+            prog, sargs,
+            rebuild=lambda out, a: (a[0], out[1], out[2]) + a[3:])
+        _np.asarray(toks)
 
     @property
     def ready(self):
@@ -382,6 +411,12 @@ class DecodeEngine(object):
         """Compiled decode-path programs held (the compile-cache bound:
         <= len(prefill_buckets) + len(slot_buckets))."""
         return len(self._prefill_progs) + len(self._step_progs)
+
+    @property
+    def warm_report(self):
+        """The last warmup's prewarm report (replayed/compile/disk-hit
+        counts and wall), or None before the first warmup."""
+        return self._warm_report
 
     def pause(self, drain=True, timeout=30.0):
         """Stop admission; with ``drain`` wait for every live and
@@ -792,44 +827,64 @@ class DecodeEngine(object):
                 self._emit_locked(sess, int(toks[i]))
 
     # -- compiled programs -------------------------------------------------
+    # both builders route through the process-wide compiled-program
+    # registry: engines over the same architecture/page layout share
+    # one program per bucket (weights are traced arguments), and the
+    # registry's warm-set entry + persistent cache make a fresh
+    # replica's warmup a disk load
+
     def _prefill_prog(self, bucket):
         prog = self._prefill_progs.get(bucket)
         if prog is None:
-            import jax
-            import jax.numpy as jnp
-            from ..parallel.transformer import (PagedKVCache,
-                                                transformer_prefill_paged)
-            cfg, ps = self._model_cfg, self._cfg.page_size
+            def build():
+                import jax
+                import jax.numpy as jnp
+                from ..parallel.transformer import (
+                    PagedKVCache, transformer_prefill_paged)
+                cfg, ps = self._model_cfg, self._cfg.page_size
 
-            @functools.partial(jax.jit, donate_argnums=(1, 2))
-            def prog(params, k_pages, v_pages, page_ids, tokens, length):
-                paged = PagedKVCache(k_pages, v_pages, page_ids[None],
-                                     ps)
-                logits, paged = transformer_prefill_paged(
-                    params, paged, tokens, length, cfg)
-                return (jnp.argmax(logits, -1).astype(jnp.int32)[0],
-                        paged.k_pages, paged.v_pages)
+                @functools.partial(jax.jit, donate_argnums=(1, 2))
+                def prog(params, k_pages, v_pages, page_ids, tokens,
+                         length):
+                    paged = PagedKVCache(k_pages, v_pages,
+                                         page_ids[None], ps)
+                    logits, paged = transformer_prefill_paged(
+                        params, paged, tokens, length, cfg)
+                    return (jnp.argmax(logits, -1).astype(jnp.int32)[0],
+                            paged.k_pages, paged.v_pages)
 
+                return prog
+
+            prog = _pg.get_or_build(
+                _pg.ProgramKey("decode_prefill", self._graph_hash,
+                               {"bucket": int(bucket)}), build)
             self._prefill_progs[bucket] = prog
         return prog
 
     def _step_prog(self, nslots):
         prog = self._step_progs.get(nslots)
         if prog is None:
-            import jax
-            import jax.numpy as jnp
-            from ..parallel.transformer import (PagedKVCache,
-                                                transformer_decode_step)
-            cfg, ps = self._model_cfg, self._cfg.page_size
+            def build():
+                import jax
+                import jax.numpy as jnp
+                from ..parallel.transformer import (
+                    PagedKVCache, transformer_decode_step)
+                cfg, ps = self._model_cfg, self._cfg.page_size
 
-            @functools.partial(jax.jit, donate_argnums=(1, 2))
-            def prog(params, k_pages, v_pages, block_tables, tokens,
-                     pos):
-                paged = PagedKVCache(k_pages, v_pages, block_tables, ps)
-                logits, paged = transformer_decode_step(
-                    params, paged, tokens, pos, cfg)
-                return (jnp.argmax(logits, -1).astype(jnp.int32),
-                        paged.k_pages, paged.v_pages)
+                @functools.partial(jax.jit, donate_argnums=(1, 2))
+                def prog(params, k_pages, v_pages, block_tables, tokens,
+                         pos):
+                    paged = PagedKVCache(k_pages, v_pages, block_tables,
+                                         ps)
+                    logits, paged = transformer_decode_step(
+                        params, paged, tokens, pos, cfg)
+                    return (jnp.argmax(logits, -1).astype(jnp.int32),
+                            paged.k_pages, paged.v_pages)
 
+                return prog
+
+            prog = _pg.get_or_build(
+                _pg.ProgramKey("decode_step", self._graph_hash,
+                               {"slots": int(nslots)}), build)
             self._step_progs[nslots] = prog
         return prog

@@ -41,6 +41,7 @@ import numpy as _np
 from .. import fault as _fault
 from ..base import MXNetError
 from .. import health as _health
+from .. import programs as _pg
 from .. import telemetry as _tm
 from .. import tracing as _tr
 from .batching import parse_buckets, pick_bucket, validate_buckets
@@ -223,6 +224,12 @@ class InferenceEngine(object):
         self._pred_locks = {}            # bucket -> forward lock
         self._bucket_cost = {}           # bucket -> cost record | None
         self._cost_tag = None            # unique registry tag, lazy
+        # graph fingerprint for the compiled-program registry: engines
+        # over the same symbol share bucket programs in-process (a
+        # hot-swap replacement warms as cache hits) and identify their
+        # warm-set manifest entries across processes
+        self._graph_hash = _pg.graph_hash(predictor._sym)
+        self._warm_report = None
         self._build_lock = threading.Lock()
         self._queue = deque()
         self._cond = threading.Condition()
@@ -274,23 +281,68 @@ class InferenceEngine(object):
                 self._workers.append(t)
         return self
 
-    def warmup(self):
+    def warmup(self, use_manifest=True):
         """Ahead-of-time compile every bucket's forward program (zeros
         feed, fetched to host so compile + first execute both finish).
         The server must not report healthy before this returns: after
-        it, steady-state traffic never triggers an XLA compile."""
-        for b in self._cfg.buckets:
-            feed = {k: _np.zeros((b,) + self._feature[k],
-                                 dtype=self._dtypes[k])
-                    for k in self._input_names}
-            pred = self._bucket_pred(b)
-            with self._pred_locks[b]:
-                outs = pred._exe.forward(is_train=False, **feed)
-                for o in outs:
-                    o.asnumpy()
-            self._note_bucket_cost(b, pred)
+        it, steady-state traffic never triggers an XLA compile.
+
+        Routes through :func:`programs.prewarm`: the configured ladder
+        plus any warm-set manifest entries for this graph replay here —
+        with ``MXNET_COMPILE_CACHE_DIR`` set, a fresh replica loads
+        every program from the persistent cache on disk instead of
+        running XLA (``programs/disk_hits_total`` vs
+        ``programs/compile_total`` tells them apart; the report lands
+        in :attr:`warm_report`)."""
+        include = [("serve_bucket", self._bucket_spec(b))
+                   for b in self._cfg.buckets]
+        self._warm_report = _pg.prewarm(
+            sites={"serve_bucket": self._warm_bucket_spec},
+            include=include, graph=self._graph_hash,
+            use_manifest=use_manifest)
         self._ready = True
         return self
+
+    @property
+    def warm_report(self):
+        """The last :meth:`warmup`'s prewarm report (replayed/compile/
+        disk-hit counts and wall), or None before the first warmup."""
+        return self._warm_report
+
+    def _bucket_spec(self, bucket):
+        """Abstract input spec of one bucket program — what the
+        warm-set manifest stores so a future replica can replay the
+        trace without a request's worth of knowledge."""
+        return {"bucket": int(bucket),
+                "inputs": {k: [[int(bucket)] + list(self._feature[k]),
+                               str(_np.dtype(self._dtypes[k]))]
+                           for k in self._input_names}}
+
+    def _warm_bucket_spec(self, spec):
+        """Prewarm replay callable: compile + execute one bucket from
+        its abstract spec. Manifest entries that don't fit THIS engine
+        (a bucket outside the configured ladder, or a same-symbol model
+        bound at other feature shapes) are ignored — pick_bucket would
+        never route traffic to them."""
+        b = int(spec.get("bucket", 0))
+        if b not in self._cfg.buckets:
+            return False
+        for k, ent in (spec.get("inputs") or {}).items():
+            if k not in self._feature:
+                return False
+            if tuple(ent[0][1:]) != self._feature[k]:
+                return False
+        feed = {k: _np.zeros((b,) + self._feature[k],
+                             dtype=self._dtypes[k])
+                for k in self._input_names}
+        pred = self._bucket_pred(b)
+        with self._pred_locks[b]:
+            outs = pred._exe.forward(is_train=False, **feed)
+            for o in outs:
+                o.asnumpy()
+        self._note_bucket_cost(b, pred)
+        _pg.note_warm("serve_bucket", self._graph_hash,
+                      self._bucket_spec(b))
 
     def _note_bucket_cost(self, bucket, pred):
         """Alias the bucket forward's cost-analysis capture (taken by

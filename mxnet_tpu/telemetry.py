@@ -439,7 +439,7 @@ def reset():
     isolation) so snapshot() and the rendered families stay in
     agreement. Instrument handles cached by hot paths are re-resolved
     on next use."""
-    global _compile_count, _compile_time
+    global _compile_count, _compile_time, _disk_hits
     REGISTRY.reset()
     _op_cache.clear()
     _kv_cache.clear()
@@ -447,6 +447,7 @@ def reset():
     with _compile_lock:
         _compile_count = 0
         _compile_time = 0.0
+        _disk_hits = 0
 
 
 # ---------------------------------------------------------------------------
@@ -483,9 +484,24 @@ def bridge_to_profiler(names=("hbm/bytes_in_use", "hbm/peak_bytes",
 
 _compile_count = 0          # bumped by the jax.monitoring listener
 _compile_time = 0.0
+_disk_hits = 0              # compile requests served from the persistent
+                            # compilation cache on disk (programs.py)
 _compile_lock = threading.Lock()    # compiles fire on whichever thread
 _listener_on = False
 _listener_lock = threading.Lock()
+
+# persistent-cache attribution: jax fires the plain
+# /jax/compilation_cache/cache_hits event INSIDE compile_or_get_cached,
+# before the wrapping backend_compile_duration event is recorded at
+# context exit — both on the compiling thread. A thread-local flag set
+# by the plain event and consumed by the duration event pairs them, so
+# the compile-vs-disk-hit split never cross-counts between threads.
+_tls_hit = threading.local()
+
+# per-thread cumulative (compile_requests, disk_hits): lets
+# programs.get_or_build attribute exactly ITS build's compiles even
+# while another thread compiles something unrelated
+_tls_counts = threading.local()
 
 # health.capture_cost runs XLA's HLO cost pass, which emits pseudo
 # compile events of its own; counting those would poison every
@@ -517,18 +533,44 @@ def _on_jax_event(name, secs, **_kw):
     if name.endswith("backend_compile_duration"):
         if getattr(_suppress, "on", 0):
             return
-        global _compile_count, _compile_time
+        # with the persistent compile cache on, this event fires for
+        # BOTH a real backend compile and a disk load (jax wraps
+        # compile_or_get_cached) — which is exactly the honest "a trace
+        # reached the compiler" signal the zero-recompile assertions
+        # bank. The disk-hit flag (set by the plain cache_hits event
+        # just before, same thread) splits the two for the
+        # programs/compile_total vs programs/disk_hits_total counters.
+        disk_hit = getattr(_tls_hit, "on", False)
+        _tls_hit.on = False
+        global _compile_count, _compile_time, _disk_hits
         with _compile_lock:
             _compile_count += 1
             _compile_time += secs
+            if disk_hit:
+                _disk_hits += 1
+        _tls_counts.compiles = getattr(_tls_counts, "compiles", 0) + 1
+        if disk_hit:
+            _tls_counts.disk = getattr(_tls_counts, "disk", 0) + 1
         counter("jit/backend_compile_total",
-                "XLA backend compiles (all layers)").inc()
+                "XLA compile requests, all layers (real backend "
+                "compiles AND persistent-cache disk loads: every "
+                "trace that reached the compiler)").inc()
+        if disk_hit:
+            counter("programs/disk_hits_total",
+                    "Compile requests served from the persistent "
+                    "compilation cache on disk "
+                    "(MXNET_COMPILE_CACHE_DIR)").inc()
+        else:
+            counter("programs/compile_total",
+                    "Real XLA backend compiles (persistent-cache "
+                    "misses + uncached compiles)").inc()
         try:
             # every backend compile is a lifecycle event: a mid-traffic
             # recompile found in a post-mortem ring names the regression
             from . import blackbox as _bb
             if _bb._enabled:
-                _bb.record_event("compile", seconds=round(secs, 4))
+                _bb.record_event("compile", seconds=round(secs, 4),
+                                 disk_hit=disk_hit)
         except Exception:
             pass
         hist = histogram("jit/backend_compile_seconds",
@@ -550,11 +592,21 @@ def _on_jax_event(name, secs, **_kw):
         hist.observe(secs)
 
 
+def _on_jax_plain_event(name, **_kw):
+    """Plain (non-duration) jax.monitoring events: a persistent-cache
+    disk hit announces itself here before the wrapping
+    backend_compile_duration event lands on the same thread."""
+    if name.endswith("compilation_cache/cache_hits"):
+        if getattr(_suppress, "on", 0):
+            return
+        _tls_hit.on = True
+
+
 _listener_dead = False      # jax.monitoring unavailable: stop retrying
 
 
 def _ensure_compile_listener():
-    """Install the jax.monitoring compile listener once. A failed
+    """Install the jax.monitoring compile listeners once. A failed
     import is cached (this sits behind the hot dispatch path — it must
     not retry the import machinery per op)."""
     global _listener_on, _listener_dead
@@ -573,6 +625,10 @@ def _ensure_compile_listener():
             _listener_dead = True
             return False
         _jm.register_event_duration_secs_listener(_on_jax_event)
+        try:
+            _jm.register_event_listener(_on_jax_plain_event)
+        except Exception:
+            pass                 # no plain-event feed: no disk-hit split
         _listener_on = True
     return True
 
@@ -583,6 +639,25 @@ def compile_count():
 
 def compile_time():
     return _compile_time
+
+
+def disk_hit_count():
+    """Compile requests served from the persistent compilation cache
+    on disk (a subset of :func:`compile_count`)."""
+    return _disk_hits
+
+
+def thread_compile_stats():
+    """(compile_requests, disk_hits) observed on THIS thread — the
+    attribution programs.get_or_build brackets a build with, immune to
+    concurrent compiles on other threads. With MXNET_TELEMETRY=0 the
+    listener is never installed from here (the off switch must keep
+    every jit site quiet even though they all route through
+    programs.get_or_build) and the stats stay (0, 0)."""
+    if _enabled and not _listener_on:
+        _ensure_compile_listener()
+    return (getattr(_tls_counts, "compiles", 0),
+            getattr(_tls_counts, "disk", 0))
 
 
 # ---------------------------------------------------------------------------
@@ -766,6 +841,20 @@ def snapshot():
            "jit_cache_misses": _val("jit/cache_misses_total"),
            "backend_compile_total": _compile_count,
            "backend_compile_seconds": round(_compile_time, 3),
+           # compiled-program registry accounting (programs.py): real
+           # backend compiles vs persistent-cache disk loads (their sum
+           # is backend_compile_total once the cache is on), registry
+           # volume/evictions, and warm-set replay — the cold-start
+           # evidence banked with cold_start bench records
+           "programs_compile_total": _val("programs/compile_total"),
+           "programs_disk_hits": _val("programs/disk_hits_total"),
+           "programs_registered": _val("programs/registered_total"),
+           "programs_registry_hits": _val("programs/registry_hits_total"),
+           "programs_evictions": _val("programs/evictions_total"),
+           "programs_prewarm_replayed":
+               _val("programs/prewarm_replayed_total"),
+           "programs_prewarm_skipped":
+               _val("programs/prewarm_skipped_total"),
            # fused train-step accounting (executor.train_step): steps
            # run, program builds, and python-cache hit/miss — the
            # O(1)-dispatch-per-step evidence banked with bench records
@@ -902,6 +991,16 @@ def diagnostics(as_dict=False):
         ci = _reg._jitted.cache_info()
         info["eager_jit_cache"] = {"entries": ci.currsize, "hits": ci.hits,
                                    "misses": ci.misses}
+    except Exception:
+        pass
+    try:
+        # compiled-program registry: how many programs this process
+        # holds, what building them cost, and whether a persistent
+        # cache dir is wired (the cold-start posture of this replica)
+        from . import programs as _pg
+        st = _pg.stats()
+        if st["entries"] or st["cache_dir"]:
+            info["program_registry"] = st
     except Exception:
         pass
     from . import profiler
