@@ -1274,6 +1274,240 @@ def dist_failover(rounds=3):
                 os.environ[k] = v
 
 
+_DIST_TRAIN_WORKER = r'''
+"""dist_train_sync bench worker: one rank of a 2-process MLP probe.
+mode "fused"  = dist_tpu_sync, gradient all-reduce in-program (gloo);
+mode "socket" = dist_sync through the socket parameter server."""
+import json, os, sys, time
+import numpy as np
+mode, rank = sys.argv[1], int(sys.argv[2])
+steps, batch, dim = int(sys.argv[3]), int(sys.argv[4]), int(sys.argv[5])
+if mode == "fused":
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    os.environ["MXNET_DIST_COORDINATOR"] = os.environ["COORD"]
+    os.environ["MXNET_DIST_NUM_PROCESSES"] = "2"
+    os.environ["MXNET_DIST_PROCESS_ID"] = str(rank)
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.module import Module
+
+if mode == "fused":
+    from mxnet_tpu import dist_runtime
+    dist_runtime.acquire()
+
+net = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(net, name="fc1", num_hidden=256)
+net = mx.sym.Activation(net, name="relu1", act_type="relu")
+net = mx.sym.FullyConnected(net, name="fc2", num_hidden=128)
+net = mx.sym.Activation(net, name="relu2", act_type="relu")
+net = mx.sym.FullyConnected(net, name="fcout", num_hidden=10)
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+rng = np.random.RandomState(7)
+batches = [mx.io.DataBatch(
+    data=[mx.nd.array(rng.randn(batch, dim).astype(np.float32))],
+    label=[mx.nd.array(rng.randint(0, 10, batch).astype(np.float32))])
+    for _ in range(4)]
+
+mod = Module(net, context=mx.cpu())
+mod.bind(data_shapes=[("data", (batch, dim))],
+         label_shapes=[("softmax_label", (batch,))])
+mod.init_params()
+prng = np.random.RandomState(5)
+args = {n: mx.nd.array(prng.randn(*a.shape).astype(np.float32) * 0.1)
+        for n, a in sorted(mod._exec.arg_dict.items())
+        if n not in ("data", "softmax_label")}
+mod.set_params(args, {}, allow_missing=True, force_init=True)
+mod.init_optimizer(
+    kvstore="dist_tpu_sync" if mode == "fused" else "dist_sync",
+    optimizer="sgd",
+    optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+assert mod._fused_step_ok() == (mode == "fused"), mode
+
+
+def run(n):
+    for i in range(n):
+        db = batches[i % len(batches)]
+        mod.forward_backward(db)
+        mod.update()
+    # sync: block on a param so the timed window covers real work
+    mod._exec.arg_dict["fc1_weight"].asnumpy()
+
+
+run(3)                                   # warmup (provenance respecialize)
+s0, r0 = tm.snapshot(), tm.REGISTRY.snapshot()
+t0 = time.perf_counter()
+run(steps)
+wall = time.perf_counter() - t0
+s1, r1 = tm.snapshot(), tm.REGISTRY.snapshot()
+
+
+def dv(reg_a, reg_b, key):
+    return reg_b.get(key, 0) - reg_a.get(key, 0)
+
+
+sock_bytes = sum(dv(r0, r1, "kvstore/bytes_total{op=%s}" % op)
+                 for op in ("push", "pull"))
+kv_ops = sum(dv(r0, r1, "kvstore/ops_total{op=%s}" % op)
+             for op in ("push", "pull"))
+print("DIST_TRAIN " + json.dumps({
+    "rank": rank, "mode": mode, "steps": steps,
+    "step_ms": round(wall / steps * 1e3, 3),
+    "dispatches_per_step":
+        round((s1["op_dispatch_total"] - s0["op_dispatch_total"])
+              / steps, 2),
+    "kv_ops_per_step": round(kv_ops / steps, 2),
+    "compiles_during_timed":
+        s1["backend_compile_total"] - s0["backend_compile_total"],
+    "socket_bytes_per_step": round(sock_bytes / steps, 1),
+    "allreduce_bytes_per_step":
+        round(dv(r0, r1, "kvstore/allreduce_bytes_total") / steps, 1),
+}), flush=True)
+if mode == "fused":
+    mod._kvstore.close()
+    dist_runtime.release()
+'''
+
+
+def _run_worker_pair(args_for_rank, env, timeout=600, env_for_rank=None):
+    """Run the dist_train_sync worker for ranks 0 and 1 concurrently
+    and parse each rank's DIST_TRAIN json line.  ``env_for_rank(env,
+    rank)`` may return a per-rank override of the shared ``env`` (the
+    socket round stages ``MXNET_TPU_RANK`` this way)."""
+    import subprocess
+    import tempfile
+    fd, script = tempfile.mkstemp(suffix=".py", prefix="mx_dist_bench_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(_DIST_TRAIN_WORKER)
+        env = dict(env)
+        env["PYTHONPATH"] = _ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        procs = [subprocess.Popen(
+            [sys.executable, script] + [str(a) for a in args_for_rank(r)],
+            env=(env_for_rank(env, r) if env_for_rank else env),
+            cwd=_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for r in range(2)]
+        try:
+            out = []
+            for p in procs:
+                stdout, _ = p.communicate(timeout=timeout)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        "dist bench worker failed (rc %d): %s"
+                        % (p.returncode, stdout[-1200:]))
+                for line in reversed(stdout.splitlines()):
+                    if line.startswith("DIST_TRAIN "):
+                        out.append(json.loads(line[len("DIST_TRAIN "):]))
+                        break
+                else:
+                    raise RuntimeError(
+                        "worker produced no DIST_TRAIN line: %s"
+                        % stdout[-1200:])
+            return out
+        finally:
+            # one rank failing/timing out must not leak the other
+            # parked in the gloo rendezvous holding our stdout pipe
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    finally:
+        try:
+            os.unlink(script)
+        except OSError:
+            pass
+
+
+def dist_train_sync(steps=40, batch=16, dim=128):
+    """Fused in-program pod collectives vs the socket parameter server
+    on the SAME 2-process MLP probe (ROADMAP item 2 evidence).
+
+    Round A (``dist_tpu_sync``): gloo 2-process cluster, the gradient
+    all-reduce a GSPMD psum INSIDE the one donated train-step program —
+    1 host dispatch/step, 0 bytes through any socket.  Round B
+    (``dist_sync``): the PR 7 snapshotting sync PS, push+pull per
+    parameter per step over TCP.  Banks step wall, dispatches/step, and
+    bytes-over-socket for both.  CPU caveat: both rounds ride loopback
+    on a 2-core container, so the banked ratio understates the TPU win
+    (ICI allreduce vs DCN round-trips); the TPU round is the ROADMAP
+    remainder."""
+    import socket as _socket
+    from .kvstore_server import KVStoreServer
+
+    # round A: fused in-program collectives
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", COORD=coord,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               MXNET_FUSED_STEP="1")
+    for v in ("MXNET_TPU_PS_URI", "MXNET_COMPILE_CACHE_DIR"):
+        env.pop(v, None)
+    fused = _run_worker_pair(
+        lambda r: ["fused", r, steps, batch, dim], env)
+    if any(w["compiles_during_timed"] for w in fused):
+        raise RuntimeError(
+            "fused dist round recompiled during the timed window: %r"
+            % fused)
+
+    # round B: socket PS
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = KVStoreServer(port=port, num_workers=2, sync_mode=True)
+    srv.start_background()
+    try:
+        env_ps = dict(os.environ, JAX_PLATFORMS="cpu",
+                      XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                      MXNET_TPU_PS_URI="127.0.0.1",
+                      MXNET_TPU_PS_PORT=str(port),
+                      MXNET_TPU_NUM_WORKERS="2",
+                      MXNET_FUSED_STEP="1")
+        env_ps.pop("MXNET_COMPILE_CACHE_DIR", None)
+        # rank rides MXNET_TPU_RANK: it must be in the env before
+        # import (the worker sets MXNET_DIST_* itself in fused mode)
+        sock_res = _run_worker_pair(
+            lambda r: ["socket", r, steps, batch, dim], env_ps,
+            env_for_rank=lambda e, r: dict(e, MXNET_TPU_RANK=str(r)))
+    finally:
+        srv.stop()
+
+    fused_ms = max(w["step_ms"] for w in fused)
+    sock_ms = max(w["step_ms"] for w in sock_res)
+    extra = {
+        "workers": 2,
+        "batch_per_host": batch,
+        "steps_timed": steps,
+        "fused_step_ms": fused_ms,
+        "socket_step_ms": sock_ms,
+        "speedup_vs_socket": round(sock_ms / fused_ms, 2),
+        "fused_dispatches_per_step":
+            max(w["dispatches_per_step"] for w in fused),
+        "socket_dispatches_per_step":
+            max(w["dispatches_per_step"] for w in sock_res),
+        # with update_on_kvstore the socket round's per-step host work
+        # is RPCs, not eager op dispatches — count those too
+        "fused_kv_ops_per_step":
+            max(w["kv_ops_per_step"] for w in fused),
+        "socket_kv_ops_per_step":
+            max(w["kv_ops_per_step"] for w in sock_res),
+        "fused_socket_bytes_per_step": 0.0,
+        "socket_bytes_per_step":
+            max(w["socket_bytes_per_step"] for w in sock_res),
+        "allreduce_bytes_per_step":
+            max(w["allreduce_bytes_per_step"] for w in fused),
+        "fused_compiles_during_timed": 0,
+        "cpu_caveat": "loopback gloo vs loopback TCP on a 2-core "
+                      "container; the ICI-vs-DCN gap needs the TPU "
+                      "round (ROADMAP item 2 remainder)",
+    }
+    return 1e3 / fused_ms, extra
+
+
 def train_mlp(batch=64, iters=50, steps_per_call=32):
     """Small-model fallback metric: MNIST-scale MLP steps/s — survives on
     any backend and gives the judge *a* number even if ResNet can't run.
@@ -2160,6 +2394,15 @@ def _job_dist_failover():
                    x, host_metric=True)
 
 
+def _job_dist_train_sync():
+    v, x = dist_train_sync()
+    return persist("dist_train_sync_steps_per_sec", v,
+                   "steps/s (2-process MLP probe, gradient all-reduce "
+                   "in-program via dist_tpu_sync; socket-PS dist_sync "
+                   "comparison + dispatches/step + bytes-over-socket "
+                   "in extras)", x, host_metric=True)
+
+
 def _job_inception_train():
     v, x = train_inception(32, "float32")
     return persist("inception-v3_train_img_per_sec", v,
@@ -2270,6 +2513,7 @@ JOBS = {
     "train_resume": _job_train_resume,
     "cold_start": _job_cold_start,
     "dist_failover": _job_dist_failover,
+    "dist_train_sync": _job_dist_train_sync,
     "mlp_train": _job_mlp_train,
     "mlp_train_fused": _job_mlp_train_fused,
     "resnet50_train_fused": _job_resnet50_train_fused,
@@ -2305,6 +2549,7 @@ JOB_PRIORITY = [
     "train_resume",
     "cold_start",
     "dist_failover",
+    "dist_train_sync",
     "predictor_serve",
     "quantized_serve",
     "decode_serve",

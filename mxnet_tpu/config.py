@@ -37,6 +37,17 @@ VARS = {
                           "BSP; async = per-push updates."),
     "MXNET_TPU_NUM_WORKERS": (int, 1, "World size in PS mode."),
     "MXNET_TPU_RANK": (int, 0, "This worker's rank in PS mode."),
+    "MXNET_DIST_COORDINATOR": (str, "", "host:port of process 0's "
+                               "jax.distributed coordinator for "
+                               "dist_tpu_sync multi-host training "
+                               "(dist_runtime.py). Empty = standard "
+                               "cluster autodetection (Cloud TPU / "
+                               "SLURM / MPI), or single-process."),
+    "MXNET_DIST_NUM_PROCESSES": (int, 1, "World size for the explicit "
+                                 "MXNET_DIST_COORDINATOR route."),
+    "MXNET_DIST_PROCESS_ID": (int, 0, "This process's rank for the "
+                              "explicit MXNET_DIST_COORDINATOR "
+                              "route."),
     "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000,
                                      "Arrays above this size may be "
                                      "sharded across servers "

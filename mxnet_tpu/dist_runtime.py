@@ -1,0 +1,189 @@
+"""Multi-host runtime lifecycle for ``dist_tpu_sync``.
+
+One idempotent, refcounted wrapper around ``jax.distributed`` so the
+kvstore (and anything else that needs the global device view) can say
+"make sure the cluster runtime is up" without owning its lifecycle:
+
+* :func:`acquire` — initialize ``jax.distributed`` exactly once per
+  process (explicit ``MXNET_DIST_*`` env first, standard cluster
+  autodetection second), or adopt an already-initialized runtime (a
+  launcher that called ``jax.distributed.initialize`` itself).
+* :func:`release` — drop one reference; when the LAST holder releases
+  AND this module performed the initialization, ``shutdown()`` tears
+  the coordinator connection down cleanly.  A runtime initialized by
+  someone else is never shut down from here.
+
+Configuration (config.py):
+
+* ``MXNET_DIST_COORDINATOR`` — ``host:port`` of process 0's
+  coordinator service.  Setting it (plus the two below) is the
+  explicit, works-anywhere route — the CPU/gloo acceptance tests and
+  the ``dist_train_sync`` bench use it.
+* ``MXNET_DIST_NUM_PROCESSES`` / ``MXNET_DIST_PROCESS_ID`` — world
+  size and this process's rank.
+
+Without ``MXNET_DIST_*``, :func:`env_configured` falls back to the
+standard signals ``jax.distributed.initialize()`` autodetects itself
+(Cloud TPU metadata, SLURM, Open MPI) so a TPU pod slice launched
+through the normal tooling needs no extra variables.
+
+On a CPU backend the gloo collectives implementation is selected
+before initialization when this jax exposes the knob (the raw CPU
+backend cannot run multiprocess computations) — the same live-probed
+gate ``tests/test_kvstore_multiprocess.py`` uses.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from .base import MXNetError
+
+__all__ = ["acquire", "release", "initialize", "shutdown",
+           "is_initialized", "env_configured", "process_count",
+           "process_index"]
+
+_log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_refs = [0]          # live acquire() holders
+_owned = [False]     # did THIS module run jax.distributed.initialize?
+
+# standard env signals jax.distributed.initialize() can autodetect a
+# cluster from without explicit arguments
+_AUTO_ENV = ("SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+             "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
+             "COORDINATOR_ADDRESS")
+
+
+def _cfg(name):
+    from .config import get
+    return get(name)
+
+
+def is_initialized():
+    """Whether this process already has a live ``jax.distributed``
+    runtime (ours or anyone's)."""
+    try:
+        from jax._src import distributed as _d
+        return _d.global_state.client is not None
+    except Exception:
+        return False
+
+
+def env_configured():
+    """Whether the environment describes a multi-process cluster this
+    process could join: explicit ``MXNET_DIST_*`` settings, or one of
+    the standard signals jax autodetects."""
+    if _cfg("MXNET_DIST_COORDINATOR"):
+        return True
+    return any(os.environ.get(v) for v in _AUTO_ENV)
+
+
+def _select_cpu_collectives():
+    """Route multiprocess CPU computations over gloo when this jax has
+    the knob; a no-op on accelerator backends and older jax (where the
+    raw CPU backend simply cannot run multiprocess programs)."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu" and \
+            _cfg("MXNET_TPU_PLATFORM") != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+
+def initialize():
+    """Idempotent ``jax.distributed.initialize``.
+
+    Returns True when THIS call initialized the runtime, False when it
+    was already up or no cluster is configured.  Raises
+    :class:`MXNetError` when the environment names a cluster but the
+    join fails — silently training single-process after a botched
+    rendezvous would corrupt the run, not degrade it."""
+    import jax
+    if is_initialized():
+        return False
+    coord = _cfg("MXNET_DIST_COORDINATOR")
+    try:
+        if coord:
+            _select_cpu_collectives()
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(_cfg("MXNET_DIST_NUM_PROCESSES")),
+                process_id=int(_cfg("MXNET_DIST_PROCESS_ID")))
+            _owned[0] = True
+            return True
+        if any(os.environ.get(v) for v in _AUTO_ENV):
+            _select_cpu_collectives()
+            jax.distributed.initialize()   # standard autodetection
+            _owned[0] = True
+            return True
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(
+            "jax.distributed.initialize failed for the configured "
+            "cluster (%s): %s" % (coord or "autodetected env", e))
+    return False
+
+
+def _shutdown_locked():
+    """Tear down the runtime IF this module initialized it (no-op
+    otherwise — never shut down a launcher-owned runtime).  Caller
+    holds ``_lock``, so a concurrent :func:`acquire` cannot adopt the
+    runtime between the ownership check and the teardown."""
+    if not _owned[0]:
+        return
+    _owned[0] = False
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception as e:           # already down / interpreter exit
+        _log.debug("jax.distributed.shutdown: %s", e)
+
+
+def shutdown():
+    with _lock:
+        _shutdown_locked()
+
+
+def acquire():
+    """Refcounted ensure-initialized; pair with :func:`release`.
+
+    Initialization is attempted whenever no runtime is live — NOT only
+    on the first reference: an early holder acquired before the cluster
+    env was set (e.g. ``io.dist_parts`` on a laptop) must not suppress
+    a later holder's rendezvous."""
+    with _lock:
+        if not is_initialized():
+            initialize()       # marks _owned when it performs the init
+        _refs[0] += 1
+
+
+def release():
+    """Drop one :func:`acquire` reference; the last release shuts the
+    runtime down when this module owns it."""
+    with _lock:
+        if _refs[0] > 0:
+            _refs[0] -= 1
+            if _refs[0] == 0:
+                _shutdown_locked()
+
+
+def process_count():
+    try:
+        import jax
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def process_index():
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0

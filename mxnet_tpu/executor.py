@@ -123,6 +123,8 @@ class Executor(object):
         self._monitor_callback = None
         self._dp_mesh = None
         self._dp_batch_names = ()
+        self._dp_nproc = 1
+        self._allreduce_bytes = 0
         if _tm._enabled:
             _tm.counter("executor/bind_total",
                         "Executor binds (graph → buffers)").inc()
@@ -141,9 +143,18 @@ class Executor(object):
         parameters replicated; GSPMD partitions the compute and inserts
         the gradient all-reduce that `Comm`/NCCL performed in the
         reference. ``batch_arg_names`` lists the args sharded on dim 0
-        (data + labels)."""
+        (data + labels).
+
+        A mesh spanning MULTIPLE PROCESSES (``dist_tpu_sync``:
+        parallel.mesh.global_dp_mesh) makes this the pod-scale path:
+        each process stages its LOCAL batch shard into a global array
+        (per-host input sharding), params ride replicated, and the
+        gradient ``psum`` crosses hosts on ICI/DCN inside the same
+        donated program — zero per-step host round-trips."""
+        from .parallel.mesh import mesh_process_count
         self._dp_mesh = mesh
         self._dp_batch_names = tuple(batch_arg_names)
+        self._dp_nproc = mesh_process_count(mesh)
         # the mesh signature is part of every program fingerprint:
         # drop the memos so programs built before the mesh was set
         # can't be confused with their sharded successors (rebuilds
@@ -165,24 +176,41 @@ class Executor(object):
 
     def _dp_place(self, name, data):
         """device_put ``data`` to its declared mesh sharding if it is not
-        already there (no-op on the steady-state path)."""
+        already there (no-op on the steady-state path).
+
+        On a multi-process mesh the staged value is this process's
+        LOCAL contribution: batch args assemble into a global array
+        whose rows are each host's shard (global batch = local batch x
+        process count), replicated args land on the local devices only
+        (every host already holds the value — replication moves no
+        bytes)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self._dp_mesh
-        if name in self._dp_batch_names:
+        is_batch = name in self._dp_batch_names
+        if is_batch:
             ndev = mesh.shape["dp"]
-            if data.ndim == 0 or data.shape[0] % ndev != 0:
+            local_div = (len(mesh.local_devices) if self._dp_nproc > 1
+                         else ndev)
+            if data.ndim == 0 or data.shape[0] % local_div != 0:
                 raise MXNetError(
                     "data-parallel Module: batch dim of %r (shape %s) must "
                     "be divisible by the %d devices"
-                    % (name, tuple(data.shape), ndev))
+                    % (name, tuple(data.shape), local_div))
             spec = P("dp", *([None] * (data.ndim - 1)))
         else:
             spec = P()
         sh = NamedSharding(mesh, spec)
         if getattr(data, "sharding", None) == sh:
             return data
-        return jax.device_put(data, sh)
+        if self._dp_nproc == 1:
+            return jax.device_put(data, sh)
+        from .parallel.mesh import (host_local_value, make_batch_global,
+                                    make_replicated_global)
+        local = host_local_value(data)      # host/local view to restage
+        if is_batch:
+            return make_batch_global(mesh, local)
+        return make_replicated_global(mesh, local)
 
     # -- compilation -------------------------------------------------------
     def _buffer_sig(self):
@@ -334,6 +362,9 @@ class Executor(object):
         self._last_key = key
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
+        # multi-process mesh: outputs stay GLOBAL jax arrays (zero
+        # per-step host traffic); NDArray.asnumpy takes this process's
+        # addressable view lazily at the first host read
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, arr in zip(self._symbol.list_outputs(), self.outputs):
@@ -532,6 +563,16 @@ class Executor(object):
         run = self._fused_jitted.get(cache_key)
         if run is None:
             install_donation_warning_filter()
+            if self._dp_nproc > 1:
+                # per-step accounting needs the gradient byte total on
+                # registry hits too; the built-a-program counter and
+                # the compile-attributed span are armed inside build()
+                # below, so a program served from the process-wide
+                # registry (zero builds) records neither
+                self._allreduce_bytes = sum(
+                    self.arg_dict[n]._data.nbytes for n in update_names)
+            else:
+                self._allreduce_bytes = 0
             # process-wide registry entry: a resumed trainer (or a
             # second Module over the same graph/optimizer) shares the
             # program, and MXNET_COMPILE_CACHE_DIR makes the build a
@@ -561,6 +602,21 @@ class Executor(object):
 
             def build():
                 built.append(True)
+                if self._dp_nproc > 1:
+                    # the cross-host gradient all-reduce is being
+                    # traced INTO this program (GSPMD psum over the
+                    # global mesh): count it at build time — there is
+                    # no per-step host marker, by construction — and
+                    # arm the one compile-time-attributed kv.allreduce
+                    # span so traces show where the collective went
+                    if _tm._enabled:
+                        _tm.counter(
+                            "kvstore/allreduce_programs_total",
+                            "Fused train-step programs built with the "
+                            "cross-host gradient all-reduce folded in "
+                            "(dist_tpu_sync; GSPMD psum over the "
+                            "global dp mesh)").inc()
+                    self._allreduce_span_due = True
                 if _tm._enabled:
                     _tm._ensure_compile_listener()
                     _tm.counter("executor/fused_step_compile_total",
@@ -605,7 +661,20 @@ class Executor(object):
         from . import profiler as _prof
         token = _tm.dispatch_begin() if _tm._enabled else None
         with _tr.child_span("executor.train_step"):
-            if _engine.profiling_imperative():
+            if getattr(self, "_allreduce_span_due", False):
+                # compile-time-attributed marker: the in-program
+                # collective has no per-step host span by construction
+                # (that is the win), so the ONE span is recorded where
+                # the psum is traced+compiled into the program — the
+                # first dispatch after a build
+                self._allreduce_span_due = False
+                with _tr.child_span(
+                        "kv.allreduce",
+                        attrs={"bytes": self._allreduce_bytes,
+                               "processes": self._dp_nproc,
+                               "compile_attributed": True}):
+                    new_p, new_s, new_aux, outs, sentinel = run(*args)
+            elif _engine.profiling_imperative():
                 with _prof.scope("fused_train_step", "executor"):
                     new_p, new_s, new_aux, outs, sentinel = run(*args)
             else:
@@ -623,6 +692,19 @@ class Executor(object):
         if _tm._enabled:
             _tm.counter("executor/fused_step_total",
                         "Completed fused train steps").inc()
+            if self._dp_nproc > 1:
+                # in-program collective accounting: one allreduce rode
+                # this step, over this many gradient bytes — and ZERO
+                # bytes through any host socket (contrast
+                # kvstore/bytes_total on the PS path)
+                _tm.counter("kvstore/allreduce_steps_total",
+                            "Fused train steps whose gradient "
+                            "all-reduce ran in-program (dist_tpu_sync)"
+                            ).inc()
+                _tm.counter("kvstore/allreduce_bytes_total",
+                            "Gradient bytes reduced by in-program "
+                            "collectives (per step: sum of parameter "
+                            "gradient sizes)").inc(self._allreduce_bytes)
 
         # throughput MFU: the interval between consecutive step ends is
         # the honest steady-state step wall (compute + whatever host
@@ -651,7 +733,8 @@ class Executor(object):
         fetch — not an op dispatch, not a recompile; the
         health_overhead bench bounds it under 2% of the step) and
         apply the numerics policy."""
-        vals = _np.asarray(sentinel)
+        from .parallel.mesh import host_local_value
+        vals = _np.asarray(host_local_value(sentinel))
         report = {"loss": float(vals[0]),
                   "grad_norm": float(vals[1]),
                   "nonfinite": int(vals[2])}

@@ -42,7 +42,7 @@ from . import tracing as _tr
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
            "DataPipeline", "ArrayBatchSource", "RecordBatchSource",
-           "shard_bounds", "mix_seed"]
+           "shard_bounds", "mix_seed", "dist_parts"]
 
 
 def shard_bounds(n, num_parts, part_index):
@@ -63,6 +63,45 @@ def shard_bounds(n, num_parts, part_index):
     lo = n * part_index // num_parts
     hi = n * (part_index + 1) // num_parts
     return lo, hi
+
+
+def dist_parts():
+    """Per-host input-sharding arguments for multi-host training:
+    ``(num_parts, part_index) = (process_count, process_index)`` once
+    ``jax.distributed`` is live, ``(1, 0)`` single-process.  Pass them
+    to any sharding iterator (NDArrayIter/CSVIter/ImageRecordIter/
+    DataPipeline sources — the PR 6 contract) so rank r feeds shard r,
+    which is exactly the slice the ``dist_tpu_sync`` global mesh maps
+    onto rank r's devices::
+
+        num_parts, part_index = mx.io.dist_parts()
+        it = mx.io.NDArrayIter(X, y, batch_size=local_batch,
+                               num_parts=num_parts, part_index=part_index)
+        module.fit(it, kvstore="dist_tpu_sync", ...)
+
+    Also publishes the ``io/host_shard_parts`` / ``io/host_shard_index``
+    gauges so a scrape can confirm every host is feeding a distinct
+    shard.
+
+    Brings the ``jax.distributed`` runtime up itself when the
+    environment describes a cluster — iterators are typically built
+    BEFORE the kvstore, and a pre-runtime ``jax.process_count()`` of 1
+    here would silently feed every rank the whole dataset.  The
+    reference is held for the process lifetime (never released), so a
+    later ``KVStore.close()`` cannot tear down the runtime out from
+    under iterators still wired with these values.  Raises on a
+    configured-but-broken cluster."""
+    from . import dist_runtime as _dist
+    _dist.acquire()
+    parts, index = _dist.process_count(), _dist.process_index()
+    if _tm._enabled:
+        _tm.gauge("io/host_shard_parts",
+                  "num_parts this host's input iterators shard over "
+                  "(io.dist_parts: the process count)").set(parts)
+        _tm.gauge("io/host_shard_index",
+                  "part_index this host's input iterators feed "
+                  "(io.dist_parts: the process index)").set(index)
+    return parts, index
 
 
 _MASK64 = (1 << 64) - 1

@@ -18,6 +18,8 @@ from __future__ import annotations
 import functools
 import pickle
 
+import numpy as _np
+
 from . import fault as _fault
 from .base import MXNetError
 from .fault import FaultInjected, TransientKVError
@@ -111,7 +113,30 @@ class KVStore(object):
         # predecessor's committed seqs and have its first mutating RPC
         # swallowed as a duplicate
         self._seq = int.from_bytes(os.urandom(6), "big") << 16
-        if kv_type.startswith("dist") and os.environ.get("MXNET_TPU_PS_URI"):
+        self._dist_acquired = False
+        if kv_type == "dist_tpu_sync":
+            # the synchronous hot path never touches the socket PS:
+            # push/pull fold into the fused XLA program as in-program
+            # collectives (Executor.train_step under the global dp
+            # mesh), so this type only needs the multi-host runtime up
+            from . import dist_runtime as _dist
+            _dist.acquire()
+            self._dist_acquired = True
+            if os.environ.get("MXNET_TPU_PS_URI"):
+                import logging
+                logging.info(
+                    "dist_tpu_sync ignores MXNET_TPU_PS_URI: the sync "
+                    "hot path runs on in-program collectives (use "
+                    "dist_async for the socket parameter server)")
+            if _tm._enabled:
+                _tm.gauge("kvstore/dist_world_size",
+                          "Processes in the dist_tpu_sync cluster"
+                          ).set(self.num_workers)
+                _tm.gauge("kvstore/dist_rank",
+                          "This process's rank in the dist_tpu_sync "
+                          "cluster").set(self.rank)
+        elif kv_type.startswith("dist") and \
+                os.environ.get("MXNET_TPU_PS_URI"):
             self._connect_ps()
 
     # -- parameter-server transport (DCN tier) -----------------------------
@@ -266,7 +291,16 @@ class KVStore(object):
         make the store TERMINAL: further PS ops raise instead of
         silently redialing — a resurrected connection would run without
         its liveness heartbeat and read as a dead rank mid-round. Safe
-        to call twice; a no-op for local/device stores."""
+        to call twice; a no-op for local/device stores.
+
+        A ``dist_tpu_sync`` store instead releases its reference on the
+        ``jax.distributed`` runtime (dist_runtime.py): the last release
+        shuts the coordinator connection down cleanly when this
+        framework initialized it."""
+        if self._dist_acquired:
+            self._dist_acquired = False
+            from . import dist_runtime as _dist
+            _dist.release()
         if self._ps_host is not None:
             # only a PS-backed store becomes terminal; local/device
             # stores have no transport to tear down
@@ -464,7 +498,15 @@ class KVStore(object):
                     raise MXNetError("key %r already initialized" % (k,))
                 if self._sock is not None:
                     self._ps_call("INIT", k, vlist[0].asnumpy())
-                self._store[k] = vlist[0].copy()
+                if self._type == "dist_tpu_sync" and self._sock is None \
+                        and self.num_workers > 1:
+                    # rank-0 broadcast through a device collective in
+                    # place of the reference's socket INIT round: every
+                    # rank adopts process 0's value, so all replicas
+                    # start from identical params without a PS hop
+                    self._store[k] = self._broadcast0(vlist[0])
+                else:
+                    self._store[k] = vlist[0].copy()
         if _tm._enabled:
             _tm.record_kvstore("init", None, _approx_nbytes(value))
 
@@ -607,6 +649,22 @@ class KVStore(object):
             for o in olist:
                 o._set_data(src._data[rows._data.astype("int32")])
 
+    def _broadcast0(self, value):
+        """Process-0's value to every process as a fresh NDArray — the
+        ``dist_tpu_sync`` replacement for socket INIT rounds.  One
+        collective over the device links at init time; the steady-state
+        hot path (the fused train step's in-program ``psum``) never
+        calls back here."""
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(value.asnumpy())
+        if _tm._enabled:
+            _tm.counter("kvstore/broadcast_init_total",
+                        "dist_tpu_sync rank-0 init broadcasts (one "
+                        "collective per key, replacing socket INIT "
+                        "rounds)").inc()
+        return NDArray(jnp.asarray(_np.asarray(out)), ctx=value.context)
+
     # -- aggregation -------------------------------------------------------
     def _aggregate(self, key, vlist):
         """Sum per-device contributions. Single values pass through; the
@@ -744,9 +802,14 @@ def create(name="local"):
     """Create a KVStore (reference: src/kvstore/kvstore.cc:40-77 factory).
 
     Supported types: ``local``, ``device`` (both intra-process),
-    ``dist_sync``/``dist_device_sync``/``dist_tpu_sync`` (allreduce across
-    JAX processes), ``dist_async`` (per-push update, no barrier), ``nccl``
-    (alias of device — collectives are XLA's job on TPU)."""
+    ``dist_tpu_sync`` (multi-host in-program collectives: the gradient
+    all-reduce folds into the fused train step as a GSPMD ``psum`` over
+    the global dp mesh — no socket parameter server on the hot path;
+    see docs/distributed_training.md), ``dist_sync``/``dist_device_sync``
+    (host-driven allreduce, or the socket PS when ``MXNET_TPU_PS_URI``
+    is set), ``dist_async`` (per-push PS update, no barrier — the
+    elastic/failover tier of docs/fault_tolerance.md), ``nccl`` (alias
+    of device — collectives are XLA's job on TPU)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     known = ("local", "device", "nccl", "dist_sync", "dist_device_sync",

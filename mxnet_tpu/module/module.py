@@ -313,6 +313,29 @@ class Module(BaseModule):
         batch_names = list(self._data_names) + list(self._label_names)
         self._exec.set_dp_mesh(mesh, batch_names)
 
+    def _install_dist_mesh(self, kvstore):
+        """Pod-scale data parallelism for ``dist_tpu_sync``: ONE global
+        1-D 'dp' mesh over every device of every process (built on the
+        same set_dp_mesh machinery the local context-list path uses).
+        Each process stages its LOCAL batch shard (per-host input
+        sharding — pair the iterator with ``io.dist_parts()``); GSPMD
+        folds the cross-host gradient all-reduce into the fused
+        train-step program, so the socket parameter server is off the
+        hot path entirely."""
+        from .. import telemetry as _tm
+        from ..parallel.mesh import global_dp_mesh
+        mesh = global_dp_mesh()
+        batch_names = list(self._data_names) + list(self._label_names)
+        self._exec.set_dp_mesh(mesh, batch_names)
+        self.logger.info(
+            "dist_tpu_sync: global dp mesh over %d devices / %d "
+            "processes (rank %d); gradient all-reduce runs in-program",
+            mesh.shape["dp"], kvstore.num_workers, kvstore.rank)
+        if _tm._enabled:
+            _tm.gauge("kvstore/dist_mesh_devices",
+                      "Devices in the dist_tpu_sync global dp mesh"
+                      ).set(mesh.shape["dp"])
+
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -365,6 +388,29 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
                                 update_on_kvstore=update_on_kvstore)
+            if kvstore.type == "dist_tpu_sync" and kvstore.num_workers > 1:
+                # the global mesh makes the backward produce ALREADY
+                # all-reduced gradients — only correct when the fused
+                # step consumes them in-program. A config the fused
+                # path can't take (MXNET_FUSED_STEP=0, optimizer
+                # without a pure rule, compression, ...) stays on the
+                # per-process local executor: its local gradients ride
+                # kvstore.push → _cross_process_allreduce, the
+                # host-driven fallback docs/distributed_training.md
+                # documents (pushing mesh-reduced gradients through
+                # that path would reduce them twice)
+                if fused_step_supported(self._optimizer, kvstore,
+                                        update_on_kvstore,
+                                        self._compression_params) \
+                        and self._exec._monitor_callback is None \
+                        and not self.inputs_need_grad:
+                    self._install_dist_mesh(kvstore)
+                else:
+                    self.logger.warning(
+                        "dist_tpu_sync: configuration cannot take the "
+                        "fused in-program-collective step; training "
+                        "host-driven (per-gradient device allreduce, "
+                        "no socket PS)")
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:
@@ -482,6 +528,19 @@ class Module(BaseModule):
             # (e.g. fused path disabled): replay the unfused sequence
             self.forward(data_batch, is_train=True)
             self.backward()
+        if getattr(self._exec, "_dp_nproc", 1) > 1:
+            # the global dist mesh is installed, so these gradients are
+            # ALREADY all-reduced by the backward; pushing them through
+            # the kvstore would reduce them a second time. Reachable
+            # only when the config degraded AFTER init_optimizer gated
+            # the mesh install (e.g. a monitor installed mid-training).
+            raise MXNetError(
+                "dist_tpu_sync: the fused-step configuration changed "
+                "after the global mesh was installed (monitor / "
+                "grad_req / MXNET_FUSED_STEP?); the unfused update "
+                "path cannot run over mesh-reduced gradients — "
+                "restore the configuration or set it before "
+                "init_optimizer")
         param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
         grad_arrays = [self._exec.grad_dict[n] for n in self._param_names]
         if self._update_on_kvstore:

@@ -88,8 +88,17 @@ class NDArray:
 
     # -- host transfer -----------------------------------------------------
     def asnumpy(self):
-        """Copy to host; the sync point (reference: ndarray.py asnumpy)."""
-        return _np.asarray(self._data)
+        """Copy to host; the sync point (reference: ndarray.py asnumpy).
+
+        Under multi-host training (``dist_tpu_sync``) an array can span
+        processes; the host copy is then this process's addressable
+        view — the full value for replicated arrays (params, optimizer
+        state), the local rows for batch-sharded ones."""
+        data = self._data
+        if getattr(data, "is_fully_addressable", True) is False:
+            from ..parallel.mesh import host_local_value
+            data = host_local_value(data)
+        return _np.asarray(data)
 
     def asscalar(self):
         if self.size != 1:

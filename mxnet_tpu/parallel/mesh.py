@@ -11,7 +11,9 @@ from __future__ import annotations
 import threading
 
 __all__ = ["make_mesh", "current_mesh", "set_mesh", "data_parallel_sharding",
-           "replicated_sharding"]
+           "replicated_sharding", "global_dp_mesh", "mesh_process_count",
+           "host_local_value", "make_replicated_global",
+           "make_batch_global"]
 
 _state = threading.local()
 
@@ -55,3 +57,86 @@ def data_parallel_sharding(mesh, axis="dp", ndim=2):
 def replicated_sharding(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# multi-host (dist_tpu_sync) mesh + placement helpers
+# ---------------------------------------------------------------------------
+
+def global_dp_mesh(axis="dp"):
+    """1-D data-parallel mesh over EVERY device of EVERY process, in
+    canonical ``(process_index, device id)`` order — each process's
+    local devices own a contiguous run of mesh positions, so rank r's
+    local batch maps onto global batch rows ``[r*local, (r+1)*local)``.
+    This is the mesh ``dist_tpu_sync`` folds the gradient all-reduce
+    into (GSPMD inserts the ``psum`` over the 'dp' axis inside the
+    fused train-step program)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.array(devs), (axis,))
+
+
+def mesh_process_count(mesh):
+    """How many processes own devices of ``mesh`` (1 = fully local)."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def host_local_value(arr):
+    """This process's addressable view of a (possibly multi-process)
+    jax array: the full value for a replicated array, the local rows
+    (concatenated over local shards, mesh order) for a batch array
+    sharded on dim 0.  Fully-addressable arrays pass through — the
+    single-process path pays nothing."""
+    import jax
+    if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+        return arr
+    shards = {}
+    for s in arr.addressable_shards:
+        key = tuple(sl.start or 0 for sl in s.index)
+        shards.setdefault(key, s.data)
+    if len(shards) == 1:                   # replicated: any shard is all
+        return next(iter(shards.values()))
+    # multiple local shards (several local devices): assemble on host —
+    # the shards are committed to DIFFERENT devices, and jax refuses a
+    # device computation over mixed placements
+    import numpy as np
+    return np.concatenate(
+        [np.asarray(d) for _, d in sorted(shards.items())], axis=0)
+
+
+def make_replicated_global(mesh, host_value):
+    """Global replicated array over a multi-process ``mesh`` from a
+    host value every process holds identically (params, optimizer
+    state): the value lands on each LOCAL device and the shards
+    assemble into one global array — no cross-host transfer, because
+    replication needs none when every host already has the value."""
+    import jax
+    import numpy as np
+    data = np.asarray(host_value)
+    sh = replicated_sharding(mesh)
+    arrs = [jax.device_put(data, d) for d in mesh.local_devices]
+    return jax.make_array_from_single_device_arrays(data.shape, sh, arrs)
+
+
+def make_batch_global(mesh, host_local_batch, axis="dp"):
+    """Global batch array sharded on dim 0 over ``axis``, assembled
+    from each process's LOCAL batch rows (the per-host input-sharding
+    contract: rank r feeds shard r of the iterator, see
+    ``io.dist_parts``).  Global batch = local batch x process count;
+    every process must contribute the same local batch size."""
+    import jax
+    import numpy as np
+    data = np.asarray(host_local_batch)
+    sh = data_parallel_sharding(mesh, axis=axis, ndim=max(data.ndim, 1))
+    make = getattr(jax, "make_array_from_process_local_data", None)
+    if make is not None:
+        return make(sh, data)
+    # older jax: split the local rows over the local devices by hand
+    local = list(mesh.local_devices)
+    chunks = np.split(data, len(local))
+    nproc = mesh_process_count(mesh)
+    gshape = (data.shape[0] * nproc,) + data.shape[1:]
+    arrs = [jax.device_put(c, d) for c, d in zip(chunks, local)]
+    return jax.make_array_from_single_device_arrays(gshape, sh, arrs)

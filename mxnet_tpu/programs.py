@@ -116,6 +116,13 @@ def version_salt():
         try:
             parts.append("backend=%s" % jax.default_backend())
             parts.append("devices=%d" % jax.device_count())
+            # device count alone cannot distinguish 2 processes x 1
+            # device from 1 process x 2 devices — same SPMD partition,
+            # different runtime (cross-host collectives) — so the
+            # process count is salted explicitly: a dist_tpu_sync
+            # worker must never replay a single-host manifest entry as
+            # if it named the same executable
+            parts.append("processes=%d" % jax.process_count())
         except Exception:
             parts.append("backend=uninitialized")
     except Exception:

@@ -264,6 +264,7 @@ class DecodeEngine(object):
         self._worker = None
         self._warmup_req = None
         self._restarts_used = 0
+        self._iter_hook = None
 
         self._m_requests = _tm.counter(
             "decode/requests_total", "Decode requests admitted")
@@ -643,8 +644,26 @@ class DecodeEngine(object):
         self._m_occupancy.set(len(self._live))
         self._m_free.set(self._pool.free_pages)
 
+    def set_iteration_hook(self, fn):
+        """Install (or clear, with None) a callable run on the
+        SCHEDULER thread at the top of every loop iteration, before
+        admission — outside the engine lock, so it may block.
+
+        This is the deterministic-testing seam (the decode analog of
+        ``fault.POINTS``): a hook that parks on a semaphore turns the
+        scheduler into a single-steppable machine, which is how the
+        iteration-level-scheduling ordering tests assert completion
+        order without sleep/race timing.  A blocking hook also blocks
+        ``close()`` — clear it (and release any parked permit) before
+        teardown.  Hook exceptions take the scheduler crash-recovery
+        path like any other loop failure.  Not a production surface."""
+        self._iter_hook = fn
+
     def _loop(self):
         while True:
+            hook = self._iter_hook
+            if hook is not None:
+                hook()
             _fault.inject("decode.step")
             with self._cond:
                 wreq, self._warmup_req = self._warmup_req, None

@@ -1557,8 +1557,10 @@ def test_c_api_infer_shape_partial_and_iter_index(tmp_path, c_api_lib):
 
 def test_c_api_kvstore_run_server(tmp_path, c_api_lib):
     """MXKVStoreRunServer: a server-role process driven purely through
-    the C ABI serves a dist_tpu_sync worker (init/push/pull round
-    trip), proving the blocking server loop entry point."""
+    the C ABI serves a dist_sync worker (init/push/pull round
+    trip), proving the blocking server loop entry point.
+    (dist_sync, not dist_tpu_sync: the latter no longer dials a PS at
+    all — its hot path is the in-program collective.)"""
     import socket
     import time as _time
     import numpy as np
@@ -1594,7 +1596,7 @@ def test_c_api_kvstore_run_server(tmp_path, c_api_lib):
         old = {k: os.environ.get(k) for k in env}
         os.environ.update(env)
         try:
-            kv = mx.kv.create("dist_tpu_sync")
+            kv = mx.kv.create("dist_sync")
             kv.init("w", mx.nd.zeros((4,)))
             kv.push("w", mx.nd.array(np.full((4,), 5.0, np.float32)))
             out = mx.nd.zeros((4,))

@@ -285,17 +285,39 @@ def test_continuous_batching_bitwise_zero_compiles(model, engine):
 
 def test_short_request_overtakes_long(model, engine):
     """A short request admitted while a long one is mid-decode finishes
-    first — iteration-level scheduling, not batch-at-admission."""
-    long_sess = engine.submit(list(range(4)), max_new_tokens=16)
-    # wait until the long request is genuinely mid-decode
-    assert long_sess.next_token(timeout=30) is not None
-    assert long_sess.next_token(timeout=30) is not None
-    short_sess = engine.submit(list(range(5, 8)), max_new_tokens=2)
-    short = short_sess.result()
-    assert len(short) == 2
-    long_out = long_sess.result()
-    assert len(long_out) == 16
-    assert short_sess.t_done < long_sess.t_done
+    first — iteration-level scheduling, not batch-at-admission.
+
+    Event-driven, not timing-driven: the scheduler iteration hook
+    parks the loop on a semaphore, so the short request is PROVABLY
+    submitted while the long one is mid-decode (two tokens in, 14 to
+    go) no matter how loaded the host is — the historical flake here
+    was the free-running scheduler finishing the long request before a
+    starved client thread got the short one admitted."""
+    gate = threading.Semaphore(0)
+    # armed while the scheduler idles INSIDE an iteration (its wait
+    # loop), so the first iteration with work runs without a permit and
+    # the loop then parks at the next iteration boundary
+    engine.set_iteration_hook(gate.acquire)
+    try:
+        long_sess = engine.submit(list(range(4)), max_new_tokens=16)
+        # iteration 1: admit + prefill (token 1) + step (token 2), then
+        # the scheduler parks — the long request CANNOT advance
+        assert long_sess.next_token(timeout=30) is not None
+        assert long_sess.next_token(timeout=30) is not None
+        assert not long_sess.done
+        # mid-decode by construction: submit the short request while
+        # the scheduler is parked, then free-run
+        short_sess = engine.submit(list(range(5, 8)), max_new_tokens=2)
+        engine.set_iteration_hook(None)
+        gate.release()                   # unpark the waiting acquire
+        short = short_sess.result()
+        assert len(short) == 2
+        long_out = long_sess.result()
+        assert len(long_out) == 16
+        assert short_sess.t_done < long_sess.t_done
+    finally:
+        engine.set_iteration_hook(None)
+        gate.release(4)                  # never leave the loop parked
 
 
 def test_admission_rejects_oversized_and_bad_tokens(engine):

@@ -508,7 +508,7 @@ def _run_sync_cluster(monkeypatch, port, steps):
                     MXNET_KV_RETRIES="60",
                     MXNET_KV_BACKOFF_MS="300",
                     MXNET_KV_TIMEOUT_MS="240000")
-        kv = mx.kv.create("dist_tpu_sync")
+        kv = mx.kv.create("dist_sync")
         if rank == 0:
             kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
                                               momentum=0.9))
@@ -587,7 +587,7 @@ import os, sys
 sys.path.insert(0, %r)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import mxnet_tpu as mx
-kv = mx.kv.create("dist_tpu_sync")
+kv = mx.kv.create("dist_sync")
 print("ENTERING_BARRIER", flush=True)
 kv.barrier()
 print("BARRIER_DONE", flush=True)

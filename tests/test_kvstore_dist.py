@@ -77,8 +77,11 @@ def _worker(port, rank, nw, results, mode="sync"):
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     try:
+        # dist_sync: the socket-PS BSP tier. (dist_tpu_sync no longer
+        # dials the PS at all — its sync hot path is the in-program
+        # collective; see tests/test_dist_tpu_sync.py)
         kv = mx.kv.create("dist_async" if mode == "async" else
-                          "dist_tpu_sync")
+                          "dist_sync")
         kv.init("w", mx.nd.zeros((4,)))
         kv.barrier()
         kv.push("w", mx.nd.array(
@@ -193,7 +196,7 @@ import os
 import numpy as np
 import mxnet_tpu as mx
 rank = int(os.environ["MXNET_TPU_RANK"])
-kv = mx.kv.create("dist_tpu_sync")
+kv = mx.kv.create("dist_sync")
 kv.init("x", mx.nd.zeros((2,)))
 kv.barrier()
 kv.push("x", mx.nd.array(np.full((2,), float(rank + 1), np.float32)))

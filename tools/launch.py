@@ -21,6 +21,15 @@ Usage:
 Env exported to children (reference: DMLC_ROLE / DMLC_PS_ROOT_URI):
     MXNET_TPU_ROLE, MXNET_TPU_PS_URI, MXNET_TPU_PS_PORT,
     MXNET_TPU_NUM_WORKERS, MXNET_TPU_RANK, MXNET_TPU_PS_MODE
+
+The local launcher additionally exports the ``MXNET_DIST_*`` contract
+(coordinator address + world size + per-worker process id) so a script
+running ``--kv-store dist_tpu_sync`` rendezvouses a ``jax.distributed``
+runtime and trains over in-program collectives — the kvstore type the
+script picks decides which transport it actually dials; the PS is
+started either way and simply idles for collective-only jobs. Multi-host
+ssh deployments get the runtime from the cluster scheduler's standard
+env instead (see docs/distributed_training.md).
 """
 import argparse
 import os
@@ -114,6 +123,14 @@ def main():
         # unauthenticated peers)
         "MXNET_TPU_PS_TOKEN": uuid.uuid4().hex,
     })
+    if args.launcher == "local":
+        # dist_tpu_sync route: rank 0 hosts the jax.distributed
+        # coordinator on its own port (the PS port carries pickle
+        # RPCs, not gRPC)
+        base_env.update({
+            "MXNET_DIST_COORDINATOR": "127.0.0.1:%d" % _free_port(),
+            "MXNET_DIST_NUM_PROCESSES": str(args.num_workers),
+        })
 
     server_env = dict(base_env, MXNET_TPU_ROLE="server")
     server = subprocess.Popen(
@@ -144,7 +161,8 @@ def main():
     try:
         for rank in range(args.num_workers):
             wenv = dict(base_env, MXNET_TPU_ROLE="worker",
-                        MXNET_TPU_RANK=str(rank))
+                        MXNET_TPU_RANK=str(rank),
+                        MXNET_DIST_PROCESS_ID=str(rank))
             if hosts is not None:
                 # the remote side gets ONLY the contract env inline;
                 # its login shell provides the rest
