@@ -46,7 +46,7 @@ _LAZY = ("symbol", "sym", "gluon", "module", "io", "optimizer", "metric",
          "config", "rnn", "mod", "name", "attribute", "log", "libinfo",
          "util", "registry", "misc", "executor_manager", "ndarray_doc",
          "symbol_doc", "telemetry", "serving", "serve", "fault",
-         "tracing", "quantize", "programs")
+         "tracing", "quantize", "programs", "forensics")
 
 
 def __getattr__(name):

@@ -115,6 +115,13 @@ def _note_mfu_divergence(extra, tol=0.20):
         return
     ratio = meas / est
     extra["mfu_measured_vs_est"] = round(ratio, 3)
+    try:
+        # mirror the ratio into the health/mfu_divergence gauge so the
+        # default mfu_divergence SLO rule can fire on /alerts
+        from . import health as _health
+        _health.note_mfu_divergence(est, meas)
+    except Exception:
+        pass
     if abs(ratio - 1.0) > tol:
         extra["mfu_divergence_warning"] = (
             "measured MFU %.4f vs hand-counted %.4f (ratio %.2f) "
@@ -205,6 +212,16 @@ def persist(metric, value, unit, extra=None, host_metric=False):
         # BENCH rounds track retrace and HBM regressions, not just img/s
         from . import telemetry as _tm
         rec["telemetry"] = _tm.snapshot()
+    except Exception:
+        pass
+    try:
+        # when forensics capture is on, bank the fusion-level digest too
+        # (report count, top fusion bytes share, residual bytes) so a
+        # BENCH round records the compiler's fusion story next to img/s
+        from . import forensics as _fx
+        fx = _fx.digest()
+        if fx:
+            rec["forensics"] = fx
     except Exception:
         pass
     base = BASELINES.get(metric)
@@ -1731,6 +1748,136 @@ def health_overhead(batch=256, hidden=1024, iters=25, rounds=8):
 
 
 # ---------------------------------------------------------------------------
+# compiler-forensics overhead job (forensics.py capture-cost proof)
+
+_FORENSICS_DRIVER = r'''
+import json, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.serve import InferenceEngine, ServeConfig
+from mxnet_tpu.serving import Predictor
+
+params_path, max_batch = sys.argv[1], int(sys.argv[2])
+data = mx.sym.Variable("data")
+h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+h = mx.sym.Activation(h, act_type="relu", name="relu1")
+h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+sym = mx.sym.softmax(h, name="prob")
+rng = np.random.RandomState(7)
+mx.nd.save(params_path, {
+    "arg:fc1_weight": mx.nd.array(
+        (rng.randn(64, 784) * 0.1).astype(np.float32)),
+    "arg:fc1_bias": mx.nd.array(np.zeros(64, np.float32)),
+    "arg:fc2_weight": mx.nd.array(
+        (rng.randn(10, 64) * 0.1).astype(np.float32)),
+    "arg:fc2_bias": mx.nd.array(np.zeros(10, np.float32))})
+with open(params_path, "rb") as f:
+    blob = f.read()
+pred = Predictor(sym.tojson(), blob, input_shapes={"data": (1, 784)})
+eng = InferenceEngine(pred, ServeConfig(max_batch=max_batch, workers=1))
+t0 = time.time()
+eng.warmup()
+t1 = time.time()
+snap = tm.snapshot()
+print("FORENSICS " + json.dumps({
+    "warmup_s": round(t1 - t0, 3),
+    "buckets": len(eng.config.buckets),
+    "compiles": snap["programs_compile_total"],
+    "compile_requests": snap["backend_compile_total"],
+    "disk_hits": snap["programs_disk_hits"],
+    "captured": snap.get("forensics_captured", 0),
+    "unavailable": snap.get("forensics_unavailable", 0)}), flush=True)
+'''
+
+
+def forensics_overhead(max_batch=128, rounds=3):
+    """Warm-replica warmup wall of the 8-bucket MLP serve ladder with
+    ``MXNET_FORENSICS`` off vs on, against one shared
+    ``MXNET_COMPILE_CACHE_DIR`` — the production configuration, where
+    the capture's AOT ``lowered.compile()`` is a persistent-cache disk
+    load, not a real backend compile. A cold populate run fills the
+    cache; every measured run is a FRESH process whose warmup performs
+    zero real compiles, and min-of-rounds with the off/on order
+    alternated (health_overhead's drift-cancelling discipline) prices
+    the capture itself: parse + attribute + one CRC'd artifact write
+    per program.
+
+    RAISES when (a) a capture-enabled run performs any counted backend
+    compile — the suppress_compile_tracking fence is the contract every
+    zero-recompile serving test banks on — or (b) the warmup overhead
+    exceeds the 2% budget docs/observability.md promises, judged above
+    the off2 harness noise floor."""
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix="mx_forensics_overhead_")
+    try:
+        base_env = {"MXNET_COMPILE_CACHE_DIR": os.path.join(tmpdir, "cache"),
+                    "MXNET_FORENSICS_DIR": os.path.join(tmpdir, "forensics"),
+                    "MXNET_TELEMETRY": "1"}
+        args = [os.path.join(tmpdir, "m.params"), str(max_batch)]
+
+        def run(forensics_on):
+            env = dict(base_env)
+            env["MXNET_FORENSICS"] = "1" if forensics_on else "0"
+            return _run_driver(_FORENSICS_DRIVER, args, env, "FORENSICS")
+
+        cold = run(False)                  # populates the compile cache
+        first_on = run(True)               # AOT disk loads + writes reports
+        if first_on["compiles"] != 0:
+            raise RuntimeError(
+                "forensics-enabled warm replica performed %d counted "
+                "backend compiles; expected 0 (the capture compile must "
+                "ride the suppress fence and the persistent cache)"
+                % first_on["compiles"])
+        if first_on["captured"] <= 0 and first_on["unavailable"] <= 0:
+            raise RuntimeError(
+                "forensics-enabled run captured nothing (captured=0, "
+                "unavailable=0) — the capture_cost hook is not wired")
+        configs = ("off", "on", "off2")
+        best = {name: float("inf") for name in configs}
+        runs = {name: None for name in configs}
+        for rnd in range(rounds):
+            order = configs if rnd % 2 == 0 else tuple(reversed(configs))
+            for name in order:
+                res = run(name == "on")
+                if res["compiles"] != 0:
+                    raise RuntimeError(
+                        "warm replica (%s) performed %d counted backend "
+                        "compiles; expected 0" % (name, res["compiles"]))
+                if res["warmup_s"] < best[name]:
+                    best[name], runs[name] = res["warmup_s"], res
+        pct = {k: round((best[k] / best["off"] - 1.0) * 100, 2)
+               for k in configs}
+        noise_pct = abs(pct["off2"])
+        extra = {
+            "buckets": cold["buckets"],
+            "warmup_s_off": round(best["off"], 3),
+            "warmup_s_on": round(best["on"], 3),
+            "first_capture_warmup_s": first_on["warmup_s"],
+            "overhead_pct_on": pct["on"],
+            "harness_noise_pct": noise_pct,
+            "captured_first_on": first_on["captured"],
+            "captured_steady": runs["on"]["captured"],
+            "unavailable": runs["on"]["unavailable"],
+            "warm_compiles_on": runs["on"]["compiles"],
+            "warm_disk_hits_on": runs["on"]["disk_hits"],
+            "loop": "min-of-%d rounds, off/on order alternated; off2 = "
+                    "off re-measured (noise floor); steady on-runs adopt "
+                    "the first on-run's disk artifacts" % rounds,
+        }
+        if pct["on"] > max(2.0, 2 * noise_pct):
+            raise RuntimeError(
+                "forensics capture warmup overhead %.2f%% exceeds the "
+                "2%% budget and the %.2f%% harness noise floor (off "
+                "%.3f s vs on %.3f s warmup)"
+                % (pct["on"], noise_pct, best["off"], best["on"]))
+        return 1.0 / max(best["on"], 1e-9), extra
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # serving job (serve.InferenceEngine under offered load)
 
 def _serve_offered_load(eng, make_feed, offered_rps, clients, duration):
@@ -2467,6 +2614,15 @@ def _job_health_overhead():
                    "the 2%% step-mode budget)", x, host_metric=True)
 
 
+def _job_forensics_overhead():
+    v, x = forensics_overhead()
+    return persist("forensics_overhead_warmups_per_sec", v,
+                   "warm 8-bucket ladder warmups/s with "
+                   "MXNET_FORENSICS=1 (zero counted backend compiles "
+                   "asserted; off/on overhead %% in extras, raises "
+                   "past the 2%% warmup budget)", x, host_metric=True)
+
+
 def _job_predictor_serve():
     v, x = serve_predictor()
     return persist("predictor_serve_req_per_sec", v,
@@ -2510,6 +2666,7 @@ def _make_infer_job(model, dtype, batch=32):
 JOBS = {
     "trace_overhead": _job_trace_overhead,
     "health_overhead": _job_health_overhead,
+    "forensics_overhead": _job_forensics_overhead,
     "train_resume": _job_train_resume,
     "cold_start": _job_cold_start,
     "dist_failover": _job_dist_failover,
@@ -2546,6 +2703,7 @@ JOB_PRIORITY = [
     "mlp_train_fused",
     "trace_overhead",
     "health_overhead",
+    "forensics_overhead",
     "train_resume",
     "cold_start",
     "dist_failover",

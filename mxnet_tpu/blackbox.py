@@ -78,6 +78,10 @@ EVENTS = {
     "alert": "an SLO rule transitioned (rule, state ok<->firing, value)",
     "numerics_trip": "a numerics sentinel tripped (kind, step report, "
                      "worst param in full mode)",
+    "forensics": "a forensics diff flagged a fusion regression between "
+                 "two captures of the same program (split fusion, new "
+                 "copy, boundary-bytes growth; a/b fingerprints + the "
+                 "regression list)",
 }
 
 _lock = threading.Lock()

@@ -309,6 +309,30 @@ VARS = {
                                     "threshold over decode/"
                                     "step_seconds p99 (inter-token "
                                     "latency)."),
+    "MXNET_SLO_MFU_DIVERGENCE": (float, 0.20,
+                                 "Default mfu_divergence SLO rule "
+                                 "threshold: the health/mfu_divergence "
+                                 "gauge (|measured/hand-counted - 1| "
+                                 "from bench runs) above this fires "
+                                 "/alerts in events mode."),
+    "MXNET_FORENSICS": (int, 0,
+                        "Compiler-forensics capture (forensics.py): "
+                        "after health.capture_cost registers a "
+                        "program, also capture its optimized HLO "
+                        "(AOT lower+compile under "
+                        "suppress_compile_tracking — a persistent-"
+                        "cache disk load when MXNET_COMPILE_CACHE_DIR "
+                        "is set) and write the per-fusion report "
+                        "artifact. Once per program, nothing per "
+                        "step; without a compile cache the capture "
+                        "compile is real warmup wall."),
+    "MXNET_FORENSICS_DIR": (str, "",
+                            "Forensics report directory (CRC'd "
+                            "<fingerprint>.json artifacts, atomic "
+                            "writes). Empty: defaults to "
+                            "<MXNET_COMPILE_CACHE_DIR>/forensics; "
+                            "with neither set, reports stay in-memory "
+                            "only (/programs + diagnostics)."),
     "MXNET_TPU_PEAK_FLOPS": (float, 197e12,
                              "Peak accelerator FLOP/s used as the MFU "
                              "denominator by BOTH benchmark.py "

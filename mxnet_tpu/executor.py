@@ -353,10 +353,10 @@ class Executor(object):
             # cost pass over the lowered module, not a second compile);
             # keyed by a process-unique sequence, never id(self) — a
             # GC-reused address must not inherit a dead graph's FLOPs
+            pkey = self._fwd_keys.get(bool(is_train))
             self._fwd_cost[bool(is_train)] = _health.capture_cost(
                 "executor_forward", _health.next_cost_key("fwd"),
-                fwd, (env, key))
-            pkey = self._fwd_keys.get(bool(is_train))
+                fwd, (env, key), pkey=pkey)
             if pkey is not None:
                 _pg.attach_cost(pkey, self._fwd_cost[bool(is_train)])
         self._last_key = key
@@ -635,7 +635,7 @@ class Executor(object):
             self._fused_costs[cache_key] = _pg.attach_cost(
                 pkey, _health.capture_cost(
                     "fused_step", _health.next_cost_key("step"),
-                    run, tuple(args)))
+                    run, tuple(args), pkey=pkey))
             # the interval ending here includes trace+lower+compile:
             # never let it pollute the throughput-MFU gauge
             self._last_step_end = None

@@ -55,6 +55,7 @@ from .base import MXNetError
 __all__ = ["NumericsError", "capture_cost", "register_cost",
            "program_cost", "programs",
            "note_executor_step", "note_serve_batch", "note_decode",
+           "note_mfu_divergence",
            "peak_flops", "peak_hbm_bytes_per_s", "mfu_summary",
            "numerics_mode", "set_numerics", "numerics_policy",
            "set_numerics_policy", "set_spike_factor", "check_numerics",
@@ -127,7 +128,7 @@ def peak_hbm_bytes_per_s():
     return float(_config("MXNET_TPU_PEAK_HBM_GBPS", 819.0)) * 1e9
 
 
-def capture_cost(kind, key, jitted, args, kwargs=None):
+def capture_cost(kind, key, jitted, args, kwargs=None, pkey=None):
     """Register the XLA cost analysis of one compiled program.
 
     ``jitted.lower(*args)`` traces + lowers (NO backend compile) and
@@ -136,6 +137,12 @@ def capture_cost(kind, key, jitted, args, kwargs=None):
     The few pseudo-compile events the pass itself emits are suppressed
     from the telemetry compile counters (they would poison the
     zero-recompile assertions every serving test banks).
+
+    ``pkey`` (optional) is the site's registry :class:`ProgramKey`:
+    when given and ``MXNET_FORENSICS`` is on, the compiler-forensics
+    layer rides this same choke point to capture the program's
+    optimized HLO (forensics.maybe_capture — once per fingerprint,
+    same suppress fence, never raises back into the site).
 
     Returns the stored record, or None when the backend offers no
     analysis (counted in ``health/cost_analysis_unavailable_total`` —
@@ -150,6 +157,7 @@ def capture_cost(kind, key, jitted, args, kwargs=None):
             return _costs[ck]
     tm = _tm()
     rec = None
+    lowered = None
     try:
         with tm.suppress_compile_tracking():
             lowered = jitted.lower(*args, **(kwargs or {}))
@@ -178,6 +186,14 @@ def capture_cost(kind, key, jitted, args, kwargs=None):
         tm.counter("health/programs_captured_total",
                    "Compiled programs with cost analysis registered "
                    "(flops + bytes accessed)", ("kind",)).labels(kind).inc()
+    if pkey is not None:
+        try:
+            from . import forensics as _fx
+            _fx.maybe_capture(pkey, jitted, args, kwargs, cost=rec,
+                              lowered=lowered)
+        except Exception as e:      # never let forensics break a site
+            _log.debug("forensics capture failed for %s/%s: %s",
+                       kind, key, e)
     return rec
 
 
@@ -274,6 +290,31 @@ def note_decode(phase, bucket, seconds, rec):
     return util
 
 
+def note_mfu_divergence(est, measured):
+    """Bank the measured-vs-hand-counted MFU divergence as a proper
+    gauge (``health/mfu_divergence`` = |measured/est - 1|) so it shows
+    on ``/metrics`` and the default ``mfu_divergence`` SLO rule can
+    fire ``/alerts`` — instead of the warning living only inside bench
+    records (benchmark._note_mfu_divergence calls this). Returns the
+    ratio, or None when either side is missing."""
+    try:
+        est, measured = float(est or 0.0), float(measured or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if est <= 0.0 or measured <= 0.0:
+        return None
+    ratio = measured / est
+    tm = _tm()
+    if tm._enabled:
+        tm.gauge("health/mfu_divergence",
+                 "Absolute divergence |measured/est - 1| between the "
+                 "measured MFU (XLA cost_analysis FLOPs) and the "
+                 "hand-counted estimate of the same bench run; the "
+                 "mfu_divergence SLO rule fires past "
+                 "MXNET_SLO_MFU_DIVERGENCE").set(abs(ratio - 1.0))
+    return ratio
+
+
 def mfu_summary():
     """One-shot roofline summary for diagnostics(): current gauges plus
     the captured-program table."""
@@ -300,6 +341,11 @@ def mfu_summary():
     if fam is not None:
         out["serve_bucket_mfu"] = {
             lv[0]: round(c.value, 6) for lv, c in fam.series()}
+    fam = tm.REGISTRY._families.get("health/mfu_divergence")
+    if fam is not None:
+        series = fam.series()
+        if series:
+            out["mfu_divergence"] = round(series[0][1].value, 4)
     return out
 
 
@@ -756,6 +802,13 @@ def _ensure_defaults():
           threshold=0.0,
           description="numerics-sentinel trips (nonfinite grads/loss "
                       "or grad-norm spike)")
+    watch("mfu_divergence", gauge="health/mfu_divergence",
+          threshold=float(_config("MXNET_SLO_MFU_DIVERGENCE", 0.20)),
+          mode="events",
+          description="measured MFU (cost_analysis FLOPs) diverges "
+                      "from the hand-counted estimate past "
+                      "MXNET_SLO_MFU_DIVERGENCE (a single divergent "
+                      "bench sample fires)")
 
 
 def set_interval(seconds):

@@ -373,7 +373,9 @@ class DecodeEngine(object):
             # be live for the HLO cost pass
             self._prog_costs[("prefill", bucket)] = _health.capture_cost(
                 "decode_prefill", _health.next_cost_key("dec"),
-                prog, pargs)
+                prog, pargs,
+                pkey=_pg.ProgramKey("decode_prefill", self._graph_hash,
+                                    {"bucket": int(bucket)}))
         tok0, self._k_pages, self._v_pages = _pg.warm_twice(
             prog, pargs,
             rebuild=lambda out, a: (a[0], out[1], out[2]) + a[3:])
@@ -391,7 +393,9 @@ class DecodeEngine(object):
         if ("step", nslots) not in self._prog_costs:
             self._prog_costs[("step", nslots)] = _health.capture_cost(
                 "decode_step", _health.next_cost_key("dec"),
-                prog, sargs)
+                prog, sargs,
+                pkey=_pg.ProgramKey("decode_step", self._graph_hash,
+                                    {"slots": int(nslots)}))
         toks, self._k_pages, self._v_pages = _pg.warm_twice(
             prog, sargs,
             rebuild=lambda out, a: (a[0], out[1], out[2]) + a[3:])

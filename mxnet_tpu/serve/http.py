@@ -20,6 +20,10 @@ deployment without adding dependencies. One threaded server mounts:
   rollout gate that keeps compile latency out of production traffic.
 * ``GET /metrics`` — the shared telemetry registry in Prometheus text
   format (same payload as ``telemetry.serve``; scrape either).
+* ``GET /programs`` — the compiled-program registry listing with
+  forensics availability; ``?key=<fingerprint>`` returns that
+  program's per-fusion forensics summary (``forensics.py``; also
+  mounted on ``telemetry.serve``).
 
 ``/predict`` request body::
 
@@ -201,6 +205,10 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
             elif path == "/alerts":
                 from .. import health as _hl
                 code, payload = _hl.alerts_endpoint(query)
+                self._reply(code, payload)
+            elif path == "/programs":
+                from .. import forensics as _fx
+                code, payload = _fx.programs_endpoint(query)
                 self._reply(code, payload)
             else:
                 self._reply(404, {"error": "not found"})

@@ -799,6 +799,11 @@ def serve(port=0, addr="127.0.0.1", registry=None):
                 code, payload = _hl.alerts_endpoint(query)
                 body = json.dumps(payload).encode() + b"\n"
                 ctype = "application/json"
+            elif path == "/programs":
+                from . import forensics as _fx
+                code, payload = _fx.programs_endpoint(query)
+                body = json.dumps(payload, default=str).encode() + b"\n"
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
@@ -919,6 +924,11 @@ def snapshot():
         out["alerts_firing"] = []
         out["numerics_trips"] = 0
         out["flight_records"] = 0
+    # compiler-forensics accounting (forensics.py): per-program HLO
+    # reports captured vs degraded — bench records carry whether the
+    # run has fusion-level provenance
+    out["forensics_captured"] = _val("forensics/captured_total")
+    out["forensics_unavailable"] = _val("forensics/unavailable_total")
     fam = REGISTRY._families.get("serving/batch_rows")
     if fam is not None:
         rows = sum(c.sum for _lv, c in fam.series())
@@ -1037,6 +1047,16 @@ def diagnostics(as_dict=False):
         if _bb.enabled():
             hinfo["flight_recorder"] = _bb.path()
             hinfo["flight_tail"] = _bb.tail(20)
+        try:
+            # compiler forensics: the top-N fusions by bytes moved in
+            # the programs farthest from the roofline — which fusion
+            # to burn down, straight in the bug report
+            from . import forensics as _fx
+            wf = _fx.worst_fusions(limit=5)
+            if wf:
+                hinfo["worst_fusions"] = wf
+        except Exception:
+            pass
         info["health"] = hinfo
     except Exception:
         pass

@@ -755,8 +755,10 @@ def test_docs_drift_check_covers_events_and_rules():
         import check_metrics_docs as chk
     finally:
         sys.path.pop(0)
-    _m, _s, events, rules = chk.collect_code_names()
+    _m, _s, events, rules, endpoints = chk.collect_code_names()
     assert set(blackbox.EVENTS) <= events
-    assert {"serve_p99", "numerics", "kv_giveups"} <= rules
+    assert {"serve_p99", "numerics", "kv_giveups",
+            "mfu_divergence"} <= rules
+    assert {"/metrics", "/alerts", "/programs"} <= endpoints
     drift = chk.check()
     assert not any(drift.values()), drift

@@ -10,9 +10,12 @@ in docs/observability.md — and every name listed in that doc's metric
 and span tables must still exist in the code. The same contract covers
 the health layer: flight-recorder event names (``blackbox.EVENTS``
 keys plus every ``record_event("name", ...)`` literal) must match the
-table under the ``<!-- flight-recorder-events -->`` marker, and SLO
+table under the ``<!-- flight-recorder-events -->`` marker, SLO
 rule names (``health.watch("name", ...)`` literals under mxnet_tpu/)
-must match the table under ``<!-- slo-rules -->``. Fails listing the
+must match the table under ``<!-- slo-rules -->``, and every HTTP
+endpoint routed by a ``path == "/x"`` literal comparison (the
+telemetry.serve / serve.http do_GET/do_POST dispatch idiom) must match
+the table under ``<!-- http-endpoints -->``. Fails listing the
 missing names on either side, so the observability surface and its
 documentation cannot silently drift (the same contract fault.POINTS
 enforces for injection points).
@@ -37,6 +40,7 @@ _RULE_CALLS = {"watch"}
 _METRIC_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+$")
 _SPAN_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
 _PLAIN_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_ENDPOINT_RE = re.compile(r"^/[a-z][a-z0-9_]*$")
 
 
 def _call_name(node):
@@ -48,12 +52,15 @@ def _call_name(node):
 
 
 def collect_code_names():
-    """(metric_names, span_names, event_names, rule_names) registered
-    via string literals under mxnet_tpu/. Event names additionally
-    include the keys of blackbox.EVENTS (the registered universe — a
-    registered event with no call site yet must still be documented);
-    rule names are ``health.watch("...")`` first-arg literals."""
-    metrics, spans, events, rules = set(), set(), set(), set()
+    """(metric_names, span_names, event_names, rule_names,
+    endpoint_paths) registered via string literals under mxnet_tpu/.
+    Event names additionally include the keys of blackbox.EVENTS (the
+    registered universe — a registered event with no call site yet
+    must still be documented); rule names are ``health.watch("...")``
+    first-arg literals; endpoints are the ``path == "/x"`` literal
+    comparisons of the HTTP dispatch idiom."""
+    metrics, spans, events, rules, endpoints = (set(), set(), set(),
+                                                set(), set())
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in filenames:
@@ -75,6 +82,15 @@ def collect_code_names():
                         if isinstance(k, ast.Constant) \
                                 and isinstance(k.value, str):
                             events.add(k.value)
+                if isinstance(node, ast.Compare) \
+                        and isinstance(node.left, ast.Name) \
+                        and node.left.id == "path" \
+                        and len(node.ops) == 1 \
+                        and isinstance(node.ops[0], ast.Eq) \
+                        and isinstance(node.comparators[0], ast.Constant) \
+                        and isinstance(node.comparators[0].value, str) \
+                        and _ENDPOINT_RE.match(node.comparators[0].value):
+                    endpoints.add(node.comparators[0].value)
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 arg0 = node.args[0]
@@ -90,7 +106,7 @@ def collect_code_names():
                     events.add(arg0.value)
                 elif name in _RULE_CALLS and _PLAIN_RE.match(arg0.value):
                     rules.add(arg0.value)
-    return metrics, spans, events, rules
+    return metrics, spans, events, rules, endpoints
 
 
 def collect_doc_names():
@@ -117,10 +133,10 @@ def collect_doc_names():
     return metrics, spans
 
 
-def collect_doc_marked(marker):
+def collect_doc_marked(marker, pattern=_PLAIN_RE):
     """Backticked first-cell tokens of the ONE table that follows the
     ``<!-- marker -->`` comment in the doc (plain lowercase names
-    would false-positive against ordinary prose tables, so these two
+    would false-positive against ordinary prose tables, so these
     tables are marker-delimited)."""
     names = set()
     in_table = armed = False
@@ -137,7 +153,7 @@ def collect_doc_marked(marker):
                 cells = line.split("|")
                 if len(cells) >= 2:
                     for tok in re.findall(r"`([^`]+)`", cells[1]):
-                        if _PLAIN_RE.match(tok.strip()):
+                        if pattern.match(tok.strip()):
                             names.add(tok.strip())
             elif in_table:
                 break                    # table ended
@@ -147,10 +163,11 @@ def collect_doc_marked(marker):
 def check():
     """Returns a dict of the possible drift directions; all empty
     means code and docs agree."""
-    code_m, code_s, code_e, code_r = collect_code_names()
+    code_m, code_s, code_e, code_r, code_p = collect_code_names()
     doc_m, doc_s = collect_doc_names()
     doc_e = collect_doc_marked("flight-recorder-events")
     doc_r = collect_doc_marked("slo-rules")
+    doc_p = collect_doc_marked("http-endpoints", _ENDPOINT_RE)
     return {
         "metrics_undocumented": sorted(code_m - doc_m),
         "metrics_stale_in_docs": sorted(doc_m - code_m),
@@ -160,6 +177,8 @@ def check():
         "flight_events_stale_in_docs": sorted(doc_e - code_e),
         "slo_rules_undocumented": sorted(code_r - doc_r),
         "slo_rules_stale_in_docs": sorted(doc_r - code_r),
+        "endpoints_undocumented": sorted(code_p - doc_p),
+        "endpoints_stale_in_docs": sorted(doc_p - code_p),
     }
 
 
@@ -174,15 +193,16 @@ def main():
                 print("  - %s" % n)
     if not ok:
         print("\ndocs/observability.md and the registered metric/span/"
-              "flight-event/SLO-rule name literals under mxnet_tpu/ "
-              "are out of sync (undocumented = add a table row; stale "
-              "= the doc names something the code no longer "
+              "flight-event/SLO-rule/endpoint name literals under "
+              "mxnet_tpu/ are out of sync (undocumented = add a table "
+              "row; stale = the doc names something the code no longer "
               "registers).")
         return 1
-    code_m, code_s, code_e, code_r = collect_code_names()
-    print("ok: %d metrics, %d spans, %d flight events, %d SLO rules "
-          "in sync with docs/observability.md"
-          % (len(code_m), len(code_s), len(code_e), len(code_r)))
+    code_m, code_s, code_e, code_r, code_p = collect_code_names()
+    print("ok: %d metrics, %d spans, %d flight events, %d SLO rules, "
+          "%d endpoints in sync with docs/observability.md"
+          % (len(code_m), len(code_s), len(code_e), len(code_r),
+             len(code_p)))
     return 0
 
 
