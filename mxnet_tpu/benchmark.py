@@ -2158,6 +2158,177 @@ def decode_serve(clients=6, requests_per_client=4, slots=4, page_size=16,
     return tok_s, extra
 
 
+def kernel_burn_down(iters=10, warmup=3):
+    """Per-kernel before/after probe for the PR-17 Pallas burn-down:
+    flash prefill attention (+fused page write), the fused
+    optimizer-update kernel (SGD-momentum and Adam), and int8 conv via
+    im2col — the three programs the PR-16 forensics worst-fusions
+    reports rank worst.
+
+    For each kernel the BEFORE program is the pure-XLA route production
+    ran before the burn-down and the AFTER program is the new dispatch
+    (Mosaic kernel on TPU; off-TPU it runs the bitwise lax twin, so the
+    CPU walls bank ~1.0x and the real win needs the TPU round —
+    ``cpu_caveat`` in extras). Both variants register forensics reports
+    under kernel-tagged registry keys (``forensics --diff`` compares
+    like with like), measured MFU comes from the XLA cost analysis over
+    the timed wall, and the hand-counted estimate rides next to it so
+    ``health/mfu_divergence`` goes live. RAISES if any variant performs
+    a counted backend compile after its warmup — the Pallas dispatch
+    must not leak compiles into a warmed process."""
+    import jax
+    import jax.numpy as jnp
+    from . import forensics as _fx
+    from . import health as _health
+    from . import programs as _pg
+    from . import telemetry as _tm
+    from .ops.pallas.flash_attention import (_flash_prefill_xla,
+                                             flash_prefill_paged)
+    from .ops.pallas.int8_matmul import _int8_conv_xla, int8_conv_im2col
+    from .optimizer import (_adam_fused, _adam_fused_pallas, _sgd_fused,
+                            _sgd_fused_pallas)
+
+    fx_dir = os.path.join(BENCH_DIR, "forensics_kernel_burn_down")
+    os.makedirs(fx_dir, exist_ok=True)
+    prev_fx = _fx.configure(on=True, directory=fx_dir)
+    rng = np.random.RandomState(0)
+    on_tpu = jax.default_backend() == "tpu"
+    graph = "kernel_burn_down"
+    kernels = {}
+    try:
+        # -- flash prefill attention + fused page write ----------------
+        b, s, nh, kvh, hd, ps = 2, 128, 8, 2, 32, 16
+        q = jnp.asarray(rng.randn(b, s, nh, hd), jnp.float32)
+        kg = jnp.asarray(rng.randn(b, s, kvh, hd), jnp.float32)
+        vg = jnp.asarray(rng.randn(b, s, kvh, hd), jnp.float32)
+        npages = b * (s // ps) + 1
+        kp = jnp.zeros((npages, ps, kvh, hd), jnp.float32)
+        vp = jnp.zeros((npages, ps, kvh, hd), jnp.float32)
+        bt = jnp.asarray(
+            1 + np.arange(b * (s // ps)).reshape(b, s // ps), jnp.int32)
+        targets = [
+            ("flash_prefill_paged", "decode_prefill",
+             {"bucket": s, "kernel": "xla-prefill"},
+             {"bucket": s, "kernel": "pallas-prefill"},
+             _flash_prefill_xla, flash_prefill_paged,
+             (q, kg, vg, kp, vp, bt),
+             4.0 * b * s * s * nh * hd, peak_flops("float32")),
+        ]
+
+        # -- fused optimizer update (SGD-momentum + Adam) --------------
+        n = (512, 1024)
+        w = jnp.asarray(rng.randn(*n), jnp.float32)
+        g = jnp.asarray(rng.randn(*n), jnp.float32)
+        mom = jnp.asarray(rng.randn(*n), jnp.float32)
+        mean = jnp.asarray(rng.randn(*n), jnp.float32)
+        var = jnp.asarray(np.abs(rng.randn(*n)), jnp.float32)
+        h_sgd = {"lr": 0.01, "wd": 1e-4, "momentum": 0.9,
+                 "rescale_grad": 1.0 / 32}
+        h_adam = {"lr": 1e-3, "wd": 1e-4, "beta1": 0.9,
+                  "one_minus_beta1": 0.1, "beta2": 0.999,
+                  "one_minus_beta2": 1e-3, "epsilon": 1e-8,
+                  "rescale_grad": 1.0}
+        nelem = float(np.prod(n))
+        targets += [
+            ("sgd_fused_update", "fused_step",
+             {"opt": "sgd_momentum", "kernel": "lax-update"},
+             {"opt": "sgd_momentum", "kernel": "pallas-update"},
+             lambda w, g, m: _sgd_fused(w, g, (m,), h_sgd),
+             lambda w, g, m: _sgd_fused_pallas(w, g, (m,), h_sgd),
+             (w, g, mom), 7.0 * nelem, peak_flops("float32")),
+            ("adam_fused_update", "fused_step",
+             {"opt": "adam", "kernel": "lax-update"},
+             {"opt": "adam", "kernel": "pallas-update"},
+             lambda w, g, m, v: _adam_fused(w, g, (m, v), h_adam),
+             lambda w, g, m, v: _adam_fused_pallas(w, g, (m, v), h_adam),
+             (w, g, mean, var), 13.0 * nelem, peak_flops("float32")),
+        ]
+
+        # -- int8 conv via im2col --------------------------------------
+        cb, cin, hw, cout, kk = 4, 64, 28, 64, 3
+        qc = jnp.asarray(rng.randint(-127, 128, (cb, cin, hw, hw)),
+                         jnp.int8)
+        wq = jnp.asarray(rng.randint(-127, 128, (cout, cin, kk, kk)),
+                         jnp.int8)
+        sc = jnp.asarray(rng.rand(cout) * 0.1, jnp.float32)
+        targets.append(
+            ("int8_conv_im2col", "executor_forward",
+             {"op": "quantized_conv_int8", "kernel": "lax-conv"},
+             {"op": "quantized_conv_int8", "kernel": "im2col-mxu"},
+             lambda x, w_, s_: _int8_conv_xla(x, w_, s_, (1, 1), (1, 1),
+                                              (1, 1), 1),
+             lambda x, w_, s_: int8_conv_im2col(x, w_, s_, (1, 1),
+                                                (1, 1), (1, 1), 1),
+             (qc, wq, sc),
+             2.0 * cb * hw * hw * cout * cin * kk * kk,
+             peak_flops("int8")))
+
+        for (name, kind, spec_b, spec_a, fn_b, fn_a, args, hand_flops,
+             peak) in targets:
+            jb, ja = jax.jit(fn_b), jax.jit(fn_a)
+            rec_b = _health.capture_cost(
+                kind, _health.next_cost_key("kbd"), jb, args,
+                pkey=_pg.ProgramKey(kind, graph, spec_b))
+            rec_a = _health.capture_cost(
+                kind, _health.next_cost_key("kbd"), ja, args,
+                pkey=_pg.ProgramKey(kind, graph, spec_a))
+            for fn in (jb, ja):          # compile + execute = warm
+                for _ in range(warmup):
+                    _fetch(fn(*args))
+            c0 = _tm.snapshot()["backend_compile_total"]
+            wall_b = _timeit(jb, *args, warmup=warmup, iters=iters)
+            wall_a = _timeit(ja, *args, warmup=warmup, iters=iters)
+            compiles = _tm.snapshot()["backend_compile_total"] - c0
+            if compiles:
+                raise RuntimeError(
+                    "kernel_burn_down: %s performed %d counted backend "
+                    "compiles after warmup; the Pallas dispatch leaks "
+                    "compiles into a warmed process" % (name, compiles))
+            entry = {
+                "kind": kind, "variant_before": spec_b["kernel"],
+                "variant_after": spec_a["kernel"],
+                "wall_before_us": round(wall_b * 1e6, 2),
+                "wall_after_us": round(wall_a * 1e6, 2),
+                "speedup": round(wall_b / wall_a, 3),
+                "mfu_est": round(hand_flops / wall_a / peak, 6),
+                "flop_convention": "hand-counted kernel FLOPs "
+                                   "(dominant matmul/elementwise ops)",
+            }
+            if rec_b:
+                entry["flops_before"] = rec_b["flops"]
+                entry["bytes_before"] = rec_b["bytes"]
+            if rec_a:
+                entry["flops_after"] = rec_a["flops"]
+                entry["bytes_after"] = rec_a["bytes"]
+                entry["mfu_measured"] = round(
+                    rec_a["flops"] / wall_a / peak, 6)
+            # mirrors into health/mfu_divergence (gauge + SLO rule)
+            _note_mfu_divergence(entry)
+            kernels[name] = entry
+
+        walls_b = [k["wall_before_us"] for k in kernels.values()]
+        walls_a = [k["wall_after_us"] for k in kernels.values()]
+        speedup = float(np.exp(np.mean(
+            [np.log(b_ / a_) for b_, a_ in zip(walls_b, walls_a)])))
+        extra = {
+            "kernels": kernels,
+            "forensics_reports_dir": fx_dir,
+            "forensics_report_count": len(_fx.reports()),
+            "compiles_after_warmup": 0,
+            "loop": "min over _timeit(%d iters, %d warmup) per variant; "
+                    "before = pure-XLA route, after = production "
+                    "dispatch" % (iters, warmup),
+        }
+        if not on_tpu:
+            extra["cpu_caveat"] = (
+                "off-TPU the after-programs dispatch to the bitwise lax "
+                "twins, so these walls price the dispatch layer only; "
+                "the Mosaic kernel wins need a TPU round")
+        return speedup, extra
+    finally:
+        _fx.configure(on=prev_fx[0], directory=prev_fx[1])
+
+
 # ---------------------------------------------------------------------------
 # inference jobs (benchmark_score.py port)
 
@@ -2638,6 +2809,17 @@ def _job_decode_serve():
                    "static-batching baseline in extras)", x)
 
 
+def _job_kernel_burn_down():
+    v, x = kernel_burn_down()
+    return persist("kernel_burn_down_speedup", v,
+                   "x (geomean before/after wall over the PR-17 Pallas "
+                   "kernels: flash prefill + fused page write, fused "
+                   "SGD-momentum/Adam update, int8 im2col conv; "
+                   "per-kernel walls, measured MFU, and kernel-tagged "
+                   "forensics reports in extras; raises on any "
+                   "after-warmup compile)", x)
+
+
 def _job_infer_int8():
     v, x = infer_quantized("resnet50")
     return persist("resnet50_infer_int8_img_per_sec", v,
@@ -2667,6 +2849,7 @@ JOBS = {
     "trace_overhead": _job_trace_overhead,
     "health_overhead": _job_health_overhead,
     "forensics_overhead": _job_forensics_overhead,
+    "kernel_burn_down": _job_kernel_burn_down,
     "train_resume": _job_train_resume,
     "cold_start": _job_cold_start,
     "dist_failover": _job_dist_failover,
@@ -2704,6 +2887,7 @@ JOB_PRIORITY = [
     "trace_overhead",
     "health_overhead",
     "forensics_overhead",
+    "kernel_burn_down",
     "train_resume",
     "cold_start",
     "dist_failover",

@@ -70,6 +70,22 @@ VARS = {
                          "local-update paths). 0 restores the separate "
                          "forward/vjp programs plus per-parameter update "
                          "dispatches."),
+    "MXNET_PALLAS_FUSED_UPDATE": (bool, True,
+                                  "Route SGD-momentum/Adam fused update "
+                                  "rules through the Pallas "
+                                  "ops/pallas/fused_update.py kernels "
+                                  "(Mosaic on TPU; off-TPU the kernels "
+                                  "dispatch to their bitwise lax twins, "
+                                  "so 0 vs 1 is a no-op on CPU). 0 pins "
+                                  "the plain lax rules everywhere."),
+    "MXNET_INT8_CONV_IM2COL": (bool, False,
+                               "Force _contrib_quantized_conv_int8 "
+                               "through the im2col + Pallas int8-matmul "
+                               "route off-TPU too (on TPU it is the "
+                               "default). The lax conv path stays the "
+                               "bitwise acceptance twin; int32 "
+                               "accumulation makes the two routes "
+                               "bitwise-identical."),
     "MXNET_TELEMETRY": (bool, True,
                         "Always-on runtime metrics (telemetry.py): op "
                         "dispatch, jit-cache, HBM, kvstore, io "

@@ -18,11 +18,25 @@ Layout: (batch, heads, seq, head_dim).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "paged_decode_attention",
+           "flash_prefill_paged"]
+
+# kernel-contract registry: every exported Pallas kernel maps to its
+# module-level pure-lax twin (tools/check_pallas_contracts.py fails the
+# suite if an exported kernel is missing here, its twin touches
+# pallas_call, or tests/ lacks an interpret-mode parity test)
+PALLAS_KERNELS = {
+    "flash_attention": "_flash_fwd_xla",
+    "paged_decode_attention": "_paged_decode_xla",
+    "flash_prefill_paged": "_flash_prefill_xla",
+}
 
 NEG_INF = -1e30
 _LANES = 128
@@ -464,3 +478,235 @@ def _paged_decode(q, k_pages, v_pages, block_tables, lengths, sm_scale,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(block_tables, lengths, q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# paged prefill attention (serving: one batched causal forward over the
+# whole prompt bucket, with the reshape-scatter page write fused into
+# the kernel as a DMA epilogue — prefill's XLA boundary the forensics
+# worst-fusions report ranks worst is exactly this scatter round-trip)
+# ---------------------------------------------------------------------------
+
+def _flash_prefill_xla(q, kg, vg, k_pages, v_pages, block_tables):
+    """Pure-lax twin of :func:`flash_prefill_paged` — op-for-op the
+    attention + page write of ``transformer._prefill_impl``'s paged
+    branch (expand-KV einsum / sqrt(hd), tril mask, softmax, and the
+    ``at[bt].set`` reshape-scatter), so the CPU tier-1 prefill path and
+    the dense==paged bitwise contract are this exact computation."""
+    b, s, nh, hd = q.shape
+    kvh = kg.shape[2]
+    groups = nh // kvh
+    ps = k_pages.shape[1]
+    n_pb = s // ps
+    k = kg if groups == 1 else jnp.repeat(kg, groups, axis=2)
+    v = vg if groups == 1 else jnp.repeat(vg, groups, axis=2)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    sc = jnp.where(mask[None, None], sc, NEG_INF)
+    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
+    bt = block_tables[:, :n_pb]
+    kp = k_pages.at[bt].set(
+        kg.reshape(b, n_pb, ps, kvh, hd).astype(k_pages.dtype))
+    vp = v_pages.at[bt].set(
+        vg.reshape(b, n_pb, ps, kvh, hd).astype(v_pages.dtype))
+    return o, kp, vp
+
+
+def _prefill_kernel(bt_ref, q_ref, k_ref, v_ref, kg_ref, vg_ref,
+                    kp_in, vp_in, o_ref, kp_out, vp_out,
+                    m_scr, l_scr, acc_scr, ksem, vsem, *,
+                    sm_scale, block_q, block_k, page_size, seq_len):
+    """Grid (b, heads, q_blocks, k_blocks): per (batch, head, q tile)
+    the trailing k dimension accumulates an online softmax in VMEM
+    scratch exactly like ``_fwd_kernel``, but K/V stay in the compact
+    GQA layout — grouped query heads index their shared K/V head via
+    the block index map, never materialising the expanded (b, s, nh,
+    hd) tensors the lax twin builds. The page write rides the same
+    pass: the first (head, q-tile) visit of each k block DMAs that
+    block's freshly computed K/V straight from HBM into its rows' pool
+    pages (``block_k`` is a multiple of ``page_size``, so each page is
+    written exactly once per layer and the separate reshape-scatter
+    program — and its HBM round-trip — disappears)."""
+    b_i = pl.program_id(0)
+    h_i = pl.program_id(1)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(jnp.logical_and(h_i == 0, qi == 0))
+    def _write_pages():
+        for j in range(block_k // page_size):
+            page = bt_ref[b_i, ki * (block_k // page_size) + j]
+            src = pl.ds(k_start + j * page_size, page_size)
+            kcp = pltpu.make_async_copy(kg_ref.at[b_i, src],
+                                        kp_out.at[page], ksem)
+            vcp = pltpu.make_async_copy(vg_ref.at[b_i, src],
+                                        vp_out.at[page], vsem)
+            kcp.start()
+            vcp.start()
+            kcp.wait()
+            vcp.wait()
+
+    def _body():
+        q = q_ref[0, :, 0].astype(jnp.float32) * sm_scale     # (bq, d)
+        k = k_ref[0, :, 0].astype(jnp.float32)                # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        s = jnp.where(jnp.logical_and(kpos < seq_len, kpos <= qpos),
+                      s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, -1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, d)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # skip K blocks entirely above the causal diagonal (the page-write
+    # epilogue above must NOT be skipped: padded-tail pages are still
+    # written, exactly like the twin's scatter)
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _():
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret"))
+def _flash_prefill(q, kg, vg, k_pages, v_pages, block_tables,
+                   block_q, block_k, interpret):
+    b, s, nh, hd = q.shape
+    kvh = kg.shape[2]
+    groups = nh // kvh
+    ps = k_pages.shape[1]
+    sm_scale = 1.0 / math.sqrt(hd)
+    grid = (b, nh, s // block_q, s // block_k)
+
+    def q_map(b_i, h_i, qi, ki, bt):
+        return (b_i, qi, h_i, 0)
+
+    def kv_map(b_i, h_i, qi, ki, bt):
+        return (b_i, ki, h_i // groups, 0)
+
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, hd), q_map),
+            pl.BlockSpec((1, block_k, 1, hd), kv_map),
+            pl.BlockSpec((1, block_k, 1, hd), kv_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # kg: page-write src
+            pl.BlockSpec(memory_space=pltpu.ANY),   # vg: page-write src
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k_pages (aliased)
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v_pages (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1, hd), q_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, page_size=ps, seq_len=s)
+    vma = _out_vma(q, kg, vg, k_pages, v_pages)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=spec,
+        out_shape=[
+            _sds((b, s, nh, hd), q.dtype, vma),
+            _sds(k_pages.shape, k_pages.dtype, vma),
+            _sds(v_pages.shape, v_pages.dtype, vma),
+        ],
+        # pool arrays alias in->out: pages no row writes keep their
+        # contents, and on TPU the pool is updated in place (operand
+        # order counts the scalar-prefetch arg: bt=0 ... k_pages=6)
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(block_tables, q, kg, vg, kg, vg, k_pages, v_pages)
+
+
+def flash_prefill_paged(q, kg, vg, k_pages, v_pages, block_tables,
+                        block_q=128, block_k=128, interpret=None):
+    """Prefill-phase flash attention over a paged KV pool: one batched
+    causal forward per layer whose epilogue writes the prompt's K/V
+    pages, replacing ``(s, s)``-score XLA attention + a separate
+    reshape-scatter program.
+
+    Parameters
+    ----------
+    q : (b, s, n_heads, head_dim) — prompt queries (RoPE-rotated).
+    kg, vg : (b, s, kv_heads, head_dim) — compact GQA K/V; the kernel
+        never materialises the ``n_heads``-expanded copies.
+    k_pages, v_pages : (num_pages, page_size, kv_heads, head_dim) —
+        one layer's slice of the shared pool; returned updated (the
+        arrays alias in->out).
+    block_tables : (b, pages_per_row) int32 — destination page ids in
+        position order (``pages_per_row = s // page_size``); rows of a
+        warmup batch may all point at the reserved null page 0.
+
+    Returns ``(o, k_pages, v_pages)`` with ``o`` (b, s, n_heads,
+    head_dim). Score scale is fixed at ``1/sqrt(head_dim)``. Causal
+    only: position ``i`` attends ``<= i`` (ragged prompts rely on this
+    plus the caller's final ``lengths-1`` logit gather, exactly like
+    the XLA path). Forward-only (serving); no VJP. Off-TPU the
+    pure-lax twin (the tier-1 path) runs; ``interpret=True`` forces
+    the Pallas interpreter for parity tests."""
+    b, s, nh, hd = q.shape
+    ps = k_pages.shape[1]
+    if s % ps:
+        raise ValueError("prefill bucket %d is not a multiple of "
+                         "page_size %d" % (s, ps))
+    if s // ps > block_tables.shape[1]:
+        raise ValueError("prefill bucket %d needs %d pages/row; "
+                         "block table holds %d"
+                         % (s, s // ps, block_tables.shape[1]))
+    block_tables = jnp.asarray(block_tables, jnp.int32)[:, :s // ps]
+    if interpret is None:
+        if _interpret_default(q):
+            return _flash_prefill_xla(q, kg, vg, k_pages, v_pages,
+                                      block_tables)
+        interpret = False
+    # block_k must be a multiple of page_size (each page written by
+    # exactly one k block) and divide s; block_q must divide s
+    block_k = max(ps, (min(block_k, s) // ps) * ps)
+    while s % block_k:
+        block_k -= ps
+    block_q = min(block_q, s)
+    while s % block_q:
+        block_q //= 2
+    return _flash_prefill(q, kg, vg, k_pages, v_pages, block_tables,
+                          int(block_q), int(block_k), bool(interpret))

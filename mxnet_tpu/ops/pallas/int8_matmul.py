@@ -32,7 +32,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _interpret_default, _out_vma, _pad_to, _sds
 
-__all__ = ["int8_matmul"]
+__all__ = ["int8_matmul", "int8_conv_im2col"]
+
+# kernel-contract registry: exported kernel -> module-level pure-lax
+# twin (see tools/check_pallas_contracts.py)
+PALLAS_KERNELS = {
+    "int8_matmul": "_int8_matmul_xla",
+    "int8_conv_im2col": "_int8_conv_xla",
+}
 
 
 def _int8_matmul_xla(x, w, scale):
@@ -137,3 +144,85 @@ def int8_matmul(x, w, scale, block_m=128, block_n=128, block_k=128,
     block_k = min(block_k, _ceil(k, 128))
     return _int8_matmul_pallas(x, w, scale, int(block_m), int(block_n),
                                int(block_k), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# int8 conv via im2col — the PR 11 escape hatch: when XLA's epilogue
+# fusion of conv + dequant falls short, lower the conv onto the SAME
+# int8 MXU matmul kernel above (rescale stays fused in the epilogue)
+# ---------------------------------------------------------------------------
+
+def _int8_conv_xla(q, wq, scale, stride, dilate, pad, num_group):
+    """Pure-lax twin of :func:`int8_conv_im2col`: the direct
+    ``conv_general_dilated`` int32 route `_contrib_quantized_conv_int8`
+    has always used (int32 accumulation is exact, so twin and im2col
+    agree BITWISE)."""
+    dn = lax.conv_dimension_numbers(q.shape, wq.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        q.astype(jnp.int32), wq.astype(jnp.int8).astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * scale.astype(
+        jnp.float32).reshape(1, -1, 1, 1)
+
+
+def _im2col(q, kh, kw, stride, dilate, pad):
+    """Unfold NCHW int8 activations into patch rows: strided slices
+    (one per kernel tap — cheap layout ops XLA folds into the copy)
+    stacked so the contraction axis orders (cin, kh, kw), matching
+    ``wq.reshape(cout, -1)``."""
+    b, cin, h, w = q.shape
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    oh = (h + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
+    ow = (w + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+    xp = jnp.pad(q, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    cols = []
+    for ki in range(kh):
+        for kj in range(kw):
+            cols.append(lax.slice(
+                xp, (0, 0, ki * dh, kj * dw),
+                (b, cin, ki * dh + (oh - 1) * sh + 1,
+                 kj * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))                     # (b, cin, oh, ow)
+    # (kh*kw, b, cin, oh, ow) -> (b, oh, ow, cin, kh*kw)
+    patches = jnp.stack(cols).transpose(1, 3, 4, 2, 0)
+    return patches.reshape(b * oh * ow, cin * kh * kw), oh, ow
+
+
+def int8_conv_im2col(q, wq, scale, stride, dilate, pad, num_group=1,
+                     interpret=None):
+    """2-D int8 convolution lowered onto the int8 MXU matmul.
+
+    Parameters
+    ----------
+    q : (b, cin, h, w) int8 — quantized NCHW activations.
+    wq : (cout, cin // num_group, kh, kw) int8 — OIHW weights.
+    scale : (cout,) float32 — fused per-channel epilogue factor
+        (``w_scale / act_scale`` for the quantized conv op).
+    stride, dilate, pad : 2-tuples (symmetric padding).
+    interpret : forwarded to :func:`int8_matmul`; ``None`` keeps the
+        kernel dispatch contract (Mosaic on TPU, the matmul's lax twin
+        off-TPU — int32 accumulation makes every route bitwise equal
+        to :func:`_int8_conv_xla`).
+
+    Returns (b, cout, oh, ow) float32.
+    """
+    cout, _, kh, kw = wq.shape
+    cout_g = cout // num_group
+    cin_g = wq.shape[1]
+    outs = []
+    for gi in range(num_group):
+        qg = q[:, gi * cin_g:(gi + 1) * cin_g]
+        wg = wq[gi * cout_g:(gi + 1) * cout_g]
+        sg = scale[gi * cout_g:(gi + 1) * cout_g]
+        patches, oh, ow = _im2col(qg, kh, kw, stride, dilate, pad)
+        outs.append(int8_matmul(patches, wg.reshape(cout_g, -1), sg,
+                                interpret=interpret))
+    out = jnp.concatenate(outs, axis=-1) if num_group > 1 else outs[0]
+    b = q.shape[0]
+    return out.reshape(b, oh, ow, cout).transpose(0, 3, 1, 2)

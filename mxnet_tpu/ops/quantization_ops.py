@@ -259,6 +259,24 @@ def _quantized_conv_int8(data, weight, scale, bias=None, kernel=(),
     dims = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
             3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
     q = _quantize_act(data, act_scale)
+    if nd == 2:
+        import jax as _jax
+        from .. import config as _config
+        if (_jax.default_backend() == "tpu"
+                or _config.get("MXNET_INT8_CONV_IM2COL")):
+            # im2col route: lower the 2-D conv onto the int8 MXU matmul
+            # kernel with the per-channel rescale fused in its epilogue
+            # (the PR 11 escape hatch). int32 accumulation is exact, so
+            # this is BITWISE the lax conv route below.
+            from .pallas.int8_matmul import int8_conv_im2col
+            out_scale = (scale.astype(jnp.float32)
+                         / jnp.float32(act_scale))
+            out = int8_conv_im2col(q, weight.astype(jnp.int8),
+                                   out_scale, stride, dilate, pad,
+                                   num_group)
+            if bias is not None and not no_bias:
+                out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+            return out
     dn = lax.conv_dimension_numbers(q.shape, weight.shape, dims)
     acc = lax.conv_general_dilated(
         q.astype(jnp.int32), weight.astype(jnp.int8).astype(jnp.int32),

@@ -91,6 +91,24 @@ def _adam_fused(w, g, state, h):
             (mean_new, var_new))
 
 
+def _sgd_fused_pallas(w, g, state, h):
+    """:func:`_sgd_fused` as a single VMEM-resident Pallas kernel
+    (ops/pallas/fused_update.py) — the weight/state tiles make one HBM
+    round-trip instead of one per fused-multiply stage. Off-TPU the
+    kernel dispatcher runs ``_sgd_fused`` itself, so this rule IS the
+    lax rule everywhere tier-1 runs; on TPU the kernel body evaluates
+    the same rule on VMEM refs (bitwise by construction)."""
+    from .ops.pallas.fused_update import sgd_fused_update
+    return sgd_fused_update(w, g, state, h)
+
+
+def _adam_fused_pallas(w, g, state, h):
+    """:func:`_adam_fused` as a single VMEM-resident Pallas kernel —
+    see :func:`_sgd_fused_pallas` for the contract."""
+    from .ops.pallas.fused_update import adam_fused_update
+    return adam_fused_update(w, g, state, h)
+
+
 def _adagrad_fused(w, g, state, h):
     import jax.numpy as jnp
     g = _rule_prep(g, h)
@@ -392,6 +410,9 @@ class SGD(Optimizer):
         return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def fused_rule(self):
+        from . import config
+        if config.get("MXNET_PALLAS_FUSED_UPDATE"):
+            return _sgd_fused_pallas
         return _sgd_fused
 
     def fused_hyper(self, index):
@@ -528,6 +549,9 @@ class Adam(Optimizer):
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
     def fused_rule(self):
+        from . import config
+        if config.get("MXNET_PALLAS_FUSED_UPDATE"):
+            return _adam_fused_pallas
         return _adam_fused
 
     def fused_hyper(self, index):

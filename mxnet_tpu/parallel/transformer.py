@@ -805,13 +805,31 @@ def _prefill_impl(params, tokens, cache, cfg, lengths):
                 pos = jnp.arange(s)
                 q = _rope_bshd(q, pos, cfg.rope_base)
                 kg = _rope_bshd(kg, pos, cfg.rope_base)
-            cache = _cache_write_prompt(cache, li_flat, kg, vg)
-            groups = cfg.n_heads // _kv_heads(cfg)
-            k = _expand_kv(kg, groups, 2)
-            v = _expand_kv(vg, groups, 2)
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-            sc = jnp.where(mask[None, None], sc, -1e30)
-            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
+            if (isinstance(cache, PagedKVCache)
+                    and jax.default_backend() == "tpu"):
+                # fused Pallas prefill: one program computes the causal
+                # attention AND writes this layer's pages in its DMA
+                # epilogue — the kernel's lax twin is op-for-op the
+                # _cache_write_prompt + expand/einsum branch below, so
+                # CPU tier-1 (and dense==paged) semantics are that path
+                from ..ops.pallas.flash_attention import (
+                    flash_prefill_paged)
+                o, kp, vp = flash_prefill_paged(
+                    q, kg, vg, cache.k_pages[li_flat],
+                    cache.v_pages[li_flat], cache.block_tables)
+                cache = PagedKVCache(
+                    cache.k_pages.at[li_flat].set(kp),
+                    cache.v_pages.at[li_flat].set(vp),
+                    cache.block_tables, cache.page_size)
+            else:
+                cache = _cache_write_prompt(cache, li_flat, kg, vg)
+                groups = cfg.n_heads // _kv_heads(cfg)
+                k = _expand_kv(kg, groups, 2)
+                v = _expand_kv(vg, groups, 2)
+                sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+                sc = jnp.where(mask[None, None], sc, -1e30)
+                o = jnp.einsum("bhqk,bkhd->bqhd",
+                               jax.nn.softmax(sc, -1), v)
             x = x + o.reshape(b, s, cfg.d_model) @ lp["wo"]
             h2 = _ln(x, lp["ln2_g"], lp["ln2_b"])
             if cfg.num_experts:

@@ -68,6 +68,18 @@ __all__ = ["DecodeConfig", "DecodeEngine", "DecodeSession"]
 _SENTINEL = object()
 
 
+def _prefill_variant():
+    """Kernel-variant tag carried in every ``decode_prefill`` registry /
+    forensics key: prefill attention rides the Pallas flash kernel on
+    TPU and plain XLA elsewhere, so ``forensics --diff`` across this
+    boundary compares like with like instead of silently overwriting
+    the xla-prefill baseline record with the pallas one (stale manifest
+    entries under the old key are skipped by prewarm, not replayed)."""
+    import jax
+    return ("pallas-prefill" if jax.default_backend() == "tpu"
+            else "xla-prefill")
+
+
 class DecodeConfig(object):
     """Decode-serving knobs. Defaults come from the ``MXNET_DECODE_*``
     config tier; constructor arguments override per engine."""
@@ -348,7 +360,8 @@ class DecodeEngine(object):
         pjit-provenance pools — the only provenance steady-state
         traffic ever presents — so any re-specialization compiles
         here, not on the first request."""
-        include = ([("decode_prefill", {"bucket": int(b)})
+        include = ([("decode_prefill", {"bucket": int(b),
+                                        "kernel": _prefill_variant()})
                     for b in self._cfg.prefill_buckets]
                    + [("decode_step", {"slots": int(n)})
                       for n in self._cfg.slot_buckets])
@@ -375,7 +388,8 @@ class DecodeEngine(object):
                 "decode_prefill", _health.next_cost_key("dec"),
                 prog, pargs,
                 pkey=_pg.ProgramKey("decode_prefill", self._graph_hash,
-                                    {"bucket": int(bucket)}))
+                                    {"bucket": int(bucket),
+                                     "kernel": _prefill_variant()}))
         tok0, self._k_pages, self._v_pages = _pg.warm_twice(
             prog, pargs,
             rebuild=lambda out, a: (a[0], out[1], out[2]) + a[3:])
@@ -880,7 +894,8 @@ class DecodeEngine(object):
 
             prog = _pg.get_or_build(
                 _pg.ProgramKey("decode_prefill", self._graph_hash,
-                               {"bucket": int(bucket)}), build)
+                               {"bucket": int(bucket),
+                                "kernel": _prefill_variant()}), build)
             self._prefill_progs[bucket] = prog
         return prog
 

@@ -1,0 +1,332 @@
+"""Pallas hot-path burn-down: interpret-mode parity for the PR-17
+kernels (flash prefill attention with fused page write, fused
+SGD/Adam optimizer update, int8 im2col conv) plus the kernel-contract
+lint and the kernel_burn_down bench job.
+
+Every kernel under ops/pallas/ is pinned to its pure-lax twin
+(PALLAS_KERNELS registry): the Pallas interpreter result must match
+the twin — bitwise for integer math and page copies, ULP-bounded for
+float update rules, allclose at float32 round-off for online-softmax
+attention — and the off-TPU default dispatch must BE the twin (so
+tier-1 CPU numerics never change).
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (registers nd ops)
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    _flash_fwd_xla, _flash_prefill_xla, flash_attention,
+    flash_prefill_paged)
+from mxnet_tpu.ops.pallas import fused_update as fu  # noqa: E402
+from mxnet_tpu.ops.pallas.fused_update import (  # noqa: E402
+    _adam_fused_xla, _sgd_fused_xla, adam_fused_update, sgd_fused_update)
+from mxnet_tpu.ops.pallas.int8_matmul import (  # noqa: E402
+    _int8_conv_xla, int8_conv_im2col)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# flash attention (dense forward)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("b,h,s,d,causal", [
+    (2, 4, 64, 32, True),
+    (1, 2, 128, 16, False),
+    (2, 3, 96, 8, True),
+])
+def test_flash_attention_interpret_matches_twin(b, h, s, d, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    o = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref, _ = _flash_fwd_xla(q, k, v, causal, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# flash prefill attention + fused page write
+# ---------------------------------------------------------------------------
+
+def _prefill_case(seed, b, s, nh, kvh, hd, ps, num_pages):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s, nh, hd).astype(np.float32))
+    kg = jnp.asarray(rng.randn(b, s, kvh, hd).astype(np.float32))
+    vg = jnp.asarray(rng.randn(b, s, kvh, hd).astype(np.float32))
+    kp = jnp.asarray(rng.randn(num_pages, ps, kvh, hd).astype(np.float32))
+    vp = jnp.asarray(rng.randn(num_pages, ps, kvh, hd).astype(np.float32))
+    n_pb = s // ps
+    # distinct pages per row, leaving some pages untouched
+    bt = jnp.asarray(
+        np.arange(b * n_pb, dtype=np.int32).reshape(b, n_pb))
+    return q, kg, vg, kp, vp, bt
+
+
+@pytest.mark.parametrize("b,s,nh,kvh,hd,ps", [
+    (2, 32, 4, 2, 16, 8),    # GQA, 4 pages/row
+    (1, 16, 2, 2, 8, 16),    # MHA, single page/row
+    (2, 24, 6, 3, 8, 8),     # 3 kv heads, non-pow2 bucket
+])
+def test_flash_prefill_interpret_matches_twin(b, s, nh, kvh, hd, ps):
+    num_pages = 2 * b * (s // ps) + 3
+    q, kg, vg, kp, vp, bt = _prefill_case(1, b, s, nh, kvh, hd, ps,
+                                          num_pages)
+    o, kp_n, vp_n = flash_prefill_paged(q, kg, vg, kp, vp, bt,
+                                        interpret=True)
+    ox, kpx, vpx = _flash_prefill_xla(q, kg, vg, kp, vp, bt)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ox),
+                               rtol=2e-5, atol=2e-5)
+    # the fused page-write epilogue is bitwise: pages are copied, not
+    # recomputed
+    np.testing.assert_array_equal(np.asarray(kp_n), np.asarray(kpx))
+    np.testing.assert_array_equal(np.asarray(vp_n), np.asarray(vpx))
+    # untouched pool pages are preserved via in->out aliasing
+    touched = set(np.asarray(bt).ravel().tolist())
+    for p in range(num_pages):
+        if p not in touched:
+            np.testing.assert_array_equal(np.asarray(kp_n[p]),
+                                          np.asarray(kp[p]))
+
+
+def test_flash_prefill_default_dispatch_is_twin_off_tpu():
+    if jax.default_backend() == "tpu":
+        pytest.skip("off-TPU dispatch contract")
+    q, kg, vg, kp, vp, bt = _prefill_case(2, 2, 32, 4, 2, 16, 8, 11)
+    o, kp_n, vp_n = flash_prefill_paged(q, kg, vg, kp, vp, bt)
+    ox, kpx, vpx = _flash_prefill_xla(q, kg, vg, kp, vp, bt)
+    np.testing.assert_array_equal(np.asarray(o), np.asarray(ox))
+    np.testing.assert_array_equal(np.asarray(kp_n), np.asarray(kpx))
+    np.testing.assert_array_equal(np.asarray(vp_n), np.asarray(vpx))
+
+
+def test_flash_prefill_null_page_warmup_row():
+    """The decode warmup batch maps every page slot to page 0: both the
+    kernel DMA (sequential over ki then j) and the twin's scatter are
+    last-write-wins, so page 0 must hold the LAST position block and
+    the pools must still agree bitwise."""
+    b, s, nh, kvh, hd, ps = 1, 32, 4, 2, 16, 8
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(b, s, nh, hd).astype(np.float32))
+    kg = jnp.asarray(rng.randn(b, s, kvh, hd).astype(np.float32))
+    vg = jnp.asarray(rng.randn(b, s, kvh, hd).astype(np.float32))
+    kp = jnp.asarray(rng.randn(6, ps, kvh, hd).astype(np.float32))
+    vp = jnp.asarray(rng.randn(6, ps, kvh, hd).astype(np.float32))
+    bt = jnp.zeros((b, s // ps), jnp.int32)
+    o, kp_n, vp_n = flash_prefill_paged(q, kg, vg, kp, vp, bt,
+                                        interpret=True)
+    ox, kpx, vpx = _flash_prefill_xla(q, kg, vg, kp, vp, bt)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ox),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(kp_n), np.asarray(kpx))
+    np.testing.assert_array_equal(np.asarray(vp_n), np.asarray(vpx))
+    np.testing.assert_array_equal(np.asarray(kp_n[0]),
+                                  np.asarray(kg[0, -ps:]))
+    # pages beyond slot 0 keep their prior contents
+    np.testing.assert_array_equal(np.asarray(kp_n[1:]),
+                                  np.asarray(kp[1:]))
+
+
+def test_flash_prefill_validations():
+    q, kg, vg, kp, vp, bt = _prefill_case(4, 1, 16, 2, 2, 8, 16, 5)
+    with pytest.raises(ValueError, match="not a multiple of page_size"):
+        flash_prefill_paged(q[:, :12], kg[:, :12], vg[:, :12],
+                            kp, vp, bt)
+    with pytest.raises(ValueError, match="pages/row"):
+        flash_prefill_paged(q, kg, vg, kp, vp, bt[:, :0])
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update
+# ---------------------------------------------------------------------------
+
+_SGD_H = {"lr": 0.05, "wd": 1e-4, "rescale_grad": 1.0 / 32,
+          "momentum": 0.9, "clip_gradient": 1.0}
+_ADAM_H = {"lr": 1e-3, "wd": 1e-4, "rescale_grad": 1.0,
+           "beta1": 0.9, "one_minus_beta1": 0.1,
+           "beta2": 0.999, "one_minus_beta2": 0.001,
+           "epsilon": 1e-8}
+
+
+def _wg(seed, shape):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(*shape).astype(np.float32)),
+            jnp.asarray(rng.randn(*shape).astype(np.float32)),
+            jnp.asarray(rng.randn(*shape).astype(np.float32)))
+
+
+def _check_update(rule, h, w, g, state, out_w, out_s):
+    """Interpret-mode parity vs the jitted lax twin, pinned in ULPs:
+    XLA:CPU's FMA-contraction choices depend on operand shape/layout,
+    so the interpreter's (rows, 128) ref plumbing can shift state by a
+    ULP, which ``w + mom`` amplifies to a few ULPs of the (smaller)
+    weight. The BITWISE guarantee lives in the dispatcher — off-TPU the
+    public entry points run the twin itself (asserted in
+    test_fused_rule_knob_selects_pallas)."""
+    ref_w, ref_s = jax.jit(
+        lambda w, g, s: rule(w, g, s, h))(w, g, tuple(state))
+    np.testing.assert_array_max_ulp(np.asarray(out_w),
+                                    np.asarray(ref_w), maxulp=16)
+    for a, b in zip(out_s, ref_s):
+        np.testing.assert_array_max_ulp(np.asarray(a), np.asarray(b),
+                                        maxulp=2)
+
+
+@pytest.mark.parametrize("shape", [(1,), (7,), (128, 130), (3, 5, 17)])
+def test_sgd_fused_update_interpret_parity(shape):
+    w, g, m = _wg(5, shape)
+    out_w, out_s = sgd_fused_update(w, g, (m,), _SGD_H, interpret=True)
+    _check_update(_sgd_fused_xla, _SGD_H, w, g, (m,), out_w, out_s)
+
+
+def test_sgd_fused_update_stateless_interpret_parity():
+    h = {"lr": 0.05, "wd": 1e-4, "rescale_grad": 1.0}
+    w, g, _ = _wg(6, (33, 9))
+    out_w, out_s = sgd_fused_update(w, g, (), h, interpret=True)
+    assert out_s == ()
+    _check_update(_sgd_fused_xla, h, w, g, (), out_w, out_s)
+
+
+@pytest.mark.parametrize("shape", [(1,), (64, 33), (2, 3, 40)])
+def test_adam_fused_update_interpret_parity(shape):
+    w, g, mean = _wg(7, shape)
+    var = jnp.abs(_wg(8, shape)[0])
+    out_w, out_s = adam_fused_update(w, g, (mean, var), _ADAM_H,
+                                     interpret=True)
+    _check_update(_adam_fused_xla, _ADAM_H, w, g, (mean, var),
+                  out_w, out_s)
+
+
+def test_fused_update_hyper_change_no_recompile():
+    """Hypers ride in as a stacked f32 vector, so sweeping lr/wd must
+    not grow the jit cache (the zero-compiles-after-warmup contract of
+    the fused train step)."""
+    w, g, m = _wg(9, (64, 33))
+    h = dict(_SGD_H)
+    sgd_fused_update(w, g, (m,), h, interpret=True)
+    size = fu._fused_update._cache_size()
+    for lr in (0.1, 0.01, 0.003):
+        h = dict(h, lr=lr, wd=lr / 10)
+        sgd_fused_update(w, g, (m,), h, interpret=True)
+    assert fu._fused_update._cache_size() == size
+
+
+def test_fused_rule_knob_selects_pallas(monkeypatch):
+    from mxnet_tpu.optimizer import (Adam, SGD, _adam_fused,
+                                     _adam_fused_pallas, _sgd_fused,
+                                     _sgd_fused_pallas)
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_UPDATE", "0")
+    assert SGD(momentum=0.9).fused_rule() is _sgd_fused
+    assert Adam().fused_rule() is _adam_fused
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_UPDATE", "1")
+    assert SGD(momentum=0.9).fused_rule() is _sgd_fused_pallas
+    assert Adam().fused_rule() is _adam_fused_pallas
+    # off-TPU the pallas rule dispatches straight to the lax rule, so
+    # tier-1 training numerics are bitwise-unchanged by the knob
+    if jax.default_backend() != "tpu":
+        w, g, m = _wg(10, (17, 5))
+        a_w, a_s = _sgd_fused_pallas(w, g, (m,), _SGD_H)
+        b_w, b_s = _sgd_fused(w, g, (m,), _SGD_H)
+        np.testing.assert_array_equal(np.asarray(a_w), np.asarray(b_w))
+        np.testing.assert_array_equal(np.asarray(a_s[0]),
+                                      np.asarray(b_s[0]))
+
+
+# ---------------------------------------------------------------------------
+# int8 im2col conv
+# ---------------------------------------------------------------------------
+
+def _conv_case(seed, b, cin, hw, cout, k, zero_channel=False):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(
+        rng.randint(-127, 128, (b, cin, hw, hw)).astype(np.int8))
+    wq = jnp.asarray(
+        rng.randint(-127, 128, (cout,) + k).astype(np.int8))
+    scale = rng.rand(cout).astype(np.float32) * 0.01 + 1e-4
+    if zero_channel:
+        scale[1] = 0.0
+    return q, wq, jnp.asarray(scale)
+
+
+@pytest.mark.parametrize(
+    "cin,hw,cout,kh,stride,dilate,pad,groups,zero_ch", [
+        (3, 8, 4, 3, (1, 1), (1, 1), (0, 0), 1, False),
+        (4, 9, 6, 3, (2, 2), (2, 2), (1, 1), 2, False),
+        (3, 7, 4, 1, (1, 1), (1, 1), (0, 0), 1, False),
+        (2, 8, 4, 3, (1, 1), (1, 1), (1, 1), 1, True),
+    ])
+def test_int8_conv_im2col_interpret_bitwise(cin, hw, cout, kh, stride,
+                                            dilate, pad, groups,
+                                            zero_ch):
+    q, wq, scale = _conv_case(11, 2, cin, hw, cout,
+                              (cin // groups, kh, kh), zero_ch)
+    out = int8_conv_im2col(q, wq, scale, stride, dilate, pad,
+                           num_group=groups, interpret=True)
+    ref = _int8_conv_xla(q, wq, scale, stride, dilate, pad, groups)
+    # int32 accumulation + one f32 rescale on both routes -> bitwise
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    if zero_ch:
+        np.testing.assert_array_equal(np.asarray(out[:, 1]), 0.0)
+
+
+def test_quantized_conv_int8_op_im2col_route(monkeypatch):
+    """MXNET_INT8_CONV_IM2COL=1 swaps _contrib_quantized_conv_int8 onto
+    the im2col-MXU route; off-TPU both routes are exact int32 conv +
+    per-channel rescale, so the op output must be bitwise identical."""
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.quantize.ptq import _per_channel_quantize
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+    bias = rng.randn(4).astype(np.float32)
+    wq, ws = _per_channel_quantize(w)
+    op = get_op("_contrib_quantized_conv_int8").fn
+    kw = dict(kernel=(3, 3), num_filter=4,
+              act_scale=float(127.0 / np.abs(x).max()))
+    monkeypatch.delenv("MXNET_INT8_CONV_IM2COL", raising=False)
+    ref = np.asarray(op(jnp.asarray(x), jnp.asarray(wq),
+                        jnp.asarray(ws), jnp.asarray(bias), **kw))
+    monkeypatch.setenv("MXNET_INT8_CONV_IM2COL", "1")
+    out = np.asarray(op(jnp.asarray(x), jnp.asarray(wq),
+                        jnp.asarray(ws), jnp.asarray(bias), **kw))
+    np.testing.assert_array_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# kernel contract lint + bench job + prefill variant tag
+# ---------------------------------------------------------------------------
+
+def test_kernel_contract_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_pallas_contracts",
+        os.path.join(ROOT, "tools", "check_pallas_contracts.py"))
+    modl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(modl)
+    drift = modl.check()
+    assert all(not v for v in drift.values()), drift
+
+
+def test_kernel_burn_down_job_registered():
+    from mxnet_tpu import benchmark
+    assert "kernel_burn_down" in benchmark.JOBS
+    assert "kernel_burn_down" in benchmark.JOB_PRIORITY
+    assert callable(benchmark.kernel_burn_down)
+
+
+def test_prefill_variant_tag_in_program_key():
+    from mxnet_tpu.serve.decode import _prefill_variant
+    from mxnet_tpu.programs import ProgramKey
+    if jax.default_backend() != "tpu":
+        assert _prefill_variant() == "xla-prefill"
+    tagged = ProgramKey("decode_prefill", "g",
+                        {"bucket": 128, "kernel": _prefill_variant()})
+    untagged = ProgramKey("decode_prefill", "g", {"bucket": 128})
+    assert tagged.fingerprint != untagged.fingerprint

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Static check: the Pallas kernel contract under ``ops/pallas/``.
+
+Every Pallas kernel module declares a ``PALLAS_KERNELS`` dict mapping
+each EXPORTED kernel entry point (a name in ``__all__`` whose call
+graph reaches ``pallas_call``) to its module-level pure-lax twin. The
+contract the repo's numerics rest on — Mosaic kernel on TPU, lax twin
+off-TPU, interpret-mode parity tests pinning the two together — has
+until now been convention only; this lint makes it load-bearing:
+
+* every exported function that (transitively, within the module)
+  reaches ``pallas_call`` must be registered in ``PALLAS_KERNELS``;
+* every registered twin must exist at module level and must NOT touch
+  ``pallas_call`` (a twin that dispatches back into the kernel proves
+  nothing);
+* every registered kernel must have a parity test under ``tests/``:
+  a call of the kernel with an ``interpret=True`` keyword (forcing the
+  Pallas interpreter) in a file that also references the twin by name;
+* the kernel inventory table under the ``<!-- pallas-kernels -->``
+  marker in docs/observability.md must list exactly the registered
+  kernels (same drift contract as check_metrics_docs.py).
+
+Run directly (CI) or via
+tests/test_pallas_kernels.py::test_kernel_contract_lint.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PALLAS_DIR = os.path.join(ROOT, "mxnet_tpu", "ops", "pallas")
+TESTS_DIR = os.path.join(ROOT, "tests")
+DOC = os.path.join(ROOT, "docs", "observability.md")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _str_list(node):
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return None
+
+
+def _module_info(path):
+    """Parse one ops/pallas module: (exports, registry, reaches,
+    functions) where ``reaches`` is the set of module-level function
+    names whose call graph (within the module) hits ``pallas_call``."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    exports, registry, funcs = [], {}, {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    exports = _str_list(node.value) or []
+                if isinstance(tgt, ast.Name) and tgt.id == "PALLAS_KERNELS" \
+                        and isinstance(node.value, ast.Dict):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(v, ast.Constant):
+                            registry[k.value] = v.value
+        if isinstance(node, ast.FunctionDef):
+            direct = False
+            calls = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    if isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "pallas_call":
+                        direct = True
+                    elif isinstance(sub.func, ast.Name):
+                        if sub.func.id == "pallas_call":
+                            direct = True
+                        calls.add(sub.func.id)
+                elif isinstance(sub, ast.Attribute) \
+                        and sub.attr == "pallas_call":
+                    direct = True        # functools.partial(pl.pallas_call)
+            funcs[node.name] = (direct, calls)
+    reaches = {n for n, (d, _) in funcs.items() if d}
+    changed = True
+    while changed:                       # transitive closure
+        changed = False
+        for n, (_, calls) in funcs.items():
+            if n not in reaches and calls & reaches:
+                reaches.add(n)
+                changed = True
+    return exports, registry, reaches, set(funcs)
+
+
+def _test_coverage(kernels, twins):
+    """(kernels with an interpret=True call in tests/, kernel -> set of
+    test files calling it, twins referenced anywhere in tests/)."""
+    interp_called, twin_seen = set(), set()
+    for fn in sorted(os.listdir(TESTS_DIR)):
+        if not (fn.startswith("test") and fn.endswith(".py")):
+            continue
+        path = os.path.join(TESTS_DIR, fn)
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        for t in twins:
+            if t in src:
+                twin_seen.add(t)
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if name in kernels and any(
+                    kw.arg == "interpret"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords):
+                interp_called.add(name)
+    return interp_called, twin_seen
+
+
+def _doc_kernels():
+    """Backticked first-cell tokens of the table after the
+    ``<!-- pallas-kernels -->`` marker in docs/observability.md."""
+    names = set()
+    in_table = armed = False
+    with open(DOC, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if "<!-- pallas-kernels -->" in line:
+                armed = True
+                continue
+            if not armed:
+                continue
+            if line.startswith("|"):
+                in_table = True
+                cells = line.split("|")
+                if len(cells) >= 2:
+                    for tok in re.findall(r"`([^`]+)`", cells[1]):
+                        if _NAME_RE.match(tok.strip()):
+                            names.add(tok.strip())
+            elif in_table:
+                break
+    return names
+
+
+def check():
+    """Returns a dict of contract violations; all empty means every
+    exported Pallas kernel carries its full contract."""
+    unregistered, twin_missing, twin_impure = [], [], []
+    registry_stale = []
+    all_kernels, all_twins = {}, {}
+    for fn in sorted(os.listdir(PALLAS_DIR)):
+        if not fn.endswith(".py") or fn == "__init__.py":
+            continue
+        path = os.path.join(PALLAS_DIR, fn)
+        exports, registry, reaches, funcs = _module_info(path)
+        for name in exports:
+            if name in reaches and name not in registry:
+                unregistered.append("%s:%s" % (fn, name))
+        for kern, twin in registry.items():
+            if kern not in funcs or kern not in exports:
+                registry_stale.append("%s:%s" % (fn, kern))
+                continue
+            all_kernels[kern] = fn
+            all_twins[kern] = twin
+            if twin not in funcs:
+                twin_missing.append("%s:%s -> %s" % (fn, kern, twin))
+            elif twin in reaches:
+                twin_impure.append("%s:%s -> %s" % (fn, kern, twin))
+    interp_called, twin_seen = _test_coverage(
+        set(all_kernels), set(all_twins.values()))
+    parity_missing = sorted(
+        "%s:%s" % (all_kernels[k], k)
+        for k in all_kernels if k not in interp_called)
+    twin_untested = sorted(
+        "%s:%s -> %s" % (all_kernels[k], k, all_twins[k])
+        for k in all_kernels if all_twins[k] not in twin_seen)
+    doc = _doc_kernels()
+    return {
+        "kernels_unregistered": sorted(unregistered),
+        "registry_stale": sorted(registry_stale),
+        "twin_missing": sorted(twin_missing),
+        "twin_touches_pallas_call": sorted(twin_impure),
+        "parity_test_missing": parity_missing,
+        "twin_unreferenced_in_tests": twin_untested,
+        "kernels_undocumented": sorted(set(all_kernels) - doc),
+        "kernels_stale_in_docs": sorted(doc - set(all_kernels)),
+    }
+
+
+def main():
+    drift = check()
+    ok = True
+    for kind, names in sorted(drift.items()):
+        if names:
+            ok = False
+            print("%s (%d):" % (kind, len(names)))
+            for n in names:
+                print("  - %s" % n)
+    if not ok:
+        print("\nops/pallas/ kernel contract violated: every exported "
+              "kernel reaching pallas_call needs a PALLAS_KERNELS entry "
+              "naming a module-level pure-lax twin, an interpret=True "
+              "parity test in tests/ referencing that twin, and a row "
+              "in docs/observability.md's pallas-kernels table.")
+        return 1
+    print("ok: %d Pallas kernels with twins, parity tests, and doc "
+          "rows in sync" % len(_doc_kernels()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
