@@ -2635,6 +2635,280 @@ def quantized_serve(offered_rps=240, clients=16, duration=2.5,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+_FLEET_BUILDER_SRC = '''\
+"""fleet_serve bench replica builder: tiny MLP registry for /predict
+plus a small decode transformer for /generate (prefix affinity needs
+real decode traffic). Written to the bench workdir and imported by
+each replica subprocess via the fleet spec."""
+import numpy as np
+
+
+def build(spec):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.transformer import (TransformerConfig,
+                                                init_transformer_params)
+    from mxnet_tpu.serve import (DecodeConfig, DecodeEngine,
+                                 ModelRegistry)
+
+    feature, hidden, classes = 64, 64, 16
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    sym = mx.sym.softmax(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+        name="prob")
+    rng = np.random.RandomState(0)
+    import os
+    path = "%s/m-%d.params" % (spec["workdir"], os.getpid())
+    mx.nd.save(path, {
+        "arg:fc1_weight": mx.nd.array(
+            rng.randn(hidden, feature).astype(np.float32) * 0.05),
+        "arg:fc1_bias": mx.nd.array(np.zeros(hidden, np.float32)),
+        "arg:fc2_weight": mx.nd.array(
+            rng.randn(classes, hidden).astype(np.float32) * 0.05),
+        "arg:fc2_bias": mx.nd.array(np.zeros(classes, np.float32))})
+    with open(path, "rb") as f:
+        blob = f.read()
+    reg = ModelRegistry(sym.tojson(), blob,
+                        input_shapes={"data": (1, feature)})
+    reg.warmup()
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_kv_heads=2,
+        n_layers=2, d_ff=256, max_len=128, pos_type="rope",
+        dtype=jnp.float32)
+    dev = np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "sp", "tp", "pp", "ep"))
+    params, _ = init_transformer_params(cfg, mesh, seed=0)
+    dcfg = DecodeConfig(slots=4, page_size=16, num_pages=128,
+                        max_context=128, queue_depth=64,
+                        max_new_tokens=16, default_timeout_ms=60000)
+    eng = DecodeEngine(params, cfg, dcfg).start()
+    eng.warmup()
+    return reg, eng
+'''
+
+
+def fleet_serve(low_rps=20, high_rps=120, clients=8, phase_s=5.0,
+                prefix_families=8, max_replicas=3, prefix_tokens=16,
+                vocab=512):
+    """The fleet tier under a diurnal load hump: one router frontend
+    over an autoscaled replica fleet (real subprocesses, real
+    ``/alerts`` + queue-depth signal polling), offered load ramping
+    low -> high -> low while we bank serve p50/p99 against the
+    ``serve_p99`` SLO, the replica-count trace (did the fleet TRACK
+    the hump, with hysteresis, instead of flapping?), scale-up latency
+    split warm (warmset manifest present when the replica spawned) vs
+    cold, and the ``/generate`` prefix-affinity hit fraction. RAISES
+    if any replica alive at the end compiled anything after its
+    warmup — the zero-compile serving contract must hold for every
+    replica the autoscaler ever spawned, including mid-ramp ones."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+    import urllib.error
+    from . import config as _config_mod
+    from . import telemetry as _tm
+    from .serve import Fleet, serve_router
+
+    workdir = tempfile.mkdtemp(prefix="fleet_serve_")
+    try:
+        with open(os.path.join(workdir, "fleet_bench_builder.py"),
+                  "w") as f:
+            f.write(_FLEET_BUILDER_SRC)
+        cache = os.path.join(workdir, "compile_cache")
+        os.makedirs(cache, exist_ok=True)
+        spec = {"builder": "fleet_bench_builder:build",
+                "pythonpath": [workdir],
+                "workdir": workdir,
+                "env": {"MXNET_COMPILE_CACHE_DIR": cache}}
+        slo_ms = float(_config_mod.get("MXNET_SLO_SERVE_P99_MS"))
+        fleet = Fleet(spec, os.path.join(workdir, "wd"),
+                      min_replicas=1, max_replicas=max_replicas,
+                      interval_s=0.25, scale_up_s=1.0,
+                      scale_down_s=4.0, cooldown_s=2.0,
+                      queue_up=1.0, queue_down=0.25)
+        rng = np.random.RandomState(0)
+        heads = [list(map(int, rng.randint(0, vocab, (prefix_tokens,))))
+                 for _ in range(prefix_families)]
+        results = []                    # (t, path, status, latency_s)
+        trace = []                      # (t, live, target)
+        baselines = {}                  # name -> (port, compiles, warm)
+        stop = threading.Event()
+        t_start = time.time()           # rebased once replica 1 is up
+        total_s = 4 * phase_s           # low, ramp, high, ramp-down
+
+        def _offered(t):
+            # one diurnal hump: low -> linear ramp -> high plateau ->
+            # linear ramp back down
+            if t < phase_s:
+                return low_rps
+            if t < 2 * phase_s:
+                return low_rps + (high_rps - low_rps) \
+                    * (t - phase_s) / phase_s
+            if t < 3 * phase_s:
+                return high_rps
+            return high_rps - (high_rps - low_rps) \
+                * (t - 3 * phase_s) / phase_s
+
+        def _post(path, payload):
+            req = urllib.request.Request(
+                front.url + path, data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+            except (OSError, urllib.error.URLError):
+                return -1
+
+        def _scrape(port, name):
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:%d/metrics" % port,
+                        timeout=5) as r:
+                    body = r.read().decode()
+            except (OSError, urllib.error.URLError):
+                return None
+            for line in body.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            return 0.0
+
+        def _client(idx):
+            crng = np.random.RandomState(100 + idx)
+            while not stop.is_set():
+                t = time.time() - t_start
+                if t >= total_s:
+                    return
+                rps = max(1.0, _offered(t))
+                if crng.rand() < 0.3:
+                    head = heads[crng.randint(len(heads))]
+                    payload = {"prompt": head + list(map(int,
+                               crng.randint(0, vocab, (4,)))),
+                               "max_new_tokens": 4, "stream": False,
+                               "timeout_ms": 30000}
+                    path = "/generate"
+                else:
+                    payload = {"inputs": {"data": crng.randn(
+                        1, 64).astype(np.float32).tolist()},
+                        "timeout_ms": 30000}
+                    path = "/predict"
+                q0 = time.perf_counter()
+                status = _post(path, payload)
+                results.append((t, path, status,
+                                time.perf_counter() - q0))
+                stop.wait(max(0.0, clients / rps
+                              - (time.perf_counter() - q0)))
+
+        def _sampler():
+            while not stop.wait(0.2):
+                st = fleet.status()
+                trace.append((round(time.time() - t_start, 2),
+                              st["live"], st["target"]))
+                for rep in st["replicas"]:
+                    if rep["port"] and rep["name"] not in baselines:
+                        c = _scrape(rep["port"],
+                                    "mxnet_jit_backend_compile_total")
+                        if c is not None:
+                            baselines[rep["name"]] = (
+                                rep["port"], c, rep["warm"],
+                                rep["spawn_s"])
+
+        hits0 = _tm.counter("router/affinity_hits_total",
+                            "served by the prefix-pinned replica").value
+        fleet.start()
+        front = serve_router(fleet.router, port=0)
+        try:
+            sampler = threading.Thread(target=_sampler, daemon=True)
+            sampler.start()
+            # bank replica 1's baseline before traffic starts
+            deadline = time.time() + 120
+            while time.time() < deadline and not baselines:
+                time.sleep(0.1)
+            # the diurnal clock starts when the fleet can take traffic,
+            # not when it starts SPAWNING (a cold first replica would
+            # otherwise eat the whole schedule)
+            t_start = time.time()
+            threads = [threading.Thread(target=_client, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=total_s + 120)
+            stop.set()
+            sampler.join(timeout=10)
+
+            compiles = {}
+            alive = {r["name"]: r for r in fleet.status()["replicas"]}
+            for name, (port, base, _warm, _sp) in baselines.items():
+                if name not in alive:
+                    continue            # killed or drained: unscrapable
+                now_c = _scrape(port,
+                                "mxnet_jit_backend_compile_total")
+                if now_c is not None:
+                    compiles[name] = now_c - base
+            if any(compiles.values()):
+                raise RuntimeError(
+                    "replica(s) compiled after warmup under the ramp: "
+                    "%r — the fleet leaks compiles mid-scale" % compiles)
+        finally:
+            stop.set()
+            front.close()
+            fleet.close()
+
+        ok = [(t, p, lat) for t, p, s, lat in results if s == 200]
+        if not ok:
+            raise RuntimeError("no request succeeded; nothing to bank")
+        lat_all = np.array([lat for _t, _p, lat in ok])
+        peak = [lat for t, _p, lat in ok
+                if 2 * phase_s <= t < 3 * phase_s]
+        n_gen = sum(1 for _t, p, _l in ok if p == "/generate")
+        hits = _tm.counter("router/affinity_hits_total",
+                           "served by the prefix-pinned replica"
+                           ).value - hits0
+        spawn_warm = [sp for _p, _c, w, sp in baselines.values()
+                      if w and sp]
+        spawn_cold = [sp for _p, _c, w, sp in baselines.values()
+                      if not w and sp]
+        p99_ms = round(float(np.percentile(lat_all, 99)) * 1e3, 3)
+        rps = len(ok) / total_s
+        extra = {
+            "low_rps": low_rps, "high_rps": high_rps,
+            "clients": clients, "duration_s": total_s,
+            "p50_ms": round(float(np.percentile(lat_all, 50)) * 1e3, 3),
+            "p99_ms": p99_ms,
+            "peak_p99_ms": (round(float(np.percentile(
+                peak, 99)) * 1e3, 3) if peak else None),
+            "slo_p99_ms": slo_ms,
+            "slo_held": bool(p99_ms <= slo_ms),
+            "errors": sum(1 for _t, _p, s, _l in results if s != 200),
+            "replica_trace": trace[:600],
+            "max_replicas_reached": max((live for _t, live, _tg
+                                         in trace), default=1),
+            "spawn_warm_s": [round(s, 3) for s in spawn_warm],
+            "spawn_cold_s": [round(s, 3) for s in spawn_cold],
+            "generate_requests": n_gen,
+            "affinity_hit_fraction": (round(hits / n_gen, 3)
+                                      if n_gen else None),
+            "compiles_after_warmup": compiles,
+        }
+        return rps, extra
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # job registry + CLI
 
@@ -2834,6 +3108,16 @@ def _job_quantized_serve():
                    "rows + top-1 agreement in extras)", x)
 
 
+def _job_fleet_serve():
+    v, x = fleet_serve()
+    return persist("fleet_serve_req_per_sec", v,
+                   "req/s (diurnal ramp through the router over an "
+                   "autoscaled replica fleet; p50/p99 vs SLO, "
+                   "replica-count trace, warm-vs-cold spawn latency, "
+                   "prefix-affinity hit fraction in extras; raises on "
+                   "any after-warmup replica compile)", x)
+
+
 def _make_infer_job(model, dtype, batch=32):
     def job():
         v, x = infer_score(model, batch, dtype)
@@ -2860,6 +3144,7 @@ JOBS = {
     "predictor_serve": _job_predictor_serve,
     "quantized_serve": _job_quantized_serve,
     "decode_serve": _job_decode_serve,
+    "fleet_serve": _job_fleet_serve,
     "data_pipeline": _job_data_pipeline,
     "transformer_lm": _job_transformer_lm,
     "data_pipeline_native": _job_data_pipeline_native,
@@ -2895,6 +3180,7 @@ JOB_PRIORITY = [
     "predictor_serve",
     "quantized_serve",
     "decode_serve",
+    "fleet_serve",
     "data_pipeline",
     "data_pipeline_native",
     "resnet50_train",

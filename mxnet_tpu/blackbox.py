@@ -82,6 +82,16 @@ EVENTS = {
                  "two captures of the same program (split fusion, new "
                  "copy, boundary-bytes growth; a/b fingerprints + the "
                  "regression list)",
+    "scale_up": "the fleet autoscaler spawned a replica (reason: SLO "
+                "burn / queue growth / re-convergence to target; the "
+                "replica name and live count ride along)",
+    "scale_down": "the fleet autoscaler retired a replica on sustained "
+                  "slack (drained via the router before SIGTERM)",
+    "replica_death": "a fleet replica exited without being retired "
+                     "(rc, preempt-vs-failure triage verdict, respawn "
+                     "decision) — read together with the dead replica's "
+                     "own ring, whose last fault record names the "
+                     "killer",
 }
 
 _lock = threading.Lock()

@@ -44,7 +44,8 @@ from .base import MXNetError
 __all__ = ["ShardedCheckpointManager", "save_sharded", "restore_sharded",
            "atomic_writer", "write_manifest", "manifest_path",
            "verify_checkpoint", "load_latest_valid", "list_checkpoints",
-           "ResumeState", "TrainingSupervisor", "CheckpointCorruptError"]
+           "ResumeState", "TrainingSupervisor", "ProcessSupervisor",
+           "CheckpointCorruptError"]
 
 MANIFEST_FORMAT = 1
 
@@ -450,6 +451,109 @@ def restore_sharded(directory, step=None, like=None):
 # auto-resume supervisor
 # ---------------------------------------------------------------------------
 
+class ProcessSupervisor(object):
+    """Relaunch/triage policy for a supervised child process — the ONE
+    implementation shared by :meth:`TrainingSupervisor.supervise` (the
+    blocking re-run-same-command loop for preemptible training jobs)
+    and the serving fleet's replica management (``serve/fleet.py``,
+    which owns many children at once and calls :meth:`triage` per
+    death instead of blocking in :meth:`run`).
+
+    Policy (unchanged from the original supervise loop):
+
+    * **preemption-grade** exits — negative rc (Popen's signal-death
+      encoding) or 137/143 (the 128+signum shell convention for
+      SIGKILL/SIGTERM) — mean the *platform* killed the process. They
+      always relaunch and reset the consecutive-failure count: on
+      preemptible TPU VMs this is the normal failure mode and must
+      never exhaust a failure budget.
+    * any other nonzero rc is a **genuine failure** (an uncaught
+      exception): relaunching replays the same bug, so stop after
+      ``max_failures`` consecutive failures
+      (``MXNET_SUPERVISOR_MAX_FAILURES``).
+
+    Every relaunch decision counts in
+    ``supervisor/relaunches_total{reason}`` (reason preempt/failure).
+    """
+
+    PREEMPT_RCS = frozenset((137, 143))
+
+    def __init__(self, max_failures=None, relaunch_delay_s=1.0,
+                 logger=None):
+        import logging
+        from .config import get as _cfg
+        self.max_failures = (int(_cfg("MXNET_SUPERVISOR_MAX_FAILURES"))
+                             if max_failures is None else int(max_failures))
+        self.relaunch_delay_s = float(relaunch_delay_s)
+        self.failures = 0            # consecutive genuine failures
+        self._log = logger or logging
+
+    @staticmethod
+    def is_preemption_rc(rc):
+        """Whether exit code ``rc`` is a preemption-grade death (signal
+        kill) rather than a genuine failure (an uncaught exception's
+        nonzero exit)."""
+        return rc < 0 or rc in ProcessSupervisor.PREEMPT_RCS
+
+    def note_success(self):
+        """A supervised child made clean progress: the consecutive-
+        failure budget resets (fleet replicas call this on ready)."""
+        self.failures = 0
+
+    def triage(self, rc, what="supervised command"):
+        """Classify one nonzero exit and decide the relaunch.
+
+        Returns ``(reason, relaunch)``: reason is ``"preempt"`` or
+        ``"failure"``; ``relaunch`` False means the consecutive-failure
+        budget is exhausted and the caller should stop (give up / mark
+        the fleet degraded). A relaunch decision bumps
+        ``supervisor/relaunches_total{reason}``.
+        """
+        from . import telemetry as _tm
+        if self.is_preemption_rc(rc):
+            reason, relaunch = "preempt", True
+            self.failures = 0
+            self._log.info("%s died preemption-grade (rc %d, signal "
+                           "kill); relaunching", what, rc)
+        else:
+            reason = "failure"
+            self.failures += 1
+            relaunch = self.failures < self.max_failures
+            if relaunch:
+                self._log.warning("%s failed (rc %d, %d/%d failures); "
+                                  "relaunching", what, rc, self.failures,
+                                  self.max_failures)
+            else:
+                self._log.error(
+                    "%s failed %d consecutive time(s) with genuine "
+                    "(non-signal) exits, last rc %d; giving up "
+                    "(MXNET_SUPERVISOR_MAX_FAILURES=%d)", what,
+                    self.failures, rc, self.max_failures)
+        if relaunch and _tm._enabled:
+            _tm.counter("supervisor/relaunches_total",
+                        "Supervised training command relaunches",
+                        ("reason",)).labels(reason).inc()
+        return reason, relaunch
+
+    def run(self, cmd, env=None, cwd=None):
+        """Blocking re-run loop: re-run ``cmd`` until it exits cleanly
+        (returns 0) or the failure budget is exhausted (returns the
+        last rc). The script inside is expected to make its own
+        progress durable (``fit(resume=True)`` / a ``--restore``
+        server)."""
+        import subprocess
+        import time as _time
+        while True:
+            rc = subprocess.call(cmd, env=env, cwd=cwd)
+            if rc == 0:
+                return 0
+            _reason, relaunch = self.triage(rc)
+            if not relaunch:
+                return rc
+            if self.relaunch_delay_s > 0:
+                _time.sleep(self.relaunch_delay_s)
+
+
 class TrainingSupervisor(object):
     """Fault-tolerant shell around ``module.fit``: every ``fit`` call
     checkpoints to ``prefix`` and resumes from the latest valid
@@ -495,14 +599,14 @@ class TrainingSupervisor(object):
     # training script is broken": raw signal deaths (Popen reports them
     # as -signum) and the 128+signum shell convention for SIGKILL
     # (preemption / OOM-killer) and SIGTERM (preemption notice)
-    _PREEMPT_RCS = frozenset((137, 143))
+    _PREEMPT_RCS = ProcessSupervisor.PREEMPT_RCS
 
     @staticmethod
     def is_preemption_rc(rc):
         """Whether exit code ``rc`` is a preemption-grade death (signal
         kill) rather than a genuine failure (an uncaught exception's
         nonzero exit)."""
-        return rc < 0 or rc in TrainingSupervisor._PREEMPT_RCS
+        return ProcessSupervisor.is_preemption_rc(rc)
 
     @staticmethod
     def supervise(cmd, max_failures=None, relaunch_delay_s=1.0,
@@ -525,41 +629,11 @@ class TrainingSupervisor(object):
         A successful-looking relaunch (preemption or clean progress)
         resets the consecutive-failure count. Relaunches count in
         ``supervisor/relaunches_total{reason}``.
+
+        The triage policy itself lives in :class:`ProcessSupervisor`
+        (the serving fleet shares it for replica deaths); this entry
+        point is a thin delegation kept behavior-identical.
         """
-        import logging
-        import subprocess
-        import time as _time
-        from . import telemetry as _tm
-        from .config import get as _cfg
-        log = logger or logging
-        if max_failures is None:
-            max_failures = int(_cfg("MXNET_SUPERVISOR_MAX_FAILURES"))
-        failures = 0
-        while True:
-            rc = subprocess.call(cmd, env=env, cwd=cwd)
-            if rc == 0:
-                return 0
-            if TrainingSupervisor.is_preemption_rc(rc):
-                reason = "preempt"
-                failures = 0
-                log.info("supervised command died preemption-grade "
-                         "(rc %d, signal kill); relaunching", rc)
-            else:
-                reason = "failure"
-                failures += 1
-                if failures >= max_failures:
-                    log.error(
-                        "supervised command failed %d consecutive "
-                        "time(s) with genuine (non-signal) exits, last "
-                        "rc %d; giving up (MXNET_SUPERVISOR_MAX_"
-                        "FAILURES=%d)", failures, rc, max_failures)
-                    return rc
-                log.warning("supervised command failed (rc %d, %d/%d "
-                            "failures); relaunching", rc, failures,
-                            max_failures)
-            if _tm._enabled:
-                _tm.counter("supervisor/relaunches_total",
-                            "Supervised training command relaunches",
-                            ("reason",)).labels(reason).inc()
-            if relaunch_delay_s > 0:
-                _time.sleep(relaunch_delay_s)
+        return ProcessSupervisor(
+            max_failures=max_failures, relaunch_delay_s=relaunch_delay_s,
+            logger=logger).run(cmd, env=env, cwd=cwd)

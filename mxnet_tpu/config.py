@@ -137,6 +137,73 @@ VARS = {
                                     "(quantize/shadow_drift). Mirrors "
                                     "run on a side thread and never "
                                     "delay or fail primary requests."),
+    "MXNET_FLEET_MIN_REPLICAS": (int, 1,
+                                 "Fleet tier lower bound: the autoscaler "
+                                 "never retires below this many live "
+                                 "replicas (serve.fleet)."),
+    "MXNET_FLEET_MAX_REPLICAS": (int, 4,
+                                 "Fleet tier upper bound: the autoscaler "
+                                 "never spawns past this many replicas."),
+    "MXNET_FLEET_PREFIX_TOKENS": (int, 16,
+                                  "Prompt-head length the router hashes "
+                                  "for /generate prefix affinity: "
+                                  "requests sharing their first N "
+                                  "tokens pin to one replica's KV/"
+                                  "prefix-cache locality domain."),
+    "MXNET_FLEET_AFFINITY_SLACK": (int, 4,
+                                   "Affinity yields to load: when the "
+                                   "pinned replica carries this many "
+                                   "more outstanding requests than the "
+                                   "least-loaded one, the router "
+                                   "breaks affinity for the request "
+                                   "(router/affinity_yields_total)."),
+    "MXNET_FLEET_FORWARD_RETRIES": (int, 2,
+                                    "Router forward retries across "
+                                    "OTHER replicas after a connection "
+                                    "failure ejects the picked one "
+                                    "(only before any response byte "
+                                    "reached the client)."),
+    "MXNET_FLEET_SCALE_UP_S": (float, 10.0,
+                               "Autoscaler hold window: the hot signal "
+                               "(replica SLO burn on /alerts, or queue "
+                               "depth past MXNET_FLEET_QUEUE_UP) must "
+                               "be sustained this long before a "
+                               "scale-up."),
+    "MXNET_FLEET_SCALE_DOWN_S": (float, 30.0,
+                                 "Autoscaler hold window: fleet-wide "
+                                 "slack (no burn, queues under "
+                                 "MXNET_FLEET_QUEUE_DOWN) must be "
+                                 "sustained this long before a "
+                                 "scale-down (hysteresis against "
+                                 "flapping; > MXNET_FLEET_SCALE_UP_S "
+                                 "by design)."),
+    "MXNET_FLEET_COOLDOWN_S": (float, 15.0,
+                               "Minimum wall between autoscaler "
+                               "actions — a fresh replica gets to "
+                               "absorb load before the next verdict."),
+    "MXNET_FLEET_INTERVAL_S": (float, 1.0,
+                               "Autoscaler control-loop tick: how often "
+                               "replica /alerts + queue signals are "
+                               "polled."),
+    "MXNET_FLEET_QUEUE_UP": (float, 4.0,
+                             "Mean per-replica serving/queue_depth "
+                             "above which a tick reads hot (queue "
+                             "growth scales up before the burn-rate "
+                             "windows mature)."),
+    "MXNET_FLEET_QUEUE_DOWN": (float, 0.5,
+                               "Max per-replica serving/queue_depth "
+                               "below which (absent burn) a tick reads "
+                               "cold."),
+    "MXNET_FLEET_SPAWN_TIMEOUT_S": (float, 120.0,
+                                    "Spawn-to-ready budget: a replica "
+                                    "that has not passed /healthz by "
+                                    "then is killed and triaged as a "
+                                    "failure."),
+    "MXNET_FLEET_DRAIN_TIMEOUT_S": (float, 30.0,
+                                    "Retirement drain budget: how long "
+                                    "a quiesced replica may take to "
+                                    "finish its outstanding requests "
+                                    "before SIGTERM regardless."),
     "MXNET_QUANT_PERCENTILE": (float, 99.99,
                                "Percentile of |x| the percentile/"
                                "entropy calibration observer clips "

@@ -115,6 +115,13 @@ POINTS = {
                    "frees its pages",
     "io.worker": "top of each input-pipeline decode task (DataPipeline "
                  "worker process, or the staging thread when workers=0)",
+    "router.forward": "serve router forward attempt, after the replica "
+                      "is picked and before the connection is opened "
+                      "(a raise here looks like a vanished replica: the "
+                      "router ejects it and retries the next one)",
+    "fleet.replica": "top of each fleet replica worker main-loop tick "
+                     "(serve.fleet --worker; ~10 Hz) — env-armed crash "
+                     "kinds SIGKILL a live replica mid-traffic",
 }
 
 _lock = threading.Lock()

@@ -925,8 +925,33 @@ def alerts_payload():
 def alerts_endpoint(query=""):
     """(status_code, payload) for ``GET /alerts`` — the one
     implementation behind both mounts (telemetry.serve and
-    serve.serve_http), the traces_endpoint pattern."""
-    return 200, alerts_payload()
+    serve.serve_http), the traces_endpoint pattern.
+
+    ``?format=json`` returns the *machine contract* the fleet
+    autoscaler polls: a trimmed, stability-guaranteed view of each
+    rule (name, state, mode, value/threshold, windows + burn
+    fractions) keyed under ``format: "json"``. The default (human)
+    payload — the full snapshots with descriptions, ordering, and
+    evaluator status — is unchanged, so dashboards keep rendering
+    exactly what they always did while control loops get fields that
+    won't move under them."""
+    import urllib.parse
+    params = urllib.parse.parse_qs(query or "")
+    fmt = (params.get("format") or [""])[0]
+    payload = alerts_payload()
+    if fmt != "json":
+        return 200, payload
+    rules = [{"rule": r["name"], "state": r["state"], "mode": r["mode"],
+              "value": r["value"], "threshold": r["threshold"],
+              "cmp": r["cmp"], "since_s": r["since_s"],
+              "windows": [
+                  {"window_s": r["short_window_s"],
+                   "burn_frac": r["short_burn_frac"]},
+                  {"window_s": r["long_window_s"],
+                   "burn_frac": r["long_burn_frac"]}],
+              "burn_threshold": r["burn"]} for r in payload["rules"]]
+    return 200, {"format": "json", "firing": payload["firing"],
+                 "interval_s": payload["interval_s"], "rules": rules}
 
 
 def reset():

@@ -23,7 +23,16 @@ users") needs on top of the one-request ``serving.Predictor``:
   batching for autoregressive decode — iteration-level scheduling,
   bucketed prefill, streaming tokens (docs/decode_serving.md);
 * :mod:`~mxnet_tpu.serve.kv_pages` — :class:`PagePool`: the HBM
-  KV-cache page allocator behind the decode engine's block tables.
+  KV-cache page allocator behind the decode engine's block tables;
+* :mod:`~mxnet_tpu.serve.router` — the fleet frontend: one port over
+  N replicas, least-outstanding load balancing + consistent-hash
+  prefix affinity for ``/generate``, ejection + retry on vanished
+  replicas, end-to-end trace grafting;
+* :mod:`~mxnet_tpu.serve.fleet` — :class:`Fleet`: replica subprocess
+  lifecycle (warmset-fast spawn, drain-then-SIGTERM retirement,
+  preemption-vs-failure death triage) and the SLO-driven autoscaler
+  over each replica's ``/alerts`` burn state (docs/serving.md "Fleet
+  tier").
 
 Quick start::
 
@@ -50,10 +59,14 @@ from .kv_pages import PagePool, PagePoolExhausted
 from .decode import DecodeConfig, DecodeEngine, DecodeSession
 from .http import ServeHTTPServer, serve_http
 from .registry import ModelRegistry
+from .router import (NoLiveReplicaError, Router, RouterHTTPServer,
+                     serve_router)
+from .fleet import Fleet
 
 __all__ = ["InferenceEngine", "ServeConfig", "ModelRegistry", "serve_http",
            "ServeHTTPServer", "QueueFullError", "DeadlineExceededError",
            "EngineClosedError", "engines_status", "power_of_two_buckets",
            "parse_buckets", "validate_buckets", "pick_bucket", "pad_axis0",
            "unpad_axis0", "DecodeConfig", "DecodeEngine", "DecodeSession",
-           "PagePool", "PagePoolExhausted"]
+           "PagePool", "PagePoolExhausted", "Router", "RouterHTTPServer",
+           "serve_router", "NoLiveReplicaError", "Fleet"]

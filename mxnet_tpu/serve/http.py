@@ -166,6 +166,8 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         _rid = None
+        _tsink = None                    # router-hop span collector
+        _tspan = None                    # open http.request span
 
         def _reply(self, code, payload, ctype="application/json",
                    headers=()):
@@ -178,13 +180,51 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
                 # every outcome — 200, 503, 504, 400 — echoes the
                 # request id, so a client log line links to /traces
                 self.send_header("X-Request-Id", self._rid)
+            if self._tsink is not None and self._tspan is not None \
+                    and self._tspan.ctx is not None:
+                # routed request: ship this hop's spans back in-band so
+                # the router can graft them into ITS trace. The
+                # http.request span is still open (it closes after the
+                # reply), so synthesize it now under its real span_id —
+                # the buffer dedups on span_id, suppressing the real
+                # close. The clock pair lets graft() rebase our
+                # perf_counter epoch onto the router's.
+                sp = self._tspan
+                _tr.record_span(sp.name, sp.ctx, sp.t0, _tr._monotonic(),
+                                attrs=dict(sp.attrs),
+                                span_id=sp.ctx.span_id,
+                                parent_id=sp.parent_id)
+                self.send_header("X-Trace-Spans", json.dumps(
+                    {"spans": self._tsink[:64],
+                     "clock": [_tr._PROC_TOKEN, _tr._monotonic()]}))
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _deadline_ms(self, timeout_ms):
+            """Fold the router's remaining-deadline budget
+            (``X-Deadline-Ms``) into the body timeout: the replica
+            must give up no later than the router will, so replica-side
+            504 accounting matches the router's view instead of
+            burning a worker on an answer nobody is waiting for."""
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr is None:
+                return timeout_ms
+            try:
+                # the engine reads timeout <= 0 as "no deadline"; an
+                # exhausted router budget must mean "already expired"
+                budget = max(1e-9, float(hdr))
+            except ValueError:
+                return timeout_ms
+            if timeout_ms is None or float(timeout_ms) <= 0:
+                return budget
+            return min(float(timeout_ms), budget)
+
         def do_GET(self):
             self._rid = None             # keep-alive: no stale echo
+            self._tsink = None
+            self._tspan = None
             path, _, query = self.path.partition("?")
             if path == "/metrics":
                 self._reply(200, _tm.render_prometheus().encode(),
@@ -215,6 +255,8 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
 
         def do_POST(self):
             self._rid = None             # keep-alive: no stale echo
+            self._tsink = None
+            self._tspan = None
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)   # always drain: HTTP/1.1
             path = self.path.split("?")[0]
@@ -233,13 +275,29 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
             if not _REQ_ID_RE.match(rid):
                 rid = _tr.new_trace_id()
             self._rid = rid
-            with _tr.start_span("http.request", trace_id=rid,
+            # a routed request carries the router's forward-span wire
+            # context: join THAT trace (http.request becomes a child of
+            # router.forward) and tee every span of this hop into a
+            # sink shipped back via the X-Trace-Spans response header
+            wctx = None
+            wire_hdr = self.headers.get("X-Trace-Context")
+            if wire_hdr:
+                try:
+                    sink = []
+                    wctx = _tr.from_wire(json.loads(wire_hdr), sink)
+                except (ValueError, TypeError, KeyError):
+                    wctx = None
+                if wctx is not None:
+                    self._tsink = sink
+            with _tr.start_span("http.request", ctx=wctx, trace_id=rid,
                                 attrs={"path": path}) as span:
+                self._tspan = span
                 handler(body, span)
 
         def _predict(self, body, span):
             try:
                 feed, timeout_ms = _parse_body(target, body)
+                timeout_ms = self._deadline_ms(timeout_ms)
                 req = target.submit(feed, timeout_ms, ctx=span.ctx)
             except (QueueFullError, EngineClosedError) as e:
                 span.set_attr("http_status", 503)
@@ -296,6 +354,9 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
         def _generate(self, body, span):
             try:
                 prompt, kwargs, stream = _parse_generate_body(body)
+                budget = self._deadline_ms(kwargs.get("timeout_ms"))
+                if budget is not None:
+                    kwargs["timeout_ms"] = budget
                 sess = decode.submit(prompt, ctx=span.ctx, **kwargs)
             except (QueueFullError, EngineClosedError) as e:
                 # PagePoolExhausted subclasses QueueFullError: same 503

@@ -1,0 +1,633 @@
+"""Fleet frontend: one port, N serve_http replicas behind it.
+
+The router is the layer that multiplies one replica into a fleet: it
+accepts ``POST /predict`` and ``POST /generate`` on a single frontend
+port and forwards each request to one of N replica subprocesses (each
+a :func:`~mxnet_tpu.serve.http.serve_http` worker on its own port),
+relaying the response — including ``/generate``'s chunked ndjson token
+stream — back to the client.
+
+Routing policy (docs/serving.md "Fleet tier"):
+
+* **least-outstanding-requests** for ``/predict`` (and as the
+  fallback): the replica with the fewest requests currently in flight
+  through this router wins — outstanding count tracks *actual* load
+  including slow decodes, where round-robin would pile onto a stuck
+  replica.
+* **consistent-hash prefix affinity** for ``/generate``: the hash of
+  the prompt *head* (first ``MXNET_FLEET_PREFIX_TOKENS`` token ids)
+  picks a replica on a 64-vnode hash ring, so every request of a
+  prefix family (same system prompt / few-shot header, multi-turn
+  continuations) lands on the same replica — the signal a prefix KV
+  cache needs to pay off. Affinity **yields to load**: when the pinned
+  replica's outstanding count exceeds the fleet minimum by more than
+  ``MXNET_FLEET_AFFINITY_SLACK``, the request falls back to
+  least-outstanding (``router/affinity_yields_total``) instead of
+  queueing behind a hot prefix.
+* **ejection + retry**: a connection failure before the response
+  status line arrives (refused, reset, or the ``router.forward``
+  fault point firing) looks like a vanished replica — the router
+  ejects it (``router/ejections_total``; no new picks until the fleet
+  re-admits or replaces it) and retries the next-best replica, up to
+  ``MXNET_FLEET_FORWARD_RETRIES`` times. Once a status line has been
+  received there are no retries: a mid-stream death surfaces as an
+  in-band ``{"error": ..., "code": 502}`` line, exactly like a
+  replica-local mid-stream failure.
+
+Per-request propagation: the router forwards ``X-Request-Id``
+verbatim, the *remaining* deadline budget as ``X-Deadline-Ms`` (so a
+replica gives up no later than the router would), and the forward
+span's trace context as ``X-Trace-Context``; the replica ships its
+span bundle back in ``X-Trace-Spans`` and the router grafts it —
+clock-rebased — into its own trace, so ``/traces`` on the router
+shows one end-to-end tree: ``router.request`` → ``router.forward`` →
+the replica's ``http.request`` and everything under it.
+
+The router process also mounts ``/healthz`` (ok while >= 1 replica is
+routable), ``/metrics``, ``/traces``, ``/alerts``, and ``/fleet``
+(live per-replica state plus, when a :class:`~mxnet_tpu.serve.fleet.
+Fleet` is attached, the autoscaler's view).
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import http.client
+import json
+import threading
+import time
+
+from ..base import MXNetError
+from ..config import get as _cfg
+from .. import fault as _fault
+from .. import telemetry as _tm
+from .. import tracing as _tr
+from .engine import DeadlineExceededError
+
+__all__ = ["Router", "RouterHTTPServer", "serve_router",
+           "NoLiveReplicaError"]
+
+_monotonic = time.perf_counter
+_VNODES = 64
+
+
+class NoLiveReplicaError(MXNetError):
+    """Every replica is ejected, quiescing, or gone (mapped to 503)."""
+
+
+def _hash64(s):
+    return int(hashlib.md5(s.encode("utf-8")).hexdigest()[:16], 16)
+
+
+class ReplicaHandle(object):
+    """Router-side state for one replica (URL + in-flight count)."""
+
+    __slots__ = ("name", "host", "port", "outstanding", "healthy",
+                 "quiescing")
+
+    def __init__(self, name, host, port):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.outstanding = 0
+        self.healthy = True
+        self.quiescing = False
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def snapshot(self):
+        return {"name": self.name, "url": self.url,
+                "outstanding": self.outstanding,
+                "healthy": self.healthy, "quiescing": self.quiescing}
+
+
+class _Forward(object):
+    """One successfully-opened forward: the picked replica, the live
+    connection/response, and the pre-allocated ``router.forward`` span
+    id the replica is parenting its spans under. ``close()`` records
+    the span, observes the latency histogram, and releases the
+    outstanding slot — callers run it in a ``finally``."""
+
+    __slots__ = ("router", "replica", "conn", "resp", "ctx", "span_id",
+                 "t0", "attempt", "_done")
+
+    def __init__(self, router, replica, conn, resp, ctx, span_id, t0,
+                 attempt):
+        self.router = router
+        self.replica = replica
+        self.conn = conn
+        self.resp = resp
+        self.ctx = ctx
+        self.span_id = span_id
+        self.t0 = t0
+        self.attempt = attempt
+        self._done = False
+
+    def graft(self):
+        """Pull the replica's span bundle out of ``X-Trace-Spans`` and
+        graft it into the router's trace (clock-rebased onto this
+        process's perf_counter epoch). Buffered replies only — the
+        streaming path has no response trailer to carry spans."""
+        if self.ctx is None:
+            return
+        hdr = self.resp.getheader("X-Trace-Spans")
+        if not hdr:
+            return
+        try:
+            bundle = json.loads(hdr)
+            clk = bundle.get("clock")
+            clock = ((clk[0], float(clk[1]), _monotonic())
+                     if clk else None)
+            _tr.graft(bundle.get("spans") or [], ctx=self.ctx,
+                      clock=clock)
+        except (ValueError, TypeError, KeyError, IndexError):
+            pass
+
+    def close(self, status="ok"):
+        if self._done:
+            return
+        self._done = True
+        t1 = _monotonic()
+        if self.ctx is not None:
+            _tr.record_span("router.forward", self.ctx, self.t0, t1,
+                            attrs={"replica": self.replica.name,
+                                   "attempt": self.attempt},
+                            span_id=self.span_id, status=status)
+        if _tm._enabled:
+            _tm.histogram("router/forward_seconds",
+                          "Router-side forward latency (pick to last "
+                          "byte relayed)").observe(t1 - self.t0)
+        self.router._release(self.replica)
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class Router(object):
+    """Replica table + hash ring + forward policy (no HTTP server of
+    its own — :func:`serve_router` mounts one on top; unit tests drive
+    :meth:`pick` / :meth:`open_forward` directly)."""
+
+    def __init__(self, prefix_tokens=None, affinity_slack=None,
+                 forward_retries=None, vnodes=_VNODES):
+        self._lock = threading.Lock()
+        self._replicas = {}              # name -> ReplicaHandle
+        self._ring = []                  # sorted [(hash, name), ...]
+        self._vnodes = int(vnodes)
+        self.prefix_tokens = int(_cfg("MXNET_FLEET_PREFIX_TOKENS")
+                                 if prefix_tokens is None
+                                 else prefix_tokens)
+        self.affinity_slack = int(_cfg("MXNET_FLEET_AFFINITY_SLACK")
+                                  if affinity_slack is None
+                                  else affinity_slack)
+        self.forward_retries = int(_cfg("MXNET_FLEET_FORWARD_RETRIES")
+                                   if forward_retries is None
+                                   else forward_retries)
+        self._fleet_status_fn = None
+
+    # -- replica table ---------------------------------------------------
+
+    def add(self, name, host, port):
+        """Admit a replica (or re-admit one previously ejected under
+        the same name: its health resets)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                rep = ReplicaHandle(name, host, port)
+                self._replicas[name] = rep
+                for i in range(self._vnodes):
+                    h = _hash64("%s#%d" % (name, i))
+                    bisect.insort(self._ring, (h, name))
+            else:
+                rep.host, rep.port = host, int(port)
+            rep.healthy = True
+            rep.quiescing = False
+            return rep
+
+    def remove(self, name):
+        """Forget a replica entirely (fleet retirement / death)."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._ring = [(h, n) for h, n in self._ring if n != name]
+
+    def quiesce(self, name):
+        """Stop new picks to ``name`` (retirement step 1); returns its
+        current outstanding count so the caller can wait for drain."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return 0
+            rep.quiescing = True
+            return rep.outstanding
+
+    def eject(self, name, reason=""):
+        """Mark a replica unroutable after a connection failure; the
+        fleet's monitor re-admits (or replaces) it."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or not rep.healthy:
+                return
+            rep.healthy = False
+        if _tm._enabled:
+            _tm.counter("router/ejections_total",
+                        "Replicas ejected on connection failure",
+                        ("reason",)).labels(reason or "conn").inc()
+
+    def outstanding(self, name):
+        with self._lock:
+            rep = self._replicas.get(name)
+            return 0 if rep is None else rep.outstanding
+
+    def replicas(self):
+        with self._lock:
+            return [r.snapshot() for r in self._replicas.values()]
+
+    def live_count(self):
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.healthy and not r.quiescing)
+
+    # -- policy ----------------------------------------------------------
+
+    def affinity_key(self, path, body):
+        """The consistent-hash key for a request, or None when the
+        request has no prefix to pin (``/predict``, malformed body).
+        The key is the prompt *head* — requests sharing their first
+        ``prefix_tokens`` ids share a key."""
+        if path != "/generate" or self.prefix_tokens <= 0:
+            return None
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return None
+        prompt = payload if isinstance(payload, list) \
+            else payload.get("prompt") if isinstance(payload, dict) \
+            else None
+        if not isinstance(prompt, list) or not prompt:
+            return None
+        return ",".join(str(t) for t in prompt[:self.prefix_tokens])
+
+    def _ring_lookup_locked(self, key, exclude):
+        if not self._ring:
+            return None
+        h = _hash64(key)
+        i = bisect.bisect_right(self._ring, (h, ""))
+        for step in range(len(self._ring)):
+            _, name = self._ring[(i + step) % len(self._ring)]
+            rep = self._replicas.get(name)
+            if rep is not None and rep.healthy and not rep.quiescing \
+                    and name not in exclude:
+                return rep
+        return None
+
+    def pick(self, affinity_key=None, exclude=()):
+        """Pick a replica and take an outstanding slot on it. Returns
+        ``(replica, affinity_hit)``; raises :class:`NoLiveReplicaError`
+        when nothing is routable (minus ``exclude``)."""
+        exclude = set(exclude)
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.healthy and not r.quiescing
+                    and r.name not in exclude]
+            if not live:
+                raise NoLiveReplicaError(
+                    "no live replica (fleet has %d registered, %d "
+                    "excluded this attempt)"
+                    % (len(self._replicas), len(exclude)))
+            chosen, hit = None, False
+            if affinity_key is not None:
+                pinned = self._ring_lookup_locked(affinity_key, exclude)
+                if pinned is not None:
+                    min_out = min(r.outstanding for r in live)
+                    if pinned.outstanding - min_out \
+                            <= self.affinity_slack:
+                        chosen, hit = pinned, True
+                    elif _tm._enabled:
+                        _tm.counter(
+                            "router/affinity_yields_total",
+                            "Prefix-affinity picks abandoned because "
+                            "the pinned replica was saturated").inc()
+            if chosen is None:
+                chosen = min(live, key=lambda r: (r.outstanding, r.name))
+            chosen.outstanding += 1
+        if hit and _tm._enabled:
+            _tm.counter("router/affinity_hits_total",
+                        "Generate requests routed to their prefix-"
+                        "affine replica").inc()
+        return chosen, hit
+
+    def _release(self, rep):
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - 1)
+
+    # -- forwarding ------------------------------------------------------
+
+    def open_forward(self, path, body, rid=None, ctx=None, deadline=None):
+        """Pick a replica and forward one POST until its response
+        status line arrives; returns a :class:`_Forward`. Connection
+        failures before the status line eject the replica and retry
+        the next-best one (``forward_retries`` extra attempts); after
+        the status line the exchange is committed to that replica."""
+        tried = set()
+        last_err = None
+        for attempt in range(self.forward_retries + 1):
+            if deadline is not None:
+                remaining_ms = (deadline - _monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    raise DeadlineExceededError(
+                        "deadline expired in the router after %d "
+                        "forward attempt(s)" % attempt)
+            else:
+                remaining_ms = None
+            try:
+                rep, _hit = self.pick(
+                    self.affinity_key(path, body), exclude=tried)
+            except NoLiveReplicaError:
+                if last_err is not None:
+                    raise NoLiveReplicaError(
+                        "no live replica left after %d attempt(s); "
+                        "last error: %s" % (attempt, last_err))
+                raise
+            sid = _tr.new_span_id() if (ctx is not None
+                                        and ctx.sampled) else None
+            t0 = _monotonic()
+            headers = {"Content-Type": "application/json"}
+            if rid:
+                headers["X-Request-Id"] = rid
+            if remaining_ms is not None:
+                headers["X-Deadline-Ms"] = "%.1f" % max(0.0,
+                                                        remaining_ms)
+            if sid is not None:
+                headers["X-Trace-Context"] = json.dumps(
+                    {"trace_id": ctx.trace_id, "span_id": sid,
+                     "sampled": True})
+            conn = None
+            try:
+                _fault.inject("router.forward")
+                conn = http.client.HTTPConnection(rep.host, rep.port)
+                conn.request("POST", path, body, headers)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException,
+                    _fault.FaultInjected) as e:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                self._release(rep)
+                self.eject(rep.name, reason="conn")
+                tried.add(rep.name)
+                last_err = e
+                if sid is not None:
+                    _tr.record_span("router.forward", ctx, t0,
+                                    _monotonic(),
+                                    attrs={"replica": rep.name,
+                                           "attempt": attempt,
+                                           "error": str(e)},
+                                    span_id=sid, status="error")
+                if _tm._enabled:
+                    _tm.counter("router/forward_retries_total",
+                                "Forward attempts retried on another "
+                                "replica after a connection "
+                                "failure").inc()
+                continue
+            return _Forward(self, rep, conn, resp, ctx, sid, t0,
+                            attempt)
+        raise NoLiveReplicaError(
+            "every forward attempt failed (%d tried); last error: %s"
+            % (len(tried), last_err))
+
+    # -- status ----------------------------------------------------------
+
+    def set_fleet_status_fn(self, fn):
+        """The owning Fleet installs its status callback here so the
+        router's ``/fleet`` endpoint shows the autoscaler's view."""
+        self._fleet_status_fn = fn
+
+    def status(self):
+        out = {"replicas": self.replicas(),
+               "live": self.live_count(),
+               "prefix_tokens": self.prefix_tokens,
+               "affinity_slack": self.affinity_slack}
+        fn = self._fleet_status_fn
+        if fn is not None:
+            try:
+                out["fleet"] = fn()
+            except Exception as e:      # status must never 500
+                out["fleet"] = {"error": str(e)}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+class RouterHTTPServer(object):
+    """Handle on a running router frontend (from :func:`serve_router`)."""
+
+    def __init__(self, httpd, thread, router):
+        self._httpd = httpd
+        self._thread = thread
+        self.router = router
+        self.port = httpd.server_address[1]
+        self.url = "http://%s:%d" % (httpd.server_address[0], self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    stop = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _body_timeout_ms(body):
+    """Best-effort read of the request body's ``timeout_ms`` (the
+    router's deadline view; malformed bodies forward as-is and 400 at
+    the replica)."""
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    t = payload.get("timeout_ms")
+    return float(t) if isinstance(t, (int, float)) else None
+
+
+def serve_router(router, port=0, addr="127.0.0.1"):
+    """Start the fleet frontend over ``router``; returns a
+    :class:`RouterHTTPServer` (``port=0`` picks a free port)."""
+    import http.server
+    from .http import _REQ_ID_RE
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        _rid = None
+
+        def _reply(self, code, payload, ctype="application/json",
+                   headers=()):
+            body = (json.dumps(payload).encode() + b"\n"
+                    if not isinstance(payload, bytes) else payload)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            if self._rid is not None:
+                self.send_header("X-Request-Id", self._rid)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._rid = None
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                self._reply(200, _tm.render_prometheus().encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+            elif path == "/healthz":
+                if router.live_count() > 0:
+                    self._reply(200, b"ok\n",
+                                ctype="text/plain; charset=utf-8")
+                else:
+                    self._reply(503, b"no-replicas\n",
+                                ctype="text/plain; charset=utf-8")
+            elif path == "/fleet":
+                self._reply(200, router.status())
+            elif path == "/traces":
+                code, payload = _tr.traces_endpoint(query)
+                self._reply(code, payload)
+            elif path == "/alerts":
+                from .. import health as _hl
+                code, payload = _hl.alerts_endpoint(query)
+                self._reply(code, payload)
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def _chunk(self, data):
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        def do_POST(self):
+            self._rid = None
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            path = self.path.split("?")[0]
+            if path not in ("/predict", "/generate"):
+                self._reply(404, {"error": "not found"})
+                return
+            rid = self.headers.get("X-Request-Id", "")
+            if not _REQ_ID_RE.match(rid):
+                rid = _tr.new_trace_id()
+            self._rid = rid
+            if _tm._enabled:
+                _tm.counter("router/requests_total",
+                            "Requests accepted by the fleet router",
+                            ("path",)).labels(path).inc()
+            timeout_ms = _body_timeout_ms(body)
+            deadline = (_monotonic() + timeout_ms / 1e3
+                        if timeout_ms is not None else None)
+            with _tr.start_span("router.request", trace_id=rid,
+                                attrs={"path": path}) as span:
+                self._route(path, body, rid, deadline, span)
+
+        def _route(self, path, body, rid, deadline, span):
+            try:
+                fwd = router.open_forward(path, body, rid=rid,
+                                          ctx=span.ctx,
+                                          deadline=deadline)
+            except NoLiveReplicaError as e:
+                span.set_attr("http_status", 503)
+                _tr.mark_error(e, ctx=span.ctx)
+                self._reply(503, {"error": str(e)},
+                            headers=(("Retry-After", "1"),))
+                return
+            except DeadlineExceededError as e:
+                span.set_attr("http_status", 504)
+                _tr.mark_error(e, ctx=span.ctx)
+                self._reply(504, {"error": str(e)})
+                return
+            status = "ok"
+            try:
+                resp = fwd.resp
+                te = (resp.getheader("Transfer-Encoding") or "").lower()
+                span.set_attr("replica", fwd.replica.name)
+                span.set_attr("http_status", resp.status)
+                if te == "chunked":
+                    status = self._relay_stream(fwd, span)
+                else:
+                    payload = resp.read()
+                    fwd.graft()
+                    if resp.status >= 500:
+                        _tr.mark_error("replica returned %d"
+                                       % resp.status, ctx=span.ctx)
+                    extra = []
+                    ra = resp.getheader("Retry-After")
+                    if ra:
+                        extra.append(("Retry-After", ra))
+                    self._reply(resp.status, payload,
+                                ctype=resp.getheader(
+                                    "Content-Type",
+                                    "application/json"),
+                                headers=tuple(extra))
+            finally:
+                fwd.close(status=status)
+
+        def _relay_stream(self, fwd, span):
+            """Relay a chunked ndjson token stream line-by-line. A
+            replica death mid-stream becomes an in-band error line (the
+            status line is already out — same contract as a replica-
+            local mid-stream failure); a client hang-up just stops the
+            relay."""
+            resp = fwd.resp
+            self.send_response(resp.status)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type",
+                                            "application/x-ndjson"))
+            self.send_header("Transfer-Encoding", "chunked")
+            if self._rid is not None:
+                self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            upstream_err = None
+            try:
+                while True:
+                    try:
+                        line = resp.readline()
+                    except (OSError, http.client.HTTPException) as e:
+                        upstream_err = e
+                        break
+                    if not line:
+                        break
+                    self._chunk(line)
+                if upstream_err is not None:
+                    router.eject(fwd.replica.name, reason="stream")
+                    _tr.mark_error(upstream_err, ctx=span.ctx)
+                    span.set_attr("http_status", 502)
+                    self._chunk(json.dumps(
+                        {"error": "replica died mid-stream: %s"
+                                  % upstream_err,
+                         "code": 502}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return "error" if upstream_err is not None else "ok"
+
+        def log_message(self, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, port), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mxnet-serve-router", daemon=True)
+    thread.start()
+    return RouterHTTPServer(httpd, thread, router)
