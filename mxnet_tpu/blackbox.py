@@ -92,6 +92,15 @@ EVENTS = {
                      "decision) — read together with the dead replica's "
                      "own ring, whose last fault record names the "
                      "killer",
+    "member_lost": "an elastic dist_tpu_sync survivor declared a rank "
+                   "lost (rank, detection source: collective-error / "
+                   "stale-heartbeat / step-watchdog, seconds since its "
+                   "last heartbeat) — fsync'd before the rescale "
+                   "starts, so a crash mid-rescale still names the "
+                   "trigger",
+    "rescale": "an elastic rescale committed: old world -> new world, "
+               "member epoch, agreed resume step, grad-accum factor, "
+               "and whether the mesh shrank or grew (a rejoin)",
 }
 
 _lock = threading.Lock()

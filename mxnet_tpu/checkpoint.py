@@ -45,7 +45,7 @@ __all__ = ["ShardedCheckpointManager", "save_sharded", "restore_sharded",
            "atomic_writer", "write_manifest", "manifest_path",
            "verify_checkpoint", "load_latest_valid", "list_checkpoints",
            "ResumeState", "TrainingSupervisor", "ProcessSupervisor",
-           "CheckpointCorruptError"]
+           "elastic_rejoin_env", "CheckpointCorruptError"]
 
 MANIFEST_FORMAT = 1
 
@@ -474,18 +474,27 @@ class ProcessSupervisor(object):
 
     Every relaunch decision counts in
     ``supervisor/relaunches_total{reason}`` (reason preempt/failure).
+
+    An optional ``env_hook(attempt, env)`` customizes each launch's
+    environment: it gets the 0-based attempt number and the base env
+    dict, and returns overrides (value ``None`` deletes the variable).
+    :func:`elastic_rejoin_env` is the canned hook that flips a
+    relaunched elastic rank into join mode with non-colliding
+    coordinates.
     """
 
     PREEMPT_RCS = frozenset((137, 143))
 
     def __init__(self, max_failures=None, relaunch_delay_s=1.0,
-                 logger=None):
+                 logger=None, env_hook=None):
         import logging
         from .config import get as _cfg
         self.max_failures = (int(_cfg("MXNET_SUPERVISOR_MAX_FAILURES"))
                              if max_failures is None else int(max_failures))
         self.relaunch_delay_s = float(relaunch_delay_s)
         self.failures = 0            # consecutive genuine failures
+        self.launches = 0            # total launch attempts (0 = first)
+        self.env_hook = env_hook     # callable(attempt, env) -> overrides
         self._log = logger or logging
 
     @staticmethod
@@ -544,7 +553,19 @@ class ProcessSupervisor(object):
         import subprocess
         import time as _time
         while True:
-            rc = subprocess.call(cmd, env=env, cwd=cwd)
+            run_env = env
+            if self.env_hook is not None:
+                base = dict(env) if env is not None else dict(os.environ)
+                overrides = self.env_hook(self.launches, base)
+                if overrides:
+                    run_env = base
+                    for k, v in overrides.items():
+                        if v is None:
+                            run_env.pop(k, None)
+                        else:
+                            run_env[k] = str(v)
+            self.launches += 1
+            rc = subprocess.call(cmd, env=run_env, cwd=cwd)
             if rc == 0:
                 return 0
             _reason, relaunch = self.triage(rc)
@@ -552,6 +573,36 @@ class ProcessSupervisor(object):
                 return rc
             if self.relaunch_delay_s > 0:
                 _time.sleep(self.relaunch_delay_s)
+
+
+def elastic_rejoin_env(elastic_dir=None):
+    """Canned :class:`ProcessSupervisor` ``env_hook`` for elastic
+    ``dist_tpu_sync`` workers: the FIRST launch keeps the caller's env
+    untouched (the rank boots with its assigned
+    ``MXNET_DIST_PROCESS_ID`` / coordinator), every RELAUNCH comes back
+    as a *joiner* — ``MXNET_ELASTIC_JOIN=1`` plus dropped
+    ``MXNET_DIST_COORDINATOR`` / ``MXNET_DIST_NUM_PROCESSES`` /
+    ``MXNET_DIST_PROCESS_ID``, so the child asks the running world's
+    rescale plan for its (new, non-colliding) rank and coordinator
+    address instead of replaying the stale pre-failure coordinates,
+    which after a rescale may belong to a live peer::
+
+        sup = ProcessSupervisor(env_hook=elastic_rejoin_env("/nfs/el"))
+        sup.run(["python", "train.py"])
+    """
+    def _hook(attempt, env):
+        if attempt == 0:
+            return {}
+        overrides = {
+            "MXNET_ELASTIC_JOIN": "1",
+            "MXNET_DIST_COORDINATOR": None,
+            "MXNET_DIST_NUM_PROCESSES": None,
+            "MXNET_DIST_PROCESS_ID": None,
+        }
+        if elastic_dir:
+            overrides["MXNET_ELASTIC_DIR"] = str(elastic_dir)
+        return overrides
+    return _hook
 
 
 class TrainingSupervisor(object):

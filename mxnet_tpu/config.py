@@ -37,6 +37,12 @@ VARS = {
                           "BSP; async = per-push updates."),
     "MXNET_TPU_NUM_WORKERS": (int, 1, "World size in PS mode."),
     "MXNET_TPU_RANK": (int, 0, "This worker's rank in PS mode."),
+    "MXNET_TPU_ROLE": (str, "worker", "PS-mode process role (worker/"
+                       "server/scheduler) for the launch.py tooling "
+                       "path."),
+    "MXNET_TPU_BENCH_DIR": (str, "", "Override for the benchmark "
+                            "results directory (default .bench/ under "
+                            "the repo root)."),
     "MXNET_DIST_COORDINATOR": (str, "", "host:port of process 0's "
                                "jax.distributed coordinator for "
                                "dist_tpu_sync multi-host training "
@@ -48,6 +54,54 @@ VARS = {
     "MXNET_DIST_PROCESS_ID": (int, 0, "This process's rank for the "
                               "explicit MXNET_DIST_COORDINATOR "
                               "route."),
+    "MXNET_DIST_DEAD_S": (float, 10.0,
+                          "Elastic membership: a dist_tpu_sync rank "
+                          "whose control-plane heartbeat is older than "
+                          "this is declared lost and a rescale begins "
+                          "(elastic.py)."),
+    "MXNET_STEP_TIMEOUT_S": (float, 120.0,
+                             "Elastic membership: a fused train step "
+                             "that has not completed after this long "
+                             "is treated as a wedged collective (a "
+                             "rank parked in a dead all-reduce) and "
+                             "routed to the same rescale path as a "
+                             "detected death. 0 disables the "
+                             "watchdog."),
+    "MXNET_ELASTIC_DIR": (str, "",
+                          "Shared directory for the elastic control "
+                          "plane (heartbeats, rescale votes/plans, "
+                          "join requests). Setting it on a "
+                          "dist_tpu_sync fit enables checkpoint-free "
+                          "elastic rescale on membership change; "
+                          "empty = fail-as-a-unit (PR 4 supervisor "
+                          "relaunch)."),
+    "MXNET_ELASTIC_HOST": (str, "",
+                           "Host this rank advertises in its elastic "
+                           "heartbeats (peers dial it when this rank "
+                           "becomes the rescale coordinator). Empty = "
+                           "127.0.0.1, the single-machine/chaos-test "
+                           "default."),
+    "MXNET_ELASTIC_HB_S": (float, 1.0,
+                           "Elastic membership heartbeat period "
+                           "(control-plane file rewrite interval); "
+                           "liveness window is MXNET_DIST_DEAD_S."),
+    "MXNET_ELASTIC_JOIN": (int, 0,
+                           "Set to 1 on a relaunched trainer to enter "
+                           "fit in JOIN mode: request admission from "
+                           "the running elastic world and adopt its "
+                           "plan instead of initializing a new "
+                           "cluster (the ProcessSupervisor relaunch "
+                           "hook sets this)."),
+    "MXNET_BENCH_TUNNEL_RETRIES": (int, 5,
+                                   "Bench driver: accelerator-init "
+                                   "probe attempts before the live "
+                                   "round is abandoned to banked "
+                                   "results (the BENCH_r02/r04 flaky "
+                                   "device tunnel)."),
+    "MXNET_BENCH_TUNNEL_BACKOFF_S": (float, 2.0,
+                                     "Bench driver: base of the "
+                                     "jittered exponential backoff "
+                                     "between tunnel probe retries."),
     "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000,
                                      "Arrays above this size may be "
                                      "sharded across servers "
@@ -57,6 +111,10 @@ VARS = {
                                   "(maps to XLA deterministic flags)."),
     "MXNET_PROFILER_AUTOSTART": (bool, False,
                                  "Start the profiler at import."),
+    "MXNET_TEST_SEED": (int, 0, "RNG seed for the test harness "
+                        "(tools/flakiness_checker.py rotates it per "
+                        "trial; reference: docs/faq/env_var.md test "
+                        "seeding)."),
     "MXNET_UPDATE_BUFFER_DONATION": (bool, True,
                                      "Donate weight/state buffers in "
                                      "optimizer update kernels (XLA "

@@ -212,6 +212,24 @@ class Executor(object):
             return make_batch_global(mesh, local)
         return make_replicated_global(mesh, local)
 
+    def _place_accum(self, name, value):
+        """Place one microbatched train-step input (host-local
+        ``[A, L, ...]``): sharded ``P(None, 'dp')`` on a mesh (global
+        ``[A, world*L, ...]`` — dim 1 is the batch), plain device array
+        off-mesh (the shrunk-to-one elastic survivor)."""
+        import jax
+        data = _np.asarray(value, dtype=self.arg_dict[name].dtype) \
+            if not isinstance(value, jax.Array) else value
+        mesh = self._dp_mesh
+        if mesh is None:
+            return jax.device_put(data, self._ctx.jax_device())
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self._dp_nproc == 1:
+            spec = P(None, "dp", *([None] * (getattr(data, "ndim", 2) - 2)))
+            return jax.device_put(data, NamedSharding(mesh, spec))
+        from .parallel.mesh import make_accum_batch_global
+        return make_accum_batch_global(mesh, data)
+
     # -- compilation -------------------------------------------------------
     def _buffer_sig(self):
         """Abstract input spec of the bound buffers ([(name, shape,
@@ -415,7 +433,7 @@ class Executor(object):
 
     # -- fused train step --------------------------------------------------
     def _build_fused_step(self, rule, update_names, default_ct, donate,
-                          numerics="off"):
+                          numerics="off", accum=1, accum_names=()):
         """Trace + jit ONE program computing forward outputs, all
         gradients (jax.vjp over the same pure graph function), the
         optimizer update for every parameter in ``update_names`` via
@@ -485,7 +503,54 @@ class Executor(object):
                 new_p[n], new_s[n] = rule(genv[n], gs[n], senv[n], henv[n])
             return new_p, new_s, new_aux, outs, sentinel
 
-        if default_ct:
+        def _accum_core(genv, senv, henv, fenv, key, mbenv):
+            # Gradient accumulation INSIDE the donated program: a
+            # lax.scan over the leading microbatch axis of ``mbenv``,
+            # with the gradient accumulator as the carry, then ONE
+            # optimizer-rule application on the total. The reduction
+            # order is fixed and documented: microbatch 0 seeds the
+            # accumulator (never zeros — IEEE `0.0 + (-0.0)` would
+            # flip the sign bit of a -0.0 gradient) and microbatches
+            # 1..A-1 fold in left-to-right, so a W-survivor world
+            # reproduces the base world's per-step reduction as
+            # (psum_W(mb0) + psum_W(mb1)) + ... — bitwise-stable
+            # across rescales of the same global batch.
+            def grads(a_env):
+                def fwd(ge):
+                    env = dict(fenv)
+                    env.update(a_env)
+                    env.update(ge)
+                    return fn(env, key)
+                outs, vjp_fn, _aux = jax.vjp(fwd, genv, has_aux=True)
+                cts = tuple(jnp.ones(o.shape, dtype=o.dtype) for o in outs)
+                (gs,) = vjp_fn(cts)
+                return gs, outs
+
+            g_tot, outs0 = grads({n: v[0] for n, v in mbenv.items()})
+            if accum > 1:
+                xs = {n: v[1:] for n, v in mbenv.items()}
+
+                def body(acc, a_env):
+                    ga, outs_a = grads(a_env)
+                    return {n: acc[n] + ga[n] for n in acc}, outs_a
+
+                g_tot, outs_rest = jax.lax.scan(body, g_tot, xs)
+                outs = tuple(
+                    jnp.concatenate([o0[None], rest], axis=0)
+                    for o0, rest in zip(outs0, outs_rest))
+            else:
+                outs = tuple(o[None] for o in outs0)
+            sentinel = _sentinel(g_tot, outs) if numerics != "off" else None
+            new_p, new_s = {}, {}
+            for n in update_names:
+                new_p[n], new_s[n] = rule(genv[n], g_tot[n], senv[n],
+                                          henv[n])
+            return new_p, new_s, {}, outs, sentinel
+
+        if accum_names:
+            def run(genv, senv, henv, fenv, key, mbenv):
+                return _accum_core(genv, senv, henv, fenv, key, mbenv)
+        elif default_ct:
             def run(genv, senv, henv, fenv, key):
                 return _core(genv, senv, henv, fenv, key, None)
         else:
@@ -495,7 +560,7 @@ class Executor(object):
         return jax.jit(run, donate_argnums=(0, 1) if donate else ())
 
     def train_step(self, rule, update_names, states, hyper, feed=None,
-                   out_grads=None):
+                   out_grads=None, accum_feed=None):
         """One fused XLA program per training step: forward + backward +
         optimizer update (+ gradient all-reduce under ``set_dp_mesh``,
         inserted by GSPMD inside the SAME program).
@@ -527,6 +592,34 @@ class Executor(object):
                 raise MXNetError(
                     "train_step requires grad_req='write' for %r (got %r)"
                     % (n, self._grad_req.get(n)))
+        accum = 1
+        mbenv = None
+        if accum_feed:
+            # gradient-accumulation mode (elastic rescale / beyond-HBM
+            # global batches): every data input arrives microbatched
+            # [A, L, ...] through accum_feed, bypassing the bound
+            # [L, ...] buffers entirely
+            if out_grads is not None:
+                raise MXNetError(
+                    "train_step(accum_feed=...) supports only the "
+                    "default cotangents (out_grads=None)")
+            if self._aux_names:
+                raise MXNetError(
+                    "train_step(accum_feed=...) cannot honor aux "
+                    "states (batch-norm running stats mutate per "
+                    "microbatch, which breaks the bitwise global-batch "
+                    "contract); aux-free graphs only")
+            dims = {int(_np.shape(v)[0]) for v in accum_feed.values()}
+            if len(dims) != 1:
+                raise MXNetError(
+                    "accum_feed entries disagree on the microbatch "
+                    "count: %s" % sorted(dims))
+            accum = dims.pop()
+            for n in accum_feed:
+                if n not in self.arg_dict:
+                    raise MXNetError("unknown train_step input %r" % n)
+            mbenv = {n: self._place_accum(n, v)
+                     for n, v in accum_feed.items()}
         for k, v in (feed or {}).items():
             self._stage_input(k, v)
 
@@ -536,11 +629,15 @@ class Executor(object):
         from .config import get as _cfg
         donate = bool(_cfg("MXNET_UPDATE_BUFFER_DONATION"))
         numerics = _health.numerics_mode()
+        accum_names = tuple(sorted(accum_feed)) if accum_feed else ()
         cache_key = (rule, update_names, out_grads is None, donate,
-                     numerics)
+                     numerics, accum, accum_names)
 
         env = self._env()
         genv = {n: env.pop(n) for n in update_names}
+        if mbenv is not None:
+            for n in accum_names:
+                env.pop(n, None)      # traced via mbenv, not the binding
         senv = {}
         for n in update_names:
             tup = []
@@ -557,7 +654,9 @@ class Executor(object):
             senv[n] = tuple(tup)
         key = _random.next_key() if self._needs_rng else None
         args = [genv, senv, hyper, env, key]
-        if out_grads is not None:
+        if mbenv is not None:
+            args.append(mbenv)
+        elif out_grads is not None:
             args.append(self._normalize_out_grads(out_grads))
 
         run = self._fused_jitted.get(cache_key)
@@ -591,12 +690,17 @@ class Executor(object):
                 if instance is None:
                     instance = self._rule_salts[rule] = \
                         _pg.next_instance("rule")
+            accum_sig = None
+            if mbenv is not None:
+                accum_sig = [[n, list(mbenv[n].shape), str(mbenv[n].dtype)]
+                             for n in accum_names]
             pkey = _pg.ProgramKey(
                 "fused_step", self._graph_hash,
                 {"rule": rule_id, "update": list(update_names),
                  "default_ct": out_grads is None, "donate": donate,
                  "numerics": numerics, "args": self._buffer_sig(),
-                 "mesh": self._mesh_sig(), "rng": self._needs_rng},
+                 "mesh": self._mesh_sig(), "rng": self._needs_rng,
+                 "accum": [accum, accum_sig] if accum_sig else None},
                 instance=instance)
             built = []
 
@@ -625,7 +729,7 @@ class Executor(object):
                                 ).inc()
                 return self._build_fused_step(
                     rule, update_names, out_grads is None, donate,
-                    numerics)
+                    numerics, accum=accum, accum_names=accum_names)
 
             run = _pg.get_or_build(pkey, build)
             self._fused_jitted[cache_key] = run

@@ -122,6 +122,15 @@ POINTS = {
     "fleet.replica": "top of each fleet replica worker main-loop tick "
                      "(serve.fleet --worker; ~10 Hz) — env-armed crash "
                      "kinds SIGKILL a live replica mid-traffic",
+    "dist.member": "top of each elastic dist_tpu_sync training step, "
+                   "after the previous step's host mirror was captured "
+                   "(a crash here is the chaos test's SIGKILL-at-a-"
+                   "step-boundary: survivors detect the silence and "
+                   "rescale without a checkpoint)",
+    "dist.rescale": "elastic rescale entry on a survivor, after the "
+                    "lost rank is detected and before the rescale "
+                    "barrier (a crash here tests a second fault "
+                    "during recovery)",
 }
 
 _lock = threading.Lock()

@@ -266,6 +266,13 @@ class NDArrayIter(DataIter):
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
 
+        # pre-shard views, retained for elastic_reshard(): numpy slices
+        # are views, so keeping these costs no extra memory
+        self._full_data = list(self.data)
+        self._full_label = list(self.label)
+        self._elastic = None
+        self._part_batch = None
+
         if num_parts > 1:
             lo, hi = shard_bounds(self.data[0][1].shape[0], num_parts,
                                   part_index)
@@ -420,6 +427,11 @@ class NDArrayIter(DataIter):
         return None
 
     def _shuffle_data(self):
+        if self._elastic is not None:
+            # elastic mode rebuilds the interleaved view per epoch (each
+            # owned part carries its own (seed, epoch) permutation)
+            self._elastic_view()
+            return
         # permute the ORIGINAL arrays with the (seed, epoch)-keyed
         # stream: any epoch's view is reconstructible without replaying
         # the epochs before it (the seek in restore_state)
@@ -429,6 +441,114 @@ class NDArrayIter(DataIter):
         self.data = [(k, v[perm]) for k, v in self._base_data]
         self.label = [(k, v[perm]) for k, v in self._base_label]
 
+    # -- elastic reshard (checkpoint-free rescale, see module.fit) --------
+    def elastic_reshard(self, base_world, owned_parts):
+        """Re-view this iterator as the union of several BASE-world
+        shards, microbatch-major — the input half of a checkpoint-free
+        rescale (``kvstore='dist_tpu_sync'`` elastic mode).
+
+        After the world shrinks from ``base_world`` ranks to ``W``
+        survivors, survivor ``j`` owns base parts
+        ``elastic.plan_microbatches(base_world, W, j)`` and each of its
+        steps feeds ``A = base_world // W`` microbatches of the original
+        per-rank batch ``L``.  This method rebuilds ``self.data`` so
+        batch ``t`` is ``[A*L, ...]`` with rows ``[a*L:(a+1)*L)`` taken
+        from base part ``owned_parts[a]``'s batch ``t`` — exactly the
+        rows base rank ``owned_parts[a]`` would have fed, including that
+        part's private ``(seed, epoch)`` shuffle permutation.  Stacked
+        over survivors on the global mesh (``make_accum_batch_global``),
+        microbatch ``a`` reproduces the pre-fault world's global batch
+        rows bit-for-bit, which is what makes the post-rescale loss
+        curve a bitwise continuation.
+
+        Bitwise replay of a DEAD rank's shuffle stream requires every
+        rank to have been constructed with the same explicit ``seed``
+        (per-rank random anchors are irrecoverable).  ``roll_over``
+        iterators cannot reshard (same reason they cannot seek).  Call
+        :meth:`restore_state` afterwards to seek to the agreed step."""
+        if self.last_batch_handle == "roll_over":
+            raise MXNetError("NDArrayIter(last_batch_handle='roll_over') "
+                             "cannot elastic_reshard: the carried tail "
+                             "is not reconstructible")
+        base_world = int(base_world)
+        owned = tuple(int(p) for p in owned_parts)
+        if not owned:
+            raise MXNetError("elastic_reshard: empty owned_parts")
+        for p in owned:
+            if not 0 <= p < base_world:
+                raise MXNetError("elastic_reshard: part %d out of range "
+                                 "for base_world %d" % (p, base_world))
+        if self._elastic is None:
+            if self.num_parts > 1 and self.num_parts != base_world:
+                raise MXNetError(
+                    "elastic_reshard: iterator was sharded %d-way but "
+                    "base_world is %d" % (self.num_parts, base_world))
+            # the per-rank batch of the BASE world, fixed across any
+            # number of reshards (grow back included)
+            self._part_batch = int(self.batch_size)
+        elif self._elastic[0] != base_world:
+            raise MXNetError("elastic_reshard: base_world changed from "
+                             "%d to %d" % (self._elastic[0], base_world))
+        self._elastic = (base_world, owned)
+        self.batch_size = len(owned) * self._part_batch
+        self._elastic_view()
+        self.num_data = self.data[0][1].shape[0]
+        self.idx = np.arange(self.num_data)
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+
+    def _elastic_view(self):
+        """Build the microbatch-major interleaved arrays for the current
+        epoch from the retained pre-shard views."""
+        base_world, owned = self._elastic
+        L = self._part_batch
+        n_full = self._full_data[0][1].shape[0]
+        bounds = [shard_bounds(n_full, base_world, p) for p in owned]
+        perms = {}
+        if self.shuffle:
+            for lo, hi in bounds:
+                if (hi - lo) not in perms:
+                    perms[hi - lo] = np.random.RandomState(
+                        mix_seed(self._seed, self._epoch)
+                        % (2 ** 32)).permutation(hi - lo)
+        nbs = set()
+        for lo, hi in bounds:
+            n = hi - lo
+            nbs.add(n // L if self.last_batch_handle == "discard"
+                    else -(-n // L))
+        if len(nbs) != 1:
+            raise MXNetError(
+                "elastic_reshard: owned parts yield unequal batch "
+                "counts %s (dataset size %d, base_world %d, per-part "
+                "batch %d) — parts must be the same number of batches "
+                "long" % (sorted(nbs), n_full, base_world, L))
+
+        def build(source):
+            out = []
+            for k, v in source:
+                secs = []
+                for lo, hi in bounds:
+                    part = v[lo:hi]
+                    if self.shuffle:
+                        part = part[perms[hi - lo]]
+                    n = part.shape[0]
+                    if self.last_batch_handle == "discard":
+                        nb = n // L
+                        part = part[:nb * L]
+                    else:           # pad: wrap with the part's own head,
+                        nb = -(-n // L)   # as the base rank itself would
+                        if nb * L > n:
+                            part = np.concatenate(
+                                [part, part[:nb * L - n]], axis=0)
+                    secs.append(part.reshape((nb, L) + part.shape[1:]))
+                out.append((k, np.concatenate(secs, axis=1).reshape(
+                    (-1,) + v.shape[1:])))
+            return out
+
+        self.data = build(self._full_data)
+        self.label = build(self._full_label)
+
     def checkpoint_state(self, epoch=None, nbatch=None):
         """Resumable cursor for the checkpoint manifest: everything a
         fresh process needs to continue this stream at (epoch, batch)
@@ -437,15 +557,20 @@ class NDArrayIter(DataIter):
         reconstruct, so it returns None (fit falls back to replay)."""
         if self.last_batch_handle == "roll_over":
             return None
-        return {"kind": "NDArrayIter",
-                "epoch": int(self._epoch if epoch is None else epoch),
-                "batch": int(nbatch or 0),
-                "seed": self._seed,
-                "shuffle": bool(self.shuffle),
-                "batch_size": int(self.batch_size),
-                "num_data": int(self.num_data),
-                "num_parts": self.num_parts,
-                "part_index": self.part_index}
+        state = {"kind": "NDArrayIter",
+                 "epoch": int(self._epoch if epoch is None else epoch),
+                 "batch": int(nbatch or 0),
+                 "seed": self._seed,
+                 "shuffle": bool(self.shuffle),
+                 "batch_size": int(self.batch_size),
+                 "num_data": int(self.num_data),
+                 "num_parts": self.num_parts,
+                 "part_index": self.part_index}
+        if self._elastic is not None:
+            state["elastic"] = {"base_world": self._elastic[0],
+                                "owned": list(self._elastic[1]),
+                                "part_batch": int(self._part_batch)}
+        return state
 
     def restore_state(self, cursor):
         """Seek to a :meth:`checkpoint_state` position: applies that
@@ -462,6 +587,11 @@ class NDArrayIter(DataIter):
         if cursor.get("kind") not in (None, "NDArrayIter"):
             raise MXNetError("io cursor kind %r is not an NDArrayIter "
                              "cursor" % cursor.get("kind"))
+        el = cursor.get("elastic")
+        if el and self._elastic is None:
+            # a cursor taken post-rescale seeks on a fresh (relaunched)
+            # iterator by first re-applying the reshard
+            self.elastic_reshard(el["base_world"], el["owned"])
         mine = {"shuffle": bool(self.shuffle),
                 "batch_size": int(self.batch_size),
                 "num_data": int(self.num_data),

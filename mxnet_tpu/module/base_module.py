@@ -251,6 +251,26 @@ class BaseModule(object):
                     resume_state.epoch, resume_state.nbatch,
                     ", iterator seeked" if io_seeked else "")
 
+        # -- elastic dist_tpu_sync (checkpoint-free rescale) ---------------
+        # JOIN mode: a relaunched rank asks the running world for
+        # admission BEFORE binding — the adopted plan brings the
+        # runtime up against the new coordinator and positions the
+        # (resharded) iterator at the agreed step; the kvstore init
+        # broadcast below then pulls the survivors' parameters.
+        from ..config import get as _cfg
+        _elastic = None
+        _el = None
+        _el_root = str(_cfg("MXNET_ELASTIC_DIR") or "")
+        if _el_root and int(_cfg("MXNET_ELASTIC_JOIN") or 0):
+            from .. import elastic as _el
+            _elastic, begin_epoch, skip_nbatch = _el.ElasticFit.join(
+                train_data)
+            io_seeked = True
+            self.logger.info(
+                "elastic: joined world=%d as rank %d, resuming at "
+                "epoch %d batch %d", _elastic.agent.world,
+                _elastic.agent.rank, begin_epoch, skip_nbatch)
+
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -261,6 +281,24 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        _kv_obj = getattr(self, "_kvstore", None)
+        if _el_root and _kv_obj is not None and \
+                getattr(_kv_obj, "type", "") == "dist_tpu_sync" and \
+                hasattr(self, "elastic_snapshot"):
+            if _el is None:
+                from .. import elastic as _el
+            if _elastic is None:
+                _elastic = _el.ElasticFit.for_world(self, train_data,
+                                                    _kv_obj)
+            _elastic.after_init(self, begin_epoch, skip_nbatch)
+        elif _elastic is not None:
+            raise MXNetError(
+                "elastic join mode needs a dist_tpu_sync kvstore with "
+                "a fused-step-capable module (got kvstore %r)"
+                % getattr(_kv_obj, "type", kvstore))
+        _rescale_errors = _el.rescale_errors() if _elastic is not None \
+            else ()
 
         if resume_state is not None:
             # a module whose params were already live before this fit
@@ -310,163 +348,193 @@ class BaseModule(object):
                 prev_handler = None
 
         try:
-            for epoch in range(begin_epoch, num_epoch):
-                tic = time.time()
-                eval_metric.reset()
-                nbatch = 0
-                data_iter = iter(train_data)
-                if skip_nbatch:
-                    if io_seeked:
-                        # the iterator is already at the cursor; only
-                        # the batch numbering needs to line up
-                        nbatch = skip_nbatch
-                    else:
-                        # mid-epoch resume without a seekable cursor:
-                        # draw and discard the batches the interrupted
-                        # run already trained on, so the iterator
-                        # position and batch numbering line up with the
-                        # uninterrupted run
-                        for _ in range(skip_nbatch):
-                            try:
-                                next(data_iter)
-                            except StopIteration:
-                                break
-                            nbatch += 1
-                    skip_nbatch = 0
-                io_seeked = False
-                end_of_batch = False
-                eval_name_vals = eval_metric.get_name_value()
+            while True:
                 try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
-                while not end_of_batch:
-                    data_batch = next_data_batch
-                    _fault.inject("engine.step")
-                    # per-step trace timeline: one root span per step
-                    # (head-sampled), with the phase split a stall
-                    # investigation needs — was the step waiting on
-                    # data, on forward-backward, or on the optimizer?
-                    with _tr.start_span("train.step",
-                                        attrs={"epoch": epoch,
-                                               "nbatch": nbatch}):
-                        if monitor is not None:
-                            monitor.tic()
+                    for epoch in range(begin_epoch, num_epoch):
+                        tic = time.time()
+                        eval_metric.reset()
+                        nbatch = 0
+                        data_iter = iter(train_data)
+                        if skip_nbatch:
+                            if io_seeked:
+                                # the iterator is already at the cursor; only
+                                # the batch numbering needs to line up
+                                nbatch = skip_nbatch
+                            else:
+                                # mid-epoch resume without a seekable cursor:
+                                # draw and discard the batches the interrupted
+                                # run already trained on, so the iterator
+                                # position and batch numbering line up with the
+                                # uninterrupted run
+                                for _ in range(skip_nbatch):
+                                    try:
+                                        next(data_iter)
+                                    except StopIteration:
+                                        break
+                                    nbatch += 1
+                            skip_nbatch = 0
+                        io_seeked = False
+                        end_of_batch = False
+                        eval_name_vals = eval_metric.get_name_value()
                         try:
-                            with _tr.child_span("train.forward_backward"):
-                                self.forward_backward(data_batch)
-                            with _tr.child_span("train.update"):
-                                self.update()
+                            next_data_batch = next(data_iter)
+                        except StopIteration:
+                            end_of_batch = True
+                        while not end_of_batch:
+                            data_batch = next_data_batch
+                            _fault.inject("engine.step")
+                            if _elastic is not None:
+                                # raises MembershipChange on a stale
+                                # peer heartbeat or a pending joiner
+                                _elastic.pre_step(epoch, nbatch)
+                            # per-step trace timeline: one root span per step
+                            # (head-sampled), with the phase split a stall
+                            # investigation needs — was the step waiting on
+                            # data, on forward-backward, or on the optimizer?
+                            with _tr.start_span("train.step",
+                                                attrs={"epoch": epoch,
+                                                       "nbatch": nbatch}):
+                                if monitor is not None:
+                                    monitor.tic()
+                                try:
+                                    with _tr.child_span("train.forward_backward"):
+                                        self.forward_backward(data_batch)
+                                    with _tr.child_span("train.update"):
+                                        if _elastic is not None:
+                                            # step watchdog: a peer dying
+                                            # mid-collective can park this
+                                            # call forever on TPU
+                                            _elastic.run_update()
+                                        else:
+                                            self.update()
+                                except _health.NumericsError:
+                                    # policy checkpoint-and-raise: preserve the
+                                    # tripped state under a FORENSIC prefix (the
+                                    # nonfinite params are the blast-radius
+                                    # evidence) without clobbering the recovery
+                                    # chain load_latest_valid walks, then stop
+                                    if (checkpoint_prefix is not None
+                                            and _health.numerics_policy()
+                                            == "checkpoint-and-raise"):
+                                        self._save_fit_checkpoint(
+                                            checkpoint_prefix + ".numerics",
+                                            epoch, nbatch + 1,
+                                            save_optimizer_states, train_data)
+                                    raise
+                                if isinstance(data_batch, list):
+                                    self.update_metric(
+                                        eval_metric,
+                                        [db.label for db in data_batch],
+                                        pre_sliced=True)
+                                else:
+                                    self.update_metric(eval_metric,
+                                                       data_batch.label)
+                                if _elastic is not None:
+                                    # the metric sync above proved the
+                                    # step's arrays are materialized:
+                                    # vote it completed and refresh the
+                                    # host param mirror survivors would
+                                    # restore from
+                                    _elastic.note_step(epoch, nbatch + 1)
+                                fetched = None
+                                with _tr.child_span("train.data_wait"):
+                                    try:
+                                        fetched = next(data_iter)
+                                    except StopIteration:
+                                        end_of_batch = True
+                                if fetched is not None:
+                                    next_data_batch = fetched
+                                    try:
+                                        self.prepare(
+                                            next_data_batch,
+                                            sparse_row_id_fn=sparse_row_id_fn)
+                                    except StopIteration:
+                                        end_of_batch = True
+                            if monitor is not None:
+                                monitor.toc_print()
+                            if end_of_batch:
+                                eval_name_vals = eval_metric.get_name_value()
+                            if batch_end_callback is not None:
+                                params = _BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                                        eval_metric=eval_metric,
+                                                        locals=locals())
+                                for callback in _as_list(batch_end_callback):
+                                    callback(params)
+                            nbatch += 1
+                            if preempt["flag"]:
+                                if end_of_batch:
+                                    self._save_fit_checkpoint(
+                                        checkpoint_prefix, epoch + 1, 0,
+                                        save_optimizer_states, train_data)
+                                else:
+                                    self._save_fit_checkpoint(
+                                        checkpoint_prefix, epoch, nbatch,
+                                        save_optimizer_states, train_data)
+                                if preempt["watchdog"] is not None:
+                                    preempt["watchdog"].cancel()
+                                self.logger.info(
+                                    "preemption checkpoint saved at epoch %d "
+                                    "batch %d; stopping fit (resume=True picks "
+                                    "up here)", epoch, nbatch)
+                                return
+
+                        # drain the deferred numerics sentinel of the epoch's
+                        # final step (its verdict is read one step behind so
+                        # the device pipeline never stalls)
+                        try:
+                            self._flush_numerics()
                         except _health.NumericsError:
-                            # policy checkpoint-and-raise: preserve the
-                            # tripped state under a FORENSIC prefix (the
-                            # nonfinite params are the blast-radius
-                            # evidence) without clobbering the recovery
-                            # chain load_latest_valid walks, then stop
                             if (checkpoint_prefix is not None
                                     and _health.numerics_policy()
                                     == "checkpoint-and-raise"):
                                 self._save_fit_checkpoint(
-                                    checkpoint_prefix + ".numerics",
-                                    epoch, nbatch + 1,
-                                    save_optimizer_states, train_data)
+                                    checkpoint_prefix + ".numerics", epoch,
+                                    nbatch, save_optimizer_states, train_data)
                             raise
-                        if isinstance(data_batch, list):
-                            self.update_metric(
-                                eval_metric,
-                                [db.label for db in data_batch],
-                                pre_sliced=True)
-                        else:
-                            self.update_metric(eval_metric,
-                                               data_batch.label)
-                        fetched = None
-                        with _tr.child_span("train.data_wait"):
-                            try:
-                                fetched = next(data_iter)
-                            except StopIteration:
-                                end_of_batch = True
-                        if fetched is not None:
-                            next_data_batch = fetched
-                            try:
-                                self.prepare(
-                                    next_data_batch,
-                                    sparse_row_id_fn=sparse_row_id_fn)
-                            except StopIteration:
-                                end_of_batch = True
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if end_of_batch:
-                        eval_name_vals = eval_metric.get_name_value()
-                    if batch_end_callback is not None:
-                        params = _BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                eval_metric=eval_metric,
-                                                locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(params)
-                    nbatch += 1
-                    if preempt["flag"]:
-                        if end_of_batch:
-                            self._save_fit_checkpoint(
-                                checkpoint_prefix, epoch + 1, 0,
-                                save_optimizer_states, train_data)
-                        else:
-                            self._save_fit_checkpoint(
-                                checkpoint_prefix, epoch, nbatch,
-                                save_optimizer_states, train_data)
-                        if preempt["watchdog"] is not None:
-                            preempt["watchdog"].cancel()
-                        self.logger.info(
-                            "preemption checkpoint saved at epoch %d "
-                            "batch %d; stopping fit (resume=True picks "
-                            "up here)", epoch, nbatch)
-                        return
 
-                # drain the deferred numerics sentinel of the epoch's
-                # final step (its verdict is read one step behind so
-                # the device pipeline never stalls)
-                try:
-                    self._flush_numerics()
-                except _health.NumericsError:
-                    if (checkpoint_prefix is not None
-                            and _health.numerics_policy()
-                            == "checkpoint-and-raise"):
-                        self._save_fit_checkpoint(
-                            checkpoint_prefix + ".numerics", epoch,
-                            nbatch, save_optimizer_states, train_data)
-                    raise
+                        for name, val in eval_name_vals:
+                            self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                             val)
+                        toc = time.time()
+                        self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                         (toc - tic))
 
-                for name, val in eval_name_vals:
-                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
-                                     val)
-                toc = time.time()
-                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                                 (toc - tic))
+                        arg_p, aux_p = self.get_params()
+                        self.set_params(arg_p, aux_p)
+                        if epoch_end_callback is not None:
+                            for callback in _as_list(epoch_end_callback):
+                                callback(epoch, self.symbol, arg_p, aux_p)
+                        if checkpoint_prefix is not None and \
+                                (epoch + 1) % checkpoint_period == 0:
+                            self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
+                                                      0, save_optimizer_states,
+                                                      train_data)
 
-                arg_p, aux_p = self.get_params()
-                self.set_params(arg_p, aux_p)
-                if epoch_end_callback is not None:
-                    for callback in _as_list(epoch_end_callback):
-                        callback(epoch, self.symbol, arg_p, aux_p)
-                if checkpoint_prefix is not None and \
-                        (epoch + 1) % checkpoint_period == 0:
-                    self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
-                                              0, save_optimizer_states,
-                                              train_data)
-
-                if eval_data is not None:
-                    res = self.score(eval_data, validation_metric,
-                                     score_end_callback=eval_end_callback,
-                                     batch_end_callback=eval_batch_end_callback,
-                                     epoch=epoch)
-                    for name, val in res:
-                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                         name, val)
-                train_data.reset()
+                        if eval_data is not None:
+                            res = self.score(eval_data, validation_metric,
+                                             score_end_callback=eval_end_callback,
+                                             batch_end_callback=eval_batch_end_callback,
+                                             epoch=epoch)
+                            for name, val in res:
+                                self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                                 name, val)
+                        train_data.reset()
+                except _rescale_errors as _mchange:
+                    # a membership change (dead peer, wedged
+                    # collective, pending joiner): run the rescale
+                    # barrier, rebuild on the surviving mesh, and
+                    # re-enter the loop at the agreed step
+                    begin_epoch, skip_nbatch = _elastic.handle(_mchange)
+                    io_seeked = True
+                    continue
+                break
         finally:
             if prev_handler is not None:
                 signal.signal(signal.SIGTERM, prev_handler)
             if preempt["watchdog"] is not None:
                 preempt["watchdog"].cancel()
+            if _elastic is not None:
+                _elastic.stop()
             # deterministic teardown of prefetch threads / decode
             # workers (close() is restartable, so handing the same
             # iterator to a second fit still works)

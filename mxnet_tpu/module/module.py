@@ -77,6 +77,10 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._fused_batch = None
+        # >1 after an elastic rescale: each step runs this many
+        # sequential gradient microbatches inside the fused program
+        # (the per-rank batch is the base world's batch x accum)
+        self._elastic_accum = 1
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -509,8 +513,29 @@ class Module(BaseModule):
             states[name] = opt.fused_state_arrays(
                 updater.ensure_state(i, weight))
             hyper[name] = optimizer.fused_hyper(i)
-        exe.train_step(optimizer.fused_rule(), tuple(update_names),
-                       states, hyper, feed=feed)
+        accum = int(self._elastic_accum)
+        if accum > 1:
+            # elastic mode: the local batch [A*L, ...] is A microbatches
+            # of the BASE world's per-rank batch L, run sequentially
+            # inside the program with a fixed accumulation order (the
+            # bitwise-continuation contract, see Executor.train_step)
+            import numpy as _np
+            mb = {}
+            for name, arr in feed.items():
+                v = arr.asnumpy() if hasattr(arr, "asnumpy") \
+                    else _np.asarray(arr)
+                if v.shape[0] % accum:
+                    raise MXNetError(
+                        "elastic accum: batch dim %d of '%s' is not "
+                        "divisible by accum factor %d"
+                        % (v.shape[0], name, accum))
+                mb[name] = v.reshape((accum, v.shape[0] // accum)
+                                     + v.shape[1:])
+            exe.train_step(optimizer.fused_rule(), tuple(update_names),
+                           states, hyper, accum_feed=mb)
+        else:
+            exe.train_step(optimizer.fused_rule(), tuple(update_names),
+                           states, hyper, feed=feed)
 
     def update(self):
         """Apply optimizer to gradients (reference: module.py:644 →
@@ -570,7 +595,20 @@ class Module(BaseModule):
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        eval_metric.update(labels, self.get_outputs())
+        outputs = self.get_outputs()
+        if self._elastic_accum > 1 and outputs:
+            # accum outputs are stacked [A, world*L, ...]; the metric
+            # contract is flat local rows matching the local labels
+            # [A*L, ...] — take this host's view and flatten the
+            # microbatch dim back into the batch dim
+            from ..ndarray.ndarray import array as _arr
+            flat = []
+            for o in outputs:
+                loc = o.asnumpy()
+                flat.append(_arr(loc.reshape((-1,) + loc.shape[2:]))
+                            if loc.ndim >= 2 else o)
+            outputs = flat
+        eval_metric.update(labels, outputs)
 
     def install_monitor(self, mon):
         assert self.binded
@@ -608,3 +646,56 @@ class Module(BaseModule):
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self.optimizer_initialized = True
+
+    # -- elastic rescale (checkpoint-free, driven by BaseModule.fit) -------
+    def elastic_snapshot(self):
+        """Host-side mirror of everything a checkpoint-free rescale
+        carries across the runtime teardown: parameters, auxiliary
+        states, optimizer state, and the optimizer's schedule counters.
+        Pure host copies — after a peer death the device arrays
+        (donated into the global mesh) are poisoned, so the
+        step-boundary mirror is the only recoverable truth."""
+        assert self.binded and self.params_initialized
+        exe = self._exec
+        snap = {"arg_params": {n: exe.arg_dict[n].asnumpy().copy()
+                               for n in self._param_names},
+                "aux_params": {n: exe.aux_dict[n].asnumpy().copy()
+                               for n in self._aux_names}}
+        if self._updater is not None:
+            snap["updater"] = self._updater.get_states(dump_optimizer=False)
+        if self._optimizer is not None:
+            snap["opt_counts"] = dict(self._optimizer._index_update_count)
+            snap["num_update"] = int(self._optimizer.num_update)
+        return snap
+
+    def elastic_restore(self, snapshot, data_shapes, label_shapes=None,
+                        kvstore="dist_tpu_sync", accum=1):
+        """Rebuild this module on the CURRENT (post-``dist_runtime.
+        reinit``) runtime from an :meth:`elastic_snapshot`: fresh
+        executor over the new global mesh, parameters and optimizer
+        state from the mirror, gradient-accumulation factor ``accum``.
+        The optimizer INSTANCE is kept and its lr-schedule counters are
+        restored from the mirror, so the re-executed step sees exactly
+        the schedule the unfaulted twin saw."""
+        from ..ndarray.ndarray import array as _arr
+        optimizer = self._optimizer
+        self._elastic_accum = int(accum)
+        self._fused_batch = None
+        # host mirrors become the bind-time source of truth — the old
+        # _arg_params wrap device buffers of the torn-down runtime
+        self._arg_params = {k: _arr(v)
+                            for k, v in snapshot["arg_params"].items()}
+        self._aux_params = {k: _arr(v)
+                            for k, v in snapshot["aux_params"].items()}
+        self._params_dirty = False
+        self.bind(data_shapes=data_shapes, label_shapes=label_shapes,
+                  for_training=True, force_rebind=True)
+        self.optimizer_initialized = False
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            force_init=True)
+        if snapshot.get("updater") is not None and self._updater is not None:
+            self._updater.set_states(snapshot["updater"])
+        if snapshot.get("opt_counts") is not None and optimizer is not None:
+            optimizer._index_update_count = dict(snapshot["opt_counts"])
+            optimizer.num_update = int(snapshot.get("num_update",
+                                                    optimizer.num_update))

@@ -13,7 +13,7 @@ import threading
 __all__ = ["make_mesh", "current_mesh", "set_mesh", "data_parallel_sharding",
            "replicated_sharding", "global_dp_mesh", "mesh_process_count",
            "host_local_value", "make_replicated_global",
-           "make_batch_global"]
+           "make_batch_global", "make_accum_batch_global"]
 
 _state = threading.local()
 
@@ -138,5 +138,32 @@ def make_batch_global(mesh, host_local_batch, axis="dp"):
     chunks = np.split(data, len(local))
     nproc = mesh_process_count(mesh)
     gshape = (data.shape[0] * nproc,) + data.shape[1:]
+    arrs = [jax.device_put(c, d) for c, d in zip(chunks, local)]
+    return jax.make_array_from_single_device_arrays(gshape, sh, arrs)
+
+
+def make_accum_batch_global(mesh, host_local_batch, axis="dp"):
+    """Microbatched global batch for the gradient-accumulation fused
+    step: local rows ``[A, L, ...]`` (A microbatches of L rows each)
+    assemble into a global ``[A, world*L, ...]`` sharded on dim **1**
+    (``P(None, 'dp')``) — microbatch ``a``'s global rows are the
+    concatenation of every process's ``a``-th microbatch, exactly the
+    rows the pre-rescale world's ranks ``a*world..(a+1)*world-1`` fed
+    in one step (see ``elastic.plan_microbatches``)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    data = np.asarray(host_local_batch)
+    if data.ndim < 2:
+        raise ValueError("accum batch needs shape [A, L, ...], got %s"
+                         % (tuple(data.shape),))
+    sh = NamedSharding(mesh, P(None, axis, *([None] * (data.ndim - 2))))
+    make = getattr(jax, "make_array_from_process_local_data", None)
+    if make is not None:
+        return make(sh, data)
+    local = list(mesh.local_devices)
+    chunks = np.split(data, len(local), axis=1)
+    nproc = mesh_process_count(mesh)
+    gshape = (data.shape[0], data.shape[1] * nproc) + data.shape[2:]
     arrs = [jax.device_put(c, d) for c, d in zip(chunks, local)]
     return jax.make_array_from_single_device_arrays(gshape, sh, arrs)

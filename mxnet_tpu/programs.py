@@ -131,6 +131,15 @@ def version_salt():
     return _salt_cache[0]
 
 
+def invalidate_version_salt():
+    """Drop the memoized salt.  The elastic rescale path calls this
+    after a shutdown→reinit cycle: the salt embeds ``processes=N`` and
+    the device topology, both of which just changed — programs built
+    for the new world must re-fingerprint (and hit the persistent
+    compile cache on disk, not replay a stale executable)."""
+    _salt_cache[0] = None
+
+
 def graph_hash(obj):
     """Stable graph fingerprint component. Accepts a Symbol (hashes its
     json), a string (hashed as-is), or any JSON-able structure."""

@@ -111,7 +111,7 @@ def test_bench_headline_prefers_harness2(tmp_path, monkeypatch):
     import bench
     importlib.reload(bench)
     bench._quiesce_daemon = lambda *a, **k: None
-    bench._live_run = lambda *a, **k: False
+    bench._live_run = lambda *a, **k: (False, 0)  # (ok, tunnel_retries)
     import contextlib
     import io as _io
     buf = _io.StringIO()
