@@ -2008,6 +2008,119 @@ def health_overhead(batch=256, hidden=1024, iters=25, rounds=8):
 
 
 # ---------------------------------------------------------------------------
+# goodput-ledger overhead job (goodput.py cost-model proof)
+
+def goodput_overhead(batch=256, hidden=1024, iters=25, rounds=8):
+    """Fused-step wall with the goodput ledger off / on, banked
+    min-of-rounds with the order alternated (health_overhead's
+    drift-cancelling discipline, same probe MLP). The "on" loop runs
+    exactly the hooks the fit loop runs per step
+    (:func:`goodput.step_begin` / :func:`goodput.step_end` inside an
+    active session); "off" runs the same hook calls gated off by
+    ``goodput.enable(False)`` — the production fast path.
+
+    RAISES when on-mode overhead exceeds 2% (above the harness noise
+    floor), or when the ledger adds even ONE device dispatch: the
+    ledger is pure host arithmetic, and ``op/dispatch_total`` deltas
+    for the on and off loops must be identical."""
+    import mxnet_tpu as mx
+    from . import goodput as _gp
+    from . import telemetry as _tm
+    from .context import current_context
+    from .io import DataBatch
+    from .module import Module
+
+    data = mx.sym.Variable("data")
+    h1 = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=hidden, name="fc1"), act_type="relu")
+    h2 = mx.sym.Activation(mx.sym.FullyConnected(
+        h1, num_hidden=hidden, name="fc2"), act_type="relu")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        h2, num_hidden=10, name="fc3"), name="softmax")
+
+    mod = Module(sym, context=current_context())
+    mod.bind(data_shapes=[("data", (batch, hidden))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    db = DataBatch(
+        data=[mx.nd.array(rng.randn(batch, hidden).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, size=(batch,))
+                           .astype(np.float32))])
+
+    def _dispatches():
+        fam = _tm.REGISTRY._families.get("op/dispatch_total")
+        return sum(c.value for _lv, c in fam.series()) if fam else 0
+
+    prev_on = _gp.enabled()
+    _gp.reset()
+
+    def loop(on):
+        _gp.enable(on)
+        if on and not _gp.active():
+            _gp.session_begin()
+        pname = mod._param_names[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            tok = _gp.step_begin()
+            mod.forward_backward(db)
+            mod.update()
+            _gp.step_end(tok)
+        _fetch(mod._exec.arg_dict[pname]._data)
+        return time.perf_counter() - t0
+
+    configs = (("off", False), ("on", True), ("off2", False))
+    try:
+        for _name, on in configs:
+            loop(on)                     # warm both gate states
+        # dispatch-count neutrality: the ledger must not add a single
+        # device dispatch to the measured step loop
+        d0 = _dispatches()
+        loop(False)
+        d_off = _dispatches() - d0
+        d0 = _dispatches()
+        loop(True)
+        d_on = _dispatches() - d0
+        best = {name: float("inf") for name, _ in configs}
+        for rnd in range(rounds):
+            order = configs if rnd % 2 == 0 else tuple(reversed(configs))
+            for name, on in order:
+                best[name] = min(best[name], loop(on))
+    finally:
+        _gp.enable(prev_on)
+        _gp.reset()
+
+    ms = {k: v / iters * 1e3 for k, v in best.items()}
+    pct = {k: round((ms[k] / ms["off"] - 1.0) * 100, 2) for k in ms}
+    noise_pct = abs(pct["off2"])
+    extra = {
+        "ms_per_step_off": round(ms["off"], 3),
+        "ms_per_step_on": round(ms["on"], 3),
+        "overhead_pct_on": pct["on"],
+        "harness_noise_pct": noise_pct,
+        "dispatches_per_loop_off": d_off,
+        "dispatches_per_loop_on": d_on,
+        "batch": batch, "hidden": hidden,
+        "loop": "min-of-%d rounds, order alternated; off2 = off "
+                "re-measured (noise floor)" % rounds,
+    }
+    if d_on != d_off:
+        raise RuntimeError(
+            "goodput ledger changed the dispatch count: %d dispatches "
+            "with the ledger on vs %d off over %d steps — the ledger "
+            "must be pure host arithmetic" % (d_on, d_off, iters))
+    if pct["on"] > max(2.0, 2 * noise_pct):
+        raise RuntimeError(
+            "goodput ledger overhead %.2f%% exceeds the 2%% budget and "
+            "the %.2f%% harness noise floor (off %.3f ms vs on %.3f ms "
+            "per step)" % (pct["on"], noise_pct, ms["off"], ms["on"]))
+    return 1e3 / ms["on"], extra
+
+
+# ---------------------------------------------------------------------------
 # compiler-forensics overhead job (forensics.py capture-cost proof)
 
 _FORENSICS_DRIVER = r'''
@@ -3331,6 +3444,15 @@ def _job_health_overhead():
                    "the 2%% step-mode budget)", x, host_metric=True)
 
 
+def _job_goodput_overhead():
+    v, x = goodput_overhead()
+    return persist("goodput_overhead_steps_per_sec", v,
+                   "fused steps/s with the goodput ledger on (off/on "
+                   "overhead %% + dispatch-neutrality proof in extras; "
+                   "raises past the 2%% budget or on any extra "
+                   "dispatch)", x, host_metric=True)
+
+
 def _job_forensics_overhead():
     v, x = forensics_overhead()
     return persist("forensics_overhead_warmups_per_sec", v,
@@ -3404,6 +3526,7 @@ def _make_infer_job(model, dtype, batch=32):
 JOBS = {
     "trace_overhead": _job_trace_overhead,
     "health_overhead": _job_health_overhead,
+    "goodput_overhead": _job_goodput_overhead,
     "forensics_overhead": _job_forensics_overhead,
     "kernel_burn_down": _job_kernel_burn_down,
     "train_resume": _job_train_resume,
@@ -3444,6 +3567,7 @@ JOB_PRIORITY = [
     "mlp_train_fused",
     "trace_overhead",
     "health_overhead",
+    "goodput_overhead",
     "forensics_overhead",
     "kernel_burn_down",
     "train_resume",

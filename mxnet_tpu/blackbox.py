@@ -51,7 +51,7 @@ import zlib
 from .base import MXNetError
 
 __all__ = ["EVENTS", "enabled", "configure", "record_event", "read_events",
-           "tail", "records_written", "path", "reset"]
+           "tail", "merge_rings", "records_written", "path", "reset"]
 
 _MAGIC = b"FR"
 _HEADER = struct.Struct("<4sII")     # magic (padded to 4) + len + crc
@@ -288,6 +288,40 @@ def tail(n=20, target=None):
     except MXNetError:
         return []
     return events[-n:]
+
+
+def merge_rings(paths):
+    """Merge N processes' flight rings into ONE ordered incident
+    timeline (the cluster observatory's post-mortem view: a victim's
+    ``fault`` record, the survivor's ``member_lost`` and ``rescale``
+    records, and a replica's ``replica_death`` interleave in causal
+    order). Every record carries a wall-clock ``t`` stamped at write
+    time, which is the merge key; records with equal ``t`` keep their
+    per-ring append order. Each merged event gains a ``ring`` field
+    (the source path); a ring's torn tail (SIGKILL mid-frame) is
+    reported per ring under ``abandoned`` — the events before the tear
+    are all present, none duplicated, none lost.
+
+    Returns ``{"events": [...], "abandoned": {path: torn_bytes},
+    "rings": [...], "count": N}``."""
+    rows = []
+    abandoned = {}
+    rings = []
+    for ridx, path in enumerate(paths):
+        path = os.fspath(path)
+        rings.append(path)
+        try:
+            events, torn = read_events(path)
+        except MXNetError:
+            events, torn = [], 0
+        abandoned[path] = torn
+        for i, ev in enumerate(events):
+            e = dict(ev)
+            e["ring"] = path
+            rows.append((float(e.get("t", 0.0)), ridx, i, e))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    return {"events": [r[3] for r in rows], "abandoned": abandoned,
+            "rings": rings, "count": len(rows)}
 
 
 # ---------------------------------------------------------------------------

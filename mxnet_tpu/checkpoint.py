@@ -552,10 +552,19 @@ class ProcessSupervisor(object):
         server)."""
         import subprocess
         import time as _time
+        prev_exit_ts = None
         while True:
             run_env = env
+            if prev_exit_ts is not None:
+                # stamp the predecessor's death time into the relaunch
+                # env: the child's goodput ledger books the supervisor
+                # gap as `restart` (goodput.py session_begin)
+                run_env = dict(env) if env is not None \
+                    else dict(os.environ)
+                run_env["MXNET_GOODPUT_PREV_EXIT_TS"] = repr(prev_exit_ts)
             if self.env_hook is not None:
-                base = dict(env) if env is not None else dict(os.environ)
+                base = dict(run_env) if run_env is not None \
+                    else dict(os.environ)
                 overrides = self.env_hook(self.launches, base)
                 if overrides:
                     run_env = base
@@ -568,6 +577,7 @@ class ProcessSupervisor(object):
             rc = subprocess.call(cmd, env=run_env, cwd=cwd)
             if rc == 0:
                 return 0
+            prev_exit_ts = _time.time()
             _reason, relaunch = self.triage(rc)
             if not relaunch:
                 return rc

@@ -456,6 +456,37 @@ VARS = {
                                  "gauge (|measured/hand-counted - 1| "
                                  "from bench runs) above this fires "
                                  "/alerts in events mode."),
+    "MXNET_SLO_BADPUT_FRACTION": (float, 0.5,
+                                  "Default badput_fraction SLO rule "
+                                  "threshold on the goodput/"
+                                  "badput_fraction gauge: the fraction "
+                                  "of run wall NOT spent in useful "
+                                  "training-step compute sustained "
+                                  "above this fires /alerts."),
+    "MXNET_GOODPUT": (bool, True,
+                      "Training goodput ledger (goodput.py): "
+                      "attribute every wall-second of a fit to one "
+                      "category (step_compute/data_wait/compile/"
+                      "checkpoint/rescale/restart/straggler_wait/"
+                      "idle). Pure host arithmetic, zero extra device "
+                      "dispatches; 0 removes the fit-loop hooks."),
+    "MXNET_GOODPUT_PREV_EXIT_TS": (str, "",
+                                   "Unix timestamp of the supervised "
+                                   "predecessor process's death, "
+                                   "stamped into a relaunched child's "
+                                   "env by checkpoint."
+                                   "ProcessSupervisor.run so the "
+                                   "child's goodput ledger books the "
+                                   "relaunch gap as `restart`. Not "
+                                   "set by hand."),
+    "MXNET_OBSERVATORY_TIMEOUT_S": (float, 2.0,
+                                    "Per-peer HTTP timeout of the "
+                                    "cluster observatory's read-only "
+                                    "scrapes (observatory.py); a peer "
+                                    "that cannot answer within it "
+                                    "counts one observatory/"
+                                    "scrape_failures_total and is "
+                                    "skipped, never raised."),
     "MXNET_FORENSICS": (int, 0,
                         "Compiler-forensics capture (forensics.py): "
                         "after health.capture_cost registers a "

@@ -75,6 +75,15 @@ def _tm():
     return telemetry
 
 
+def _telemetry_endpoint():
+    """``"host:port"`` of this process's running metrics server, or
+    None — what the heartbeat publishes for observatory discovery."""
+    try:
+        return _tm().server_endpoint()
+    except Exception:
+        return None
+
+
 class StepStallError(MXNetError):
     """A fused train step exceeded ``MXNET_STEP_TIMEOUT_S`` — the
     signature of a rank parked in a collective whose peer died without
@@ -275,10 +284,17 @@ class ElasticAgent(object):
                         {"nonce": self.nonce, "pid": os.getpid(),
                          "host": self.host, "ts": now})
         elif self.rank is not None:
-            _write_json(self._hb_path(self.gen, self.rank),
-                        {"rank": self.rank, "pid": os.getpid(),
-                         "host": self.host, "step": list(self.step),
-                         "ts": now})
+            rec = {"rank": self.rank, "pid": os.getpid(),
+                   "host": self.host, "step": list(self.step),
+                   "ts": now}
+            # publish this rank's telemetry endpoint so the cluster
+            # observatory (observatory.py) can discover and scrape it
+            # with zero extra configuration — absent when no metrics
+            # server is running in this process
+            ep = _telemetry_endpoint()
+            if ep:
+                rec["telemetry"] = ep
+            _write_json(self._hb_path(self.gen, self.rank), rec)
 
     def _beat_loop(self):
         while not self._stop.wait(self.hb_s):
@@ -699,6 +715,15 @@ class ElasticFit(object):
         if hasattr(td, "restore_state"):
             td.restore_state({"epoch": epoch, "batch": nbatch})
         wall = time.monotonic() - t0
+        # goodput: the whole outage window — from the failing step's
+        # start through detection, barrier, reinit, reshard, restore —
+        # is unaccounted (the step never reached step_end); close it
+        # into the `rescale` category (compile deltas stay in `compile`)
+        try:
+            from . import goodput as _gp
+            _gp.note_since_last("rescale")
+        except Exception:
+            pass
         _bb.record_event("rescale", old_world=int(old_world),
                          world=int(agent.world), gen=int(agent.gen),
                          epoch=int(epoch), nbatch=int(nbatch),

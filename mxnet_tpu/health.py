@@ -809,6 +809,12 @@ def _ensure_defaults():
                       "from the hand-counted estimate past "
                       "MXNET_SLO_MFU_DIVERGENCE (a single divergent "
                       "bench sample fires)")
+    watch("badput_fraction", gauge="goodput/badput_fraction",
+          threshold=float(_config("MXNET_SLO_BADPUT_FRACTION", 0.5)),
+          description="goodput ledger: fraction of run wall NOT spent "
+                      "in useful training-step compute sustained above "
+                      "MXNET_SLO_BADPUT_FRACTION (compiles, data "
+                      "waits, rescales, restarts, idle)")
 
 
 def set_interval(seconds):

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import pickle
+import time
 
 import numpy as _np
 
@@ -28,6 +29,16 @@ from . import telemetry as _tm
 from . import tracing as _tr
 
 __all__ = ["KVStore", "create", "TransientKVError"]
+
+
+def _note_straggler_wait(seconds):
+    """Book time parked at a distributed rendezvous into the goodput
+    ledger's `straggler_wait` category (no-op without a live ledger)."""
+    try:
+        from . import goodput as _gp
+        _gp.note("straggler_wait", seconds)
+    except Exception:
+        pass
 
 # PS ops that mutate server state: they carry a sequence number so a
 # retried/resent RPC whose first copy already applied (reply lost on a
@@ -762,15 +773,19 @@ class KVStore(object):
         sat at the rendezvous (straggler forensics)."""
         self._check_open("barrier")
         if self._sock is not None:
+            _t0 = time.perf_counter()
             with _tr.child_span("kv.barrier_wait"):
                 self._ps_call("BARRIER")
+            _note_straggler_wait(time.perf_counter() - _t0)
             self._barrier_count += 1
             return
         import jax
         if self.num_workers > 1:
             from jax.experimental import multihost_utils
+            _t0 = time.perf_counter()
             multihost_utils.sync_global_devices(
                 "kvstore_barrier_%d" % self._barrier_count)
+            _note_straggler_wait(time.perf_counter() - _t0)
         self._barrier_count += 1
 
     def num_dead_node(self, node_id=0, timeout=None):

@@ -12,6 +12,7 @@ import threading
 import time
 
 from .. import fault as _fault
+from .. import goodput as _gp
 from .. import health as _health
 from .. import metric as _metric
 from .. import io as _io
@@ -347,6 +348,12 @@ class BaseModule(object):
             except ValueError:
                 prev_handler = None
 
+        # goodput ledger: attribute every wall-second of this fit to one
+        # category (step compute / data wait / compile / checkpoint /
+        # rescale / restart / straggler wait / idle) — pure host
+        # arithmetic, zero device dispatches (goodput.py)
+        _gp.session_begin()
+
         try:
             while True:
                 try:
@@ -387,6 +394,8 @@ class BaseModule(object):
                                 # raises MembershipChange on a stale
                                 # peer heartbeat or a pending joiner
                                 _elastic.pre_step(epoch, nbatch)
+                            _gp_tok = _gp.step_begin()
+                            _gp_dw = 0.0
                             # per-step trace timeline: one root span per step
                             # (head-sampled), with the phase split a stall
                             # investigation needs — was the step waiting on
@@ -438,10 +447,12 @@ class BaseModule(object):
                                     _elastic.note_step(epoch, nbatch + 1)
                                 fetched = None
                                 with _tr.child_span("train.data_wait"):
+                                    _gp_dw = time.perf_counter()
                                     try:
                                         fetched = next(data_iter)
                                     except StopIteration:
                                         end_of_batch = True
+                                    _gp_dw = time.perf_counter() - _gp_dw
                                 if fetched is not None:
                                     next_data_batch = fetched
                                     try:
@@ -450,6 +461,7 @@ class BaseModule(object):
                                             sparse_row_id_fn=sparse_row_id_fn)
                                     except StopIteration:
                                         end_of_batch = True
+                            _gp.step_end(_gp_tok, data_wait_s=_gp_dw)
                             if monitor is not None:
                                 monitor.toc_print()
                             if end_of_batch:
@@ -529,6 +541,7 @@ class BaseModule(object):
                     continue
                 break
         finally:
+            _gp.session_end()
             if prev_handler is not None:
                 signal.signal(signal.SIGTERM, prev_handler)
             if preempt["watchdog"] is not None:
@@ -564,25 +577,30 @@ class BaseModule(object):
                     "data iterator checkpoint_state() failed; checkpoint "
                     "carries no io cursor (resume will replay)",
                     exc_info=True)
-        with _tr.start_span("train.checkpoint",
-                            attrs={"epoch": epoch, "nbatch": nbatch}):
-            saver = getattr(self, "save_checkpoint", None)
-            if saver is not None:
-                saver(prefix, epoch, save_optimizer_states, nbatch=nbatch,
-                      io_cursor=io_cursor)
-                return
-            # modules without a save_checkpoint of their own (Sequential,
-            # Python): params + manifest through the model-level writer
-            from ..model import save_checkpoint as _model_save
-            arg_p, aux_p = self.get_params()
-            states = None
-            if save_optimizer_states and self.optimizer_initialized and \
-                    hasattr(self, "save_optimizer_states"):
-                states = "%s-%04d.states" % (prefix, epoch)
-                self.save_optimizer_states(states)
-            _model_save(prefix, epoch, self._symbol, arg_p, aux_p,
-                        nbatch=nbatch, states_fname=states,
-                        io_cursor=io_cursor)
+        _gp_t0 = time.perf_counter()
+        try:
+            with _tr.start_span("train.checkpoint",
+                                attrs={"epoch": epoch, "nbatch": nbatch}):
+                saver = getattr(self, "save_checkpoint", None)
+                if saver is not None:
+                    saver(prefix, epoch, save_optimizer_states, nbatch=nbatch,
+                          io_cursor=io_cursor)
+                    return
+                # modules without a save_checkpoint of their own
+                # (Sequential, Python): params + manifest through the
+                # model-level writer
+                from ..model import save_checkpoint as _model_save
+                arg_p, aux_p = self.get_params()
+                states = None
+                if save_optimizer_states and self.optimizer_initialized and \
+                        hasattr(self, "save_optimizer_states"):
+                    states = "%s-%04d.states" % (prefix, epoch)
+                    self.save_optimizer_states(states)
+                _model_save(prefix, epoch, self._symbol, arg_p, aux_p,
+                            nbatch=nbatch, states_fname=states,
+                            io_cursor=io_cursor)
+        finally:
+            _gp.note("checkpoint", time.perf_counter() - _gp_t0)
 
     # -- properties --------------------------------------------------------
     @property

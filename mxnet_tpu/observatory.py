@@ -1,0 +1,552 @@
+"""Cluster observatory: one read-only view over N processes' telemetry.
+
+Every process in a training pod or serving fleet already exposes the
+full single-process observability surface — ``/metrics``, ``/traces``,
+``/alerts``, a flight-recorder ring.  What no single process can
+answer is the *cluster* question: which rank is the straggler, is the
+fleet burning its SLO everywhere or on one box, what did the whole
+pod's global step N look like, and — after a chaos night — what is THE
+incident timeline across every ring that was being written when things
+died.  The observatory is that aggregation plane, deliberately thin:
+
+* **Discovery, not registration.**  Peers are found where they already
+  announce themselves: each elastic rank publishes its telemetry
+  endpoint in its heartbeat file (``hb-g<gen>-r<rank>.json`` under
+  ``MXNET_ELASTIC_DIR``), each serving replica's port is in the
+  :class:`~mxnet_tpu.serve.fleet.Fleet` roster, and static
+  ``host:port`` peers can be passed directly.  Nothing runs an agent
+  for the observatory's benefit.
+* **Read-only and failure-tolerant.**  Scrapes are plain HTTP GETs
+  with a short timeout (``MXNET_OBSERVATORY_TIMEOUT_S``); a dead or
+  stale peer degrades to a counted ``observatory/scrape_failures_total``
+  increment — never an exception, never a retry storm.  Scraping a
+  peer that happens to be *this* process goes through the same fence
+  as cost analysis (``telemetry.suppress_compile_tracking()``) so
+  observation cannot perturb compile/dispatch-count invariants the
+  test-suite and bench gates rely on.
+* **Cross-process stitching.**  Per-rank ``train.step`` trace
+  summaries carry their root attrs (epoch, nbatch) and a wall-clock
+  anchor; grouping them by (epoch, nbatch) across peers yields one
+  ``cluster.step`` timeline per *global* step — per-rank durations,
+  skew, and which rank was slowest.  Rank-level means feed the
+  ``observatory/rank_step_seconds{rank}`` gauges and the
+  ``observatory/step_skew_seconds`` worst-minus-best gauge.
+* **Flight-ring merge.**  ``python -m mxnet_tpu.observatory --merge
+  ring1 ring2 …`` (and :meth:`Observatory.merge`) folds every
+  process's black-box ring — including torn tails from SIGKILLed
+  writers — into one time-ordered incident timeline via
+  :func:`mxnet_tpu.blackbox.merge_rings`.
+
+The merged view is served as ``GET /cluster`` on both telemetry mounts
+(:func:`mxnet_tpu.telemetry.serve` and ``serve.serve_http``) and
+summarized into ``mxnet_tpu.diagnostics()`` when an observatory is
+configured.  docs/observability.md#cluster-observatory--goodput-ledger
+documents the schema.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import threading
+import time
+
+__all__ = ["Observatory", "configure", "configured", "current",
+           "cluster_endpoint", "main"]
+
+# one prometheus family out of a peer's /metrics text: group(1) the
+# family suffix after mxnet_, group(2) an optional single label value,
+# group(3) the sample value (same idiom as fleet._QUEUE_DEPTH_RE)
+_GOODPUT_RE = re.compile(
+    r'^mxnet_(goodput_[a-z_]+?)(?:\{[a-z]+="([a-z_]+)"\})?'
+    r"\s+([0-9.eE+-]+)\s*$", re.MULTILINE)
+
+_HB_RE = re.compile(r"^hb-g(\d+)-r(\d+)\.json$")
+
+
+def _cfg(name, default=None):
+    try:
+        from .config import get
+        v = get(name)
+        return default if v in (None, "") else v
+    except Exception:
+        return default
+
+
+def _http_get(host, port, path, timeout=2.0):
+    """(status, body-bytes) or (None, b"") — never raises."""
+    try:
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+    except (OSError, http.client.HTTPException, ValueError):
+        return None, b""
+
+
+class Observatory(object):
+    """Aggregates ``/metrics``, ``/traces``, ``/alerts`` and flight
+    rings across discovered peers into one cluster view.
+
+    ``elastic_dir``: heartbeat directory of an elastic pod (defaults to
+    ``MXNET_ELASTIC_DIR`` when set) — ranks publishing a ``telemetry``
+    endpoint in their heartbeat become peers.
+    ``fleet``: a live :class:`~mxnet_tpu.serve.fleet.Fleet` — its ready
+    replicas become peers.
+    ``peers``: extra static ``"host:port"`` strings.
+    """
+
+    def __init__(self, elastic_dir=None, fleet=None, peers=(),
+                 timeout_s=None):
+        self.elastic_dir = elastic_dir
+        self.fleet = fleet
+        self.static_peers = tuple(peers or ())
+        self.timeout_s = float(timeout_s if timeout_s is not None
+                               else _cfg("MXNET_OBSERVATORY_TIMEOUT_S", 2.0))
+        self.scrape_failures_total = 0
+        self._lock = threading.Lock()
+        self._stitched_seen = set()   # (epoch, nbatch) already span-recorded
+
+    # -- discovery --------------------------------------------------------
+
+    def _rank_peers(self):
+        """Peers from elastic heartbeat files: freshest heartbeat per
+        rank (highest generation wins) that advertises a telemetry
+        endpoint."""
+        root = self.elastic_dir or _cfg("MXNET_ELASTIC_DIR")
+        if not root:
+            return []
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        best = {}  # rank -> (gen, ts, rec)
+        for n in names:
+            m = _HB_RE.match(n)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(root, n)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            gen, rank = int(m.group(1)), int(m.group(2))
+            key = (gen, float(rec.get("ts", 0.0)))
+            if rank not in best or key > best[rank][:2]:
+                best[rank] = (gen, key[1], rec)
+        out = []
+        for rank in sorted(best):
+            gen, ts, rec = best[rank]
+            ep = rec.get("telemetry")
+            if not ep or ":" not in ep:
+                continue
+            host, port = ep.rsplit(":", 1)
+            try:
+                port = int(port)
+            except ValueError:
+                continue
+            out.append({"name": "rank%d" % rank, "kind": "rank",
+                        "rank": rank, "gen": gen, "host": host,
+                        "port": port, "hb_age_s": round(time.time() - ts, 3)})
+        return out
+
+    def _replica_peers(self):
+        if self.fleet is None:
+            return []
+        try:
+            status = self.fleet.status()
+        except Exception:
+            return []
+        out = []
+        for rep in status.get("replicas", ()):
+            if rep.get("port") is None:
+                continue
+            out.append({"name": rep["name"], "kind": "replica",
+                        "host": "127.0.0.1", "port": int(rep["port"])})
+        return out
+
+    def discover(self):
+        """All current peers (rank + replica + static), no liveness
+        probe — dead peers surface as counted scrape failures."""
+        peers = self._rank_peers() + self._replica_peers()
+        for i, ep in enumerate(self.static_peers):
+            if ":" not in ep:
+                continue
+            host, port = ep.rsplit(":", 1)
+            try:
+                port = int(port)
+            except ValueError:
+                continue
+            peers.append({"name": "peer%d" % i, "kind": "static",
+                          "host": host, "port": port})
+        return peers
+
+    # -- scraping ---------------------------------------------------------
+
+    def _get(self, peer, path):
+        """Fetch one endpoint of one peer; a miss counts one scrape
+        failure and returns None."""
+        status, body = _http_get(peer["host"], peer["port"], path,
+                                 timeout=self.timeout_s)
+        if status != 200:
+            with self._lock:
+                self.scrape_failures_total += 1
+            self._count_failure(peer, path)
+            return None
+        return body
+
+    def _count_failure(self, peer, path):
+        try:
+            from . import telemetry as _tm
+            if _tm._enabled:
+                _tm.counter(
+                    "observatory/scrape_failures_total",
+                    "Peer endpoint scrapes that failed (dead peer, "
+                    "timeout, non-200); dead peers degrade to this "
+                    "counter, never an exception").inc()
+        except Exception:
+            pass
+
+    def _scrape_peer(self, peer):
+        """One peer's metrics/traces/alerts, parsed; partial on
+        failures."""
+        row = {"name": peer["name"], "kind": peer["kind"],
+               "endpoint": "%s:%d" % (peer["host"], peer["port"]),
+               "ok": True}
+        if "rank" in peer:
+            row["rank"] = peer["rank"]
+        if "hb_age_s" in peer:
+            row["hb_age_s"] = peer["hb_age_s"]
+
+        body = self._get(peer, "/alerts?format=json")
+        if body is not None:
+            try:
+                row["firing"] = list(json.loads(body.decode())["firing"])
+            except (ValueError, KeyError, UnicodeDecodeError):
+                row["firing"] = []
+        else:
+            row["ok"] = False
+            row["firing"] = []
+
+        body = self._get(peer, "/metrics")
+        goodput = {"categories": {}}
+        if body is not None:
+            for fam, label, val in _GOODPUT_RE.findall(
+                    body.decode("utf-8", "replace")):
+                if fam == "goodput_category_seconds" and label:
+                    goodput["categories"][label] = float(val)
+                elif fam == "goodput_wall_seconds":
+                    goodput["wall_s"] = float(val)
+                elif fam == "goodput_goodput_fraction":
+                    goodput["goodput_fraction"] = float(val)
+                elif fam == "goodput_badput_fraction":
+                    goodput["badput_fraction"] = float(val)
+        else:
+            row["ok"] = False
+        row["goodput"] = goodput if len(goodput) > 1 or \
+            goodput["categories"] else None
+
+        body = self._get(peer, "/traces")
+        steps = []
+        if body is not None:
+            try:
+                recent = json.loads(body.decode()).get("recent", ())
+            except (ValueError, UnicodeDecodeError):
+                recent = ()
+            for s in recent:
+                if s.get("root") == "train.step":
+                    steps.append(s)
+        else:
+            row["ok"] = False
+        row["train_steps"] = steps
+        return row
+
+    # -- stitching --------------------------------------------------------
+
+    def _stitch(self, rows):
+        """Group per-rank ``train.step`` summaries by their (epoch,
+        nbatch) root attrs into per-GLOBAL-step entries, compute skew,
+        and name the straggler.  Newly seen global steps are
+        materialized as ``cluster.step`` marker spans in this process's
+        tracer (attrs carry the stitched numbers; the per-rank wall
+        windows live in the peers' own ``train.step`` spans)."""
+        groups = {}
+        for row in rows:
+            for s in row["train_steps"]:
+                attrs = s.get("root_attrs") or {}
+                if "epoch" not in attrs or "nbatch" not in attrs:
+                    continue
+                key = (int(attrs["epoch"]), int(attrs["nbatch"]))
+                groups.setdefault(key, {})[row["name"]] = {
+                    "duration_ms": s.get("duration_ms"),
+                    "trace_id": s.get("trace_id"),
+                    "wall_ts": s.get("wall_ts"),
+                }
+        steps = []
+        for (epoch, nbatch) in sorted(groups):
+            ranks = groups[(epoch, nbatch)]
+            durs = {n: v["duration_ms"] for n, v in ranks.items()
+                    if v.get("duration_ms") is not None}
+            entry = {"epoch": epoch, "nbatch": nbatch, "ranks": ranks,
+                     "world": len(ranks)}
+            if durs:
+                worst = max(durs, key=durs.get)
+                entry["skew_ms"] = round(max(durs.values())
+                                         - min(durs.values()), 3)
+                entry["straggler"] = worst
+            steps.append(entry)
+            self._record_cluster_step(entry)
+        return steps
+
+    def _record_cluster_step(self, entry):
+        """One ``cluster.step`` marker span per newly stitched global
+        step (root span in the observatory's own tracer; subject to its
+        sampling like any root)."""
+        key = (entry["epoch"], entry["nbatch"])
+        with self._lock:
+            if key in self._stitched_seen:
+                return
+            self._stitched_seen.add(key)
+            if len(self._stitched_seen) > 4096:
+                self._stitched_seen.clear()
+                self._stitched_seen.add(key)
+        try:
+            from . import tracing as _tr
+            attrs = {"epoch": entry["epoch"], "nbatch": entry["nbatch"],
+                     "world": entry["world"]}
+            if "skew_ms" in entry:
+                attrs["skew_ms"] = entry["skew_ms"]
+                attrs["straggler"] = entry["straggler"]
+            with _tr.start_span("cluster.step", attrs=attrs):
+                pass
+        except Exception:
+            pass
+
+    # -- the cluster view -------------------------------------------------
+
+    def cluster_view(self, limit=20):
+        """Scrape every discovered peer and merge: per-peer health,
+        fleet-wide firing alerts, stitched ``cluster.step`` timeline,
+        per-rank step-time skew, per-peer + cluster goodput.  Read-only
+        w.r.t. this process's compile/dispatch accounting (scrapes run
+        under the cost-analysis fence)."""
+        from . import telemetry as _tm
+        with _tm.suppress_compile_tracking():
+            peers = self.discover()
+            rows = [self._scrape_peer(p) for p in peers]
+        steps = self._stitch(rows)
+        if limit:
+            steps = steps[-int(limit):]
+
+        firing = sorted({r for row in rows for r in row["firing"]})
+        by_peer = {row["name"]: row["firing"] for row in rows
+                   if row["firing"]}
+
+        # per-rank mean step seconds -> skew gauges
+        rank_means = {}
+        for row in rows:
+            durs = [s["duration_ms"] for s in row["train_steps"]
+                    if s.get("duration_ms") is not None]
+            if durs:
+                rank_means[row["name"]] = round(
+                    sum(durs) / len(durs) / 1000.0, 6)
+        skew = {"per_peer_step_s": rank_means}
+        if len(rank_means) >= 2:
+            worst = max(rank_means, key=rank_means.get)
+            skew["skew_s"] = round(max(rank_means.values())
+                                   - min(rank_means.values()), 6)
+            skew["straggler"] = worst
+        self._update_gauges(rows, rank_means, skew.get("skew_s"))
+
+        goodput = {row["name"]: row["goodput"] for row in rows
+                   if row.get("goodput")}
+        with self._lock:
+            failures = self.scrape_failures_total
+        return {"ts": time.time(),
+                "peers": [{k: v for k, v in row.items()
+                           if k != "train_steps"} for row in rows],
+                "peer_count": len(rows),
+                "alerts": {"firing": firing, "by_peer": by_peer},
+                "steps": steps,
+                "skew": skew,
+                "goodput": goodput,
+                "scrape_failures_total": failures}
+
+    def _update_gauges(self, rows, rank_means, skew_s):
+        try:
+            from . import telemetry as _tm
+            if not _tm._enabled:
+                return
+            _tm.gauge("observatory/peers",
+                      "Peers the cluster observatory discovered on its "
+                      "last scrape").set(len(rows))
+            if rank_means:
+                g = _tm.gauge(
+                    "observatory/rank_step_seconds",
+                    "Mean train.step wall per scraped peer (the "
+                    "straggler is the max)", ("rank",))
+                for name, mean_s in rank_means.items():
+                    g.labels(name).set(mean_s)
+            if skew_s is not None:
+                _tm.gauge("observatory/step_skew_seconds",
+                          "Worst-minus-best mean step wall across "
+                          "peers on the last scrape").set(skew_s)
+        except Exception:
+            pass
+
+    def summary(self):
+        """One-shot compact cluster summary (embedded in
+        ``mxnet_tpu.diagnostics()``)."""
+        view = self.cluster_view(limit=5)
+        out = {"peers": view["peer_count"],
+               "peers_ok": sum(1 for p in view["peers"] if p["ok"]),
+               "alerts_firing": view["alerts"]["firing"],
+               "scrape_failures_total": view["scrape_failures_total"]}
+        if "skew_s" in view["skew"]:
+            out["step_skew_s"] = view["skew"]["skew_s"]
+            out["straggler"] = view["skew"]["straggler"]
+        if view["goodput"]:
+            out["goodput"] = {
+                name: {"goodput_fraction": gp.get("goodput_fraction"),
+                       "badput_fraction": gp.get("badput_fraction")}
+                for name, gp in view["goodput"].items()}
+        return out
+
+    # -- flight-ring merge ------------------------------------------------
+
+    def merge(self, paths):
+        """Merge N processes' flight-recorder rings into one ordered
+        incident timeline (:func:`mxnet_tpu.blackbox.merge_rings`)."""
+        from . import blackbox as _bb
+        return _bb.merge_rings(paths)
+
+
+# ---------------------------------------------------------------------------
+# module-level instance (the one diagnostics() and /cluster consult)
+# ---------------------------------------------------------------------------
+
+_OBS = None
+
+
+def configure(elastic_dir=None, fleet=None, peers=(), timeout_s=None):
+    """Install the process-wide observatory (returned; also reachable
+    via :func:`current`).  Pass ``None``s to clear."""
+    global _OBS
+    if elastic_dir is None and fleet is None and not peers:
+        _OBS = None
+        return None
+    _OBS = Observatory(elastic_dir=elastic_dir, fleet=fleet, peers=peers,
+                       timeout_s=timeout_s)
+    return _OBS
+
+
+def configured():
+    return _OBS is not None
+
+
+def current():
+    return _OBS
+
+
+def cluster_endpoint(query=""):
+    """(status_code, payload_dict) for ``GET /cluster`` — the ONE
+    implementation behind both mounts.  Unconfigured processes answer
+    200 with ``{"configured": false}`` unless ``MXNET_ELASTIC_DIR``
+    points at a pod control directory, in which case an ephemeral
+    heartbeat-discovery observatory serves the request."""
+    from urllib.parse import parse_qs
+    q = parse_qs(query)
+    try:
+        limit = int((q.get("limit") or ["20"])[0])
+    except ValueError:
+        limit = 20
+    obs = _OBS
+    if obs is None and _cfg("MXNET_ELASTIC_DIR"):
+        obs = Observatory()
+    if obs is None:
+        return 200, {"configured": False}
+    view = obs.cluster_view(limit=limit)
+    view["configured"] = True
+    return 200, view
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _print_timeline(merged, as_json=False):
+    if as_json:
+        print(json.dumps(merged, indent=2, default=str))
+        return
+    print("merged incident timeline: %d events from %d ring(s)"
+          % (merged["count"], len(merged["rings"])))
+    for path, torn in sorted(merged["abandoned"].items()):
+        if torn:
+            print("  torn tail: %d abandoned byte(s) in %s" % (torn, path))
+    t0 = merged["events"][0]["t"] if merged["events"] else 0.0
+    for e in merged["events"]:
+        extras = {k: v for k, v in e.items()
+                  if k not in ("t", "pid", "event", "ring")}
+        print("  +%9.3fs pid=%-7d %-16s %s  [%s]"
+              % (e["t"] - t0, e.get("pid", 0), e["event"],
+                 json.dumps(extras, default=str) if extras else "",
+                 os.path.basename(e["ring"])))
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.observatory",
+        description="Cluster observatory: scrape peers into one cluster "
+                    "view, or merge flight rings into one incident "
+                    "timeline.")
+    ap.add_argument("--merge", nargs="+", metavar="RING",
+                    help="flight-recorder ring files to merge into one "
+                         "ordered incident timeline (handles torn tails "
+                         "from SIGKILLed writers)")
+    ap.add_argument("--dir", help="elastic heartbeat directory to "
+                                  "discover rank peers from (default: "
+                                  "MXNET_ELASTIC_DIR)")
+    ap.add_argument("--peers", nargs="*", default=(), metavar="HOST:PORT",
+                    help="static peer telemetry endpoints")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="stitched cluster.step entries to keep")
+    ap.add_argument("--json", action="store_true",
+                    help="print raw JSON instead of a summary")
+    args = ap.parse_args(argv)
+
+    if args.merge:
+        from . import blackbox as _bb
+        _print_timeline(_bb.merge_rings(args.merge), as_json=args.json)
+        return 0
+
+    obs = Observatory(elastic_dir=args.dir, peers=args.peers)
+    view = obs.cluster_view(limit=args.limit)
+    if args.json:
+        print(json.dumps(view, indent=2, default=str))
+        return 0
+    print("cluster view: %d peer(s), %d ok, %d scrape failure(s)"
+          % (view["peer_count"],
+             sum(1 for p in view["peers"] if p["ok"]),
+             view["scrape_failures_total"]))
+    if view["alerts"]["firing"]:
+        print("  firing: %s" % ", ".join(view["alerts"]["firing"]))
+    for name, mean_s in sorted(
+            view["skew"].get("per_peer_step_s", {}).items()):
+        print("  %-12s mean step %.4fs" % (name, mean_s))
+    if "skew_s" in view["skew"]:
+        print("  skew %.4fs (straggler: %s)"
+              % (view["skew"]["skew_s"], view["skew"]["straggler"]))
+    for name, gp in sorted(view["goodput"].items()):
+        if gp and gp.get("goodput_fraction") is not None:
+            print("  %-12s goodput %.1f%%"
+                  % (name, 100.0 * gp["goodput_fraction"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

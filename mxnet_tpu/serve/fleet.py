@@ -480,6 +480,8 @@ class Fleet(object):
     def status(self):
         with self._lock:
             reps = [{"name": r.name, "pid": r.proc.pid, "port": r.port,
+                     "endpoint": ("127.0.0.1:%d" % r.port
+                                  if r.port is not None else None),
                      "retiring": r.retiring, "warm": r.warm,
                      "spawn_s": (round(r.ready_t - r.spawned_t, 3)
                                  if r.ready_t else None)}

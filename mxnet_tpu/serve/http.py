@@ -250,6 +250,10 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
                 from .. import forensics as _fx
                 code, payload = _fx.programs_endpoint(query)
                 self._reply(code, payload)
+            elif path == "/cluster":
+                from .. import observatory as _ob
+                code, payload = _ob.cluster_endpoint(query)
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": "not found"})
 
@@ -428,4 +432,7 @@ def serve_http(target, port=0, addr="127.0.0.1", decode=None):
     thread = threading.Thread(target=httpd.serve_forever,
                               name="mxnet-serve-http", daemon=True)
     thread.start()
+    # publish this mount as the process's scrapable endpoint (elastic
+    # heartbeats and the cluster observatory read it)
+    _tm.set_server_endpoint(addr, httpd.server_address[1])
     return ServeHTTPServer(httpd, thread, target, decode)

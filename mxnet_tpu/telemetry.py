@@ -747,6 +747,23 @@ def record_hbm(device, bytes_in_use, peak_bytes=None):
 # /metrics HTTP server (stdlib only)
 # ---------------------------------------------------------------------------
 
+# last-started metrics endpoint of this process ("host:port"), set by
+# serve() / serve.serve_http and published in the elastic heartbeat so
+# the cluster observatory can discover this rank with no extra config
+_server_endpoint = None
+
+
+def server_endpoint():
+    """``"host:port"`` of this process's most recently started metrics
+    mount (telemetry.serve or serve.serve_http), or None."""
+    return _server_endpoint
+
+
+def set_server_endpoint(host, port):
+    global _server_endpoint
+    _server_endpoint = "%s:%d" % (host, int(port)) if port else None
+
+
 class TelemetryServer(object):
     """Handle on a running metrics endpoint (returned by :func:`serve`)."""
 
@@ -804,6 +821,11 @@ def serve(port=0, addr="127.0.0.1", registry=None):
                 code, payload = _fx.programs_endpoint(query)
                 body = json.dumps(payload, default=str).encode() + b"\n"
                 ctype = "application/json"
+            elif path == "/cluster":
+                from . import observatory as _ob
+                code, payload = _ob.cluster_endpoint(query)
+                body = json.dumps(payload, default=str).encode() + b"\n"
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
@@ -821,6 +843,7 @@ def serve(port=0, addr="127.0.0.1", registry=None):
     thread = threading.Thread(target=httpd.serve_forever,
                               name="mxnet-telemetry", daemon=True)
     thread.start()
+    set_server_endpoint(addr, httpd.server_address[1])
     return TelemetryServer(httpd, thread)
 
 
@@ -929,6 +952,20 @@ def snapshot():
     # run has fusion-level provenance
     out["forensics_captured"] = _val("forensics/captured_total")
     out["forensics_unavailable"] = _val("forensics/unavailable_total")
+    # goodput-ledger accounting (goodput.py): what fraction of the
+    # run's wall was useful step compute, and where the rest went —
+    # banked with every bench record when a fit session is live
+    try:
+        from . import goodput as _gp
+        rep = _gp.report()
+        if rep.get("active"):
+            out["goodput_fraction"] = rep["goodput_fraction"]
+            out["badput_fraction"] = rep["badput_fraction"]
+            out["goodput_wall_s"] = rep["wall_s"]
+            for c, d in rep["categories"].items():
+                out["goodput_%s_s" % c] = d["seconds"]
+    except Exception:
+        pass
     fam = REGISTRY._families.get("serving/batch_rows")
     if fam is not None:
         rows = sum(c.sum for _lv, c in fam.series())
@@ -1058,6 +1095,26 @@ def diagnostics(as_dict=False):
         except Exception:
             pass
         info["health"] = hinfo
+    except Exception:
+        pass
+    try:
+        # goodput ledger: the run's wall-clock cost accounting (every
+        # second attributed to step compute / data wait / compile /
+        # checkpoint / rescale / restart / straggler wait / idle)
+        from . import goodput as _gp
+        rep = _gp.report()
+        if rep.get("active"):
+            info["goodput"] = rep
+    except Exception:
+        pass
+    try:
+        # cluster observatory (observatory.py): when one is configured,
+        # the bug report carries the one-shot CLUSTER summary — peer
+        # count, alerts firing anywhere in the fleet, worst-rank step
+        # skew, merged goodput — not just process-local state
+        from . import observatory as _ob
+        if _ob.configured():
+            info["cluster"] = _ob.current().summary()
     except Exception:
         pass
     eng_mod = sys.modules.get("mxnet_tpu.serve.engine")

@@ -591,10 +591,21 @@ def chrome_events():
 
 
 def _trace_summary(t):
+    # the root span's attrs ride the summary (e.g. train.step's
+    # epoch/nbatch): the cluster observatory joins per-rank step
+    # timelines on them without fetching every trace by id, and
+    # wall_ts is the cross-process clock anchor that lets it stitch
+    # N ranks' perf_counter timelines onto one axis
+    root_attrs = {}
+    for s in t["spans"]:
+        if s.get("parent_id") is None and s["name"] == t["root"]:
+            root_attrs = s.get("attrs") or {}
+            break
     return {"trace_id": t["trace_id"], "root": t["root"],
             "duration_ms": t["duration_ms"], "error": t["error"],
             "slow": t["slow"], "spans": len(t["spans"]),
-            "phases": t["phases"], "age_s": round(
+            "phases": t["phases"], "root_attrs": root_attrs,
+            "wall_ts": round(t["wall_ts"], 6), "age_s": round(
                 time.time() - t["wall_ts"], 1)}
 
 

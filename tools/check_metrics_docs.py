@@ -15,7 +15,9 @@ rule names (``health.watch("name", ...)`` literals under mxnet_tpu/)
 must match the table under ``<!-- slo-rules -->``, and every HTTP
 endpoint routed by a ``path == "/x"`` literal comparison (the
 telemetry.serve / serve.http do_GET/do_POST dispatch idiom) must match
-the table under ``<!-- http-endpoints -->``. Fails listing the
+the table under ``<!-- http-endpoints -->``, and the goodput-ledger
+attribution taxonomy (the ``goodput.CATEGORIES`` tuple literal) must
+match the table under ``<!-- goodput-categories -->``. Fails listing the
 missing names on either side, so the observability surface and its
 documentation cannot silently drift (the same contract fault.POINTS
 enforces for injection points).
@@ -109,6 +111,27 @@ def collect_code_names():
     return metrics, spans, events, rules, endpoints
 
 
+def collect_goodput_categories():
+    """The ``CATEGORIES`` tuple literal in mxnet_tpu/goodput.py — the
+    goodput ledger's complete attribution taxonomy."""
+    path = os.path.join(PKG, "goodput.py")
+    cats = set()
+    if not os.path.exists(path):
+        return cats
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "CATEGORIES"
+                        for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str):
+                    cats.add(el.value)
+    return cats
+
+
 def collect_doc_names():
     """(metric_names, span_names) from the first cell of every table
     row in docs/observability.md. One cell may list several backticked
@@ -168,6 +191,8 @@ def check():
     doc_e = collect_doc_marked("flight-recorder-events")
     doc_r = collect_doc_marked("slo-rules")
     doc_p = collect_doc_marked("http-endpoints", _ENDPOINT_RE)
+    code_g = collect_goodput_categories()
+    doc_g = collect_doc_marked("goodput-categories")
     return {
         "metrics_undocumented": sorted(code_m - doc_m),
         "metrics_stale_in_docs": sorted(doc_m - code_m),
@@ -179,6 +204,8 @@ def check():
         "slo_rules_stale_in_docs": sorted(doc_r - code_r),
         "endpoints_undocumented": sorted(code_p - doc_p),
         "endpoints_stale_in_docs": sorted(doc_p - code_p),
+        "goodput_categories_undocumented": sorted(code_g - doc_g),
+        "goodput_categories_stale_in_docs": sorted(doc_g - code_g),
     }
 
 
@@ -200,9 +227,10 @@ def main():
         return 1
     code_m, code_s, code_e, code_r, code_p = collect_code_names()
     print("ok: %d metrics, %d spans, %d flight events, %d SLO rules, "
-          "%d endpoints in sync with docs/observability.md"
+          "%d endpoints, %d goodput categories in sync with "
+          "docs/observability.md"
           % (len(code_m), len(code_s), len(code_e), len(code_r),
-             len(code_p)))
+             len(code_p), len(collect_goodput_categories())))
     return 0
 
 
